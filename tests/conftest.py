@@ -1,0 +1,36 @@
+"""Test environment: a virtual 8-device CPU mesh.
+
+Mirrors the reference's testing stance of "CPU fallback as the no-cluster
+mode" (SURVEY.md §4: use_gpu=False default, my_ray_module.py:218): all tests
+run on XLA CPU devices, with 8 virtual devices so multi-chip shardings
+(DP/FSDP/TP/SP) compile and execute without TPU hardware. Env vars must be
+set before jax initializes its backends, hence the top-of-conftest placement.
+"""
+
+import os
+
+# Force CPU even when the environment preselects a TPU platform plugin
+# (tests never touch real chips; bench.py is what runs on hardware). The
+# platform plugin's sitecustomize overrides JAX_PLATFORMS via jax.config, so
+# the config must be re-updated after import, before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if jax.config.jax_num_cpu_devices < 8:
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpuflow import dist
+
+    return dist.make_mesh({"data": 8})

@@ -1,0 +1,53 @@
+"""Unit tests for the dist facade (mesh, shardings, batch placement)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuflow import dist
+
+
+def test_make_mesh_default_all_data():
+    mesh = dist.make_mesh()
+    assert mesh.shape["data"] == len(jax.devices())
+    # Canonical axes always present so sharding rules resolve on any mesh.
+    for name in ("data", "fsdp", "tensor", "seq"):
+        assert name in mesh.shape
+
+
+def test_make_mesh_infer_axis():
+    mesh = dist.make_mesh({"data": -1, "tensor": 2})
+    assert mesh.shape["data"] == len(jax.devices()) // 2
+    assert mesh.shape["tensor"] == 2
+
+
+def test_make_mesh_bad_total():
+    with pytest.raises(ValueError):
+        dist.make_mesh({"data": 3})
+
+
+def test_data_axis_size(mesh8):
+    assert dist.data_axis_size(mesh8) == 8
+    mesh = dist.make_mesh({"data": 2, "fsdp": 4})
+    assert dist.data_axis_size(mesh) == 8
+
+
+def test_shard_batch_layout(mesh8):
+    batch = {"x": np.zeros((16, 28, 28), np.float32), "y": np.zeros((16,), np.int32)}
+    placed = dist.shard_batch(batch, mesh8)
+    # Leading dim split 8 ways: each device holds 2 rows.
+    shard_shapes = {s.data.shape for s in placed["x"].addressable_shards}
+    assert shard_shapes == {(2, 28, 28)}
+    assert placed["y"].sharding.spec == P(("data", "fsdp"))
+
+
+def test_replicated(mesh8):
+    x = jax.device_put(np.ones((4, 4), np.float32), dist.replicated(mesh8))
+    assert x.sharding.is_fully_replicated
+
+
+def test_initialize_single_process_noop():
+    dist.initialize()  # no coordinator → no-op, must not raise
+    assert not dist.is_initialized()
+    dist.barrier()  # single-process barrier is a no-op

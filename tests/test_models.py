@@ -1,0 +1,66 @@
+"""Model zoo tests: ResNet (batch_stats path) and GPT-2 (LM path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpuflow.models import get_model
+from tpuflow.models.gpt2 import GPT2Config
+from tpuflow.train import create_train_state, make_train_step
+
+
+def test_resnet18_forward_and_train_step():
+    model = get_model("resnet18", num_classes=10, small_inputs=True, width=8)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), optax.sgd(0.1)
+    )
+    assert state.batch_stats  # BatchNorm stats tracked
+    batch = {
+        "x": np.random.default_rng(0).normal(size=(8, 32, 32, 3)).astype(np.float32),
+        "y": np.arange(8, dtype=np.int32) % 10,
+    }
+    step = make_train_step(donate=False)
+    state2, metrics = step(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    # Running stats must have been updated.
+    before = jax.tree_util.tree_leaves(state.batch_stats)[0]
+    after = jax.tree_util.tree_leaves(state2.batch_stats)[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_resnet50_builds():
+    model = get_model("resnet50", num_classes=100, width=8, small_inputs=True)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    n_bottleneck = sum(
+        1 for k in variables["params"] if k.startswith("BottleneckBlock")
+    )
+    assert n_bottleneck == 3 + 4 + 6 + 3
+
+
+def test_gpt2_forward_and_loss_step():
+    cfg = GPT2Config.small_test()
+    model = get_model("gpt2", config=cfg)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(2, 16)
+    ).astype(np.int32)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32), optax.adamw(1e-3)
+    )
+    logits = state.apply_fn({"params": state.params}, tokens, train=False)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # Next-token LM batch through the generic train step.
+    batch = {"x": tokens[:, :-1], "y": tokens[:, 1:]}
+    step = make_train_step(donate=False)
+    state2, metrics = step(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    # Initial loss should be near uniform log(vocab).
+    assert abs(float(metrics["loss"]) - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_gpt2_weight_tying():
+    cfg = GPT2Config.small_test()
+    model = get_model("gpt2", config=cfg)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    assert variables["params"]["wte"].shape == (cfg.vocab_size, cfg.n_embd)
+    assert "lm_head" not in variables["params"]  # tied to wte

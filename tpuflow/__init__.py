@@ -1,0 +1,27 @@
+"""tpuflow — TPU-native distributed training, checkpointing, and eval pipelines.
+
+A brand-new JAX/XLA framework providing the capabilities of the reference
+pipeline `outerbounds/ray-torch-distributed-checkpoint` (Metaflow + Ray Train +
+torch DDP/NCCL + Ray Data), re-designed TPU-first:
+
+- ``tpuflow.dist``  — mesh + multi-host gang init (XLA collectives over ICI/DCN,
+  replacing NCCL/Gloo + torch.distributed rendezvous).
+- ``tpuflow.data``  — dataset registry with per-host sharding and seeded
+  per-epoch reshuffle (replacing DataLoader + DistributedSampler).
+- ``tpuflow.models`` — Flax model zoo (parity MLP, ResNet, GPT-2) + losses.
+- ``tpuflow.train`` — Trainer / ScalingConfig / RunConfig / report() / Result
+  (replacing Ray Train's TorchTrainer worker group).
+- ``tpuflow.ckpt``  — async sharded checkpointing with best/latest policies and
+  retention (Orbax; replacing torch.save + Ray Checkpoint).
+- ``tpuflow.infer`` — batch inference engine (replacing Ray Data map_batches).
+- ``tpuflow.flow``  — a small flow orchestrator: steps, parameters, artifacts,
+  --from-run resume, retries, triggers, cards (replacing Metaflow).
+- ``tpuflow.ops``   — Pallas TPU kernels (flash attention, ...).
+- ``tpuflow.parallel`` — sharding rules: DP / FSDP / tensor / ring-attention
+  sequence parallelism over a named ``jax.sharding.Mesh``.
+
+See ``SURVEY.md`` at the repo root for the capability contract and the mapping
+from every reference component to its tpuflow equivalent.
+"""
+
+__version__ = "0.1.0"

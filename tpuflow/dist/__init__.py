@@ -1,0 +1,45 @@
+"""Distributed communication backend facade (mesh, gang init, shardings).
+
+TPU-native replacement for the reference stack's NCCL/Gloo + torch.distributed
+process-group runtime (exercised at reference my_ray_module.py:135,149,177 via
+ray.train.torch.prepare_model / get_context): rendezvous is
+``jax.distributed.initialize`` over DCN, collectives are XLA's over ICI, and
+data-parallel gradient allreduce is emitted by the compiler from shardings —
+there is no user-visible collective API, same encapsulation as the reference.
+"""
+
+from tpuflow.dist.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    barrier,
+    batch_sharding,
+    data_axis_size,
+    initialize,
+    is_initialized,
+    make_mesh,
+    process_count,
+    process_index,
+    replicated,
+    shard_batch,
+    shutdown,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_SEQ",
+    "AXIS_TENSOR",
+    "barrier",
+    "batch_sharding",
+    "data_axis_size",
+    "initialize",
+    "is_initialized",
+    "make_mesh",
+    "process_count",
+    "process_index",
+    "replicated",
+    "shard_batch",
+    "shutdown",
+]

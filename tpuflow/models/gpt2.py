@@ -1,0 +1,112 @@
+"""GPT-2 causal language model in Flax — the FSDP acceptance-config model.
+
+The driver acceptance configs name "GPT-2-medium FSDP → pjit fully-sharded
+checkpoint (multi-host v5e-32)" (BASELINE.md config 5); the reference repo has
+no transformer at all, so this is a TPU-first design, not a translation:
+bf16 activations on the MXU, attention behind the pluggable ``tpuflow.ops``
+dispatch ('xla' | Pallas 'flash' | sequence-parallel 'ring'), weights tied
+between the token embedding and the LM head, and shapes kept static for jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.ops import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_ctx: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+    attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
+    dtype: jnp.dtype = jnp.float32  # activation dtype; bfloat16 on TPU
+
+    @classmethod
+    def small_test(cls, **kw) -> "GPT2Config":
+        """Tiny config for tests (fast CPU compile)."""
+        kw = {
+            "vocab_size": 512,
+            "n_ctx": 128,
+            "n_embd": 128,
+            "n_layer": 2,
+            "n_head": 4,
+            **kw,
+        }
+        return cls(**kw)
+
+    @classmethod
+    def medium(cls, **kw) -> "GPT2Config":
+        """GPT-2-medium (355M): 24 layers, 1024 hidden, 16 heads."""
+        kw = {"n_embd": 1024, "n_layer": 24, "n_head": 16, **kw}
+        return cls(**kw)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: LN → MHA → residual, LN → MLP → residual."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        cfg = self.config
+        B, T, C = x.shape
+        head_dim = cfg.n_embd // cfg.n_head
+
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_head, head_dim)
+        k = k.reshape(B, T, cfg.n_head, head_dim)
+        v = v.reshape(B, T, cfg.n_head, head_dim)
+        a = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        a = a.reshape(B, T, cfg.n_embd)
+        a = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(a)
+        a = nn.Dropout(cfg.dropout, deterministic=not train)(a)
+        x = x + a
+
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="mlp_fc")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="mlp_proj")(h)
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class GPT2(nn.Module):
+    """Token ids (B, T) int32 → logits (B, T, vocab). LM head tied to wte."""
+
+    config: GPT2Config = GPT2Config()
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        cfg = self.config
+        B, T = tokens.shape
+        wte = self.param(
+            "wte",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.n_embd),
+            jnp.float32,
+        )
+        wpe = self.param(
+            "wpe",
+            nn.initializers.normal(0.01),
+            (cfg.n_ctx, cfg.n_embd),
+            jnp.float32,
+        )
+        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        for i in range(cfg.n_layer):
+            x = Block(cfg, name=f"h{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # Weight-tied LM head; logits in float32 for a stable softmax/CE.
+        return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(
+            jnp.float32
+        )

@@ -1,0 +1,19 @@
+"""Loss / metric functions (parity: nn.CrossEntropyLoss at reference
+my_ray_module.py:141 and the accuracy computation at my_ray_module.py:170-175)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels (↔ nn.CrossEntropyLoss
+    default reduction='mean')."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of argmax predictions matching labels (reference
+    my_ray_module.py:170: ``(pred.argmax(1) == y)``)."""
+    return (jnp.argmax(logits, axis=-1) == labels).mean()

@@ -1,0 +1,38 @@
+"""Parity MLP classifier.
+
+Reproduces the reference `NeuralNetwork` (my_ray_module.py:94-112):
+784 → 512 → 512 → 10 with ReLU + Dropout(0.25) between layers — **including
+the quirk of a ReLU after the final Linear** (my_ray_module.py:106), which
+clamps logits ≥ 0 and is visible in the eval flow's logit bar charts. The
+quirk is on by default for parity; pass ``final_relu=False`` for the corrected
+behavior (documented deviation, SURVEY.md §7 hard-part 4).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class NeuralNetwork(nn.Module):
+    """Flatten → Dense(512) → ReLU → Dropout → Dense(512) → ReLU → Dropout
+    → Dense(10) [→ ReLU if final_relu]."""
+
+    hidden_dim: int = 512
+    num_classes: int = 10
+    dropout_rate: float = 0.25
+    final_relu: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))  # nn.Flatten
+        x = nn.Dense(self.hidden_dim, name="dense1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.hidden_dim, name="dense2")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, name="dense3")(x)
+        if self.final_relu:
+            x = nn.relu(x)
+        return x

@@ -1,0 +1,108 @@
+"""ResNet-18/50 in Flax — the acceptance-config image models.
+
+The reference repo's only model is the FashionMNIST MLP (my_ray_module.py:
+94-112), but the driver acceptance configs name ResNet-18/CIFAR-10 and
+ResNet-50/ImageNet behind the same trainer API (BASELINE.md configs 1-2), so
+the model zoo provides them as standard Flax modules. TPU notes: convolutions
+land on the MXU; NHWC layout (XLA:TPU's native conv layout); BatchNorm
+statistics are per-replica like torch DDP's default (no cross-replica sync).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Conv = partial(nn.Conv, use_bias=False, kernel_init=nn.initializers.he_normal())
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, *, use_running_average: bool):
+        norm = partial(self.norm, use_running_average=use_running_average)
+        residual = x
+        y = Conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = Conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = Conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck block (ResNet-50/101/152)."""
+
+    filters: int
+    strides: int = 1
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, *, use_running_average: bool):
+        norm = partial(self.norm, use_running_average=use_running_average)
+        residual = x
+        y = Conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = Conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = Conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = Conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet. ``small_inputs=True`` uses the CIFAR stem (3x3 conv, no
+    max-pool) instead of the ImageNet stem (7x7/2 + pool)."""
+
+    stage_sizes: Sequence[int]
+    block: type = BasicBlock
+    num_classes: int = 10
+    width: int = 64
+    small_inputs: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        use_ra = not train
+        norm = partial(nn.BatchNorm, use_running_average=use_ra)
+        if x.ndim == 3:  # (B, H, W) grayscale → add channel dim
+            x = x[..., None]
+        if self.small_inputs:
+            x = Conv(self.width, (3, 3))(x)
+        else:
+            x = Conv(self.width, (7, 7), strides=(2, 2))(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.width * 2**i, strides)(
+                    x, use_running_average=use_ra
+                )
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes)(x)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock)

@@ -1,0 +1,5 @@
+"""TPU compute kernels: XLA-fused ops and Pallas kernels for the hot paths."""
+
+from tpuflow.ops.attention import attention, xla_attention
+
+__all__ = ["attention", "xla_attention"]

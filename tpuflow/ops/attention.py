@@ -1,0 +1,55 @@
+"""Attention implementations with one call signature, selectable per model.
+
+``attention(q, k, v, *, causal, impl)`` with q/k/v shaped (B, T, H, D).
+Implementations:
+
+- ``"xla"``   — plain einsum softmax attention; XLA fuses it well for short
+  sequences and it runs on any backend (the CPU test mesh included).
+- ``"flash"`` — Pallas TPU blockwise (flash) attention kernel, O(T) memory
+  (tpuflow.ops.flash_attention).
+- ``"ring"``  — ring attention over the 'seq' mesh axis for long-context
+  sequence parallelism (tpuflow.parallel.ring_attention): KV blocks rotate
+  around the ring via collective-permute while each shard computes blockwise
+  attention — the TPU-native long-context strategy (absent from the reference,
+  which has no attention at all; SURVEY.md §5 long-context).
+
+The reference has no attention op anywhere (its model is an image MLP,
+my_ray_module.py:94-112); these exist for the GPT-2 acceptance config and the
+framework's first-class long-context support.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_attention(q, k, v, *, causal: bool = True):
+    """Reference einsum attention. q,k,v: (B, T, H, D) → (B, T, H, D).
+
+    Softmax statistics in float32 regardless of input dtype (bf16-safe on the
+    MXU: the matmuls stay bf16, the normalization doesn't lose precision).
+    """
+    B, T, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(D).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(q, k, v, *, causal: bool = True, impl: str = "xla"):
+    """Dispatch to the selected implementation (see module docstring)."""
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal)
+    if impl == "flash":
+        from tpuflow.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        from tpuflow.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=causal)
+    raise KeyError(f"unknown attention impl {impl!r}; use xla|flash|ring")

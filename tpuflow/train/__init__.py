@@ -1,0 +1,15 @@
+"""Distributed training runtime (Trainer, configs, context, Result)."""
+
+from tpuflow.train.step import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_eval_step",
+    "make_train_step",
+]

@@ -1,0 +1,135 @@
+"""Jitted train/eval steps — the innermost hot loop.
+
+TPU-native replacement for the reference's per-minibatch loop
+(my_ray_module.py:153-175): one compiled ``train_step(state, batch, rng)``
+where the data-parallel gradient all-reduce is emitted by GSPMD over ICI
+(because the batch is sharded on the 'data' mesh axis while params are
+replicated or FSDP-sharded) — there is no DDP wrapper and no explicit
+collective call, matching the reference's encapsulation of NCCL behind
+``prepare_model`` (my_ray_module.py:135).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax
+import jax
+import jax.numpy as jnp
+from flax.training import train_state
+
+from tpuflow.models.losses import accuracy, cross_entropy_loss
+
+
+class TrainState(train_state.TrainState):
+    """Flax TrainState: {step, params, opt_state} pytree + static apply_fn/tx.
+
+    The pytree leaves are exactly the checkpoint payload of the reference
+    ({epoch, model_state_dict, optimizer_state_dict}, my_ray_module.py:183-185)
+    plus the step counter. ``batch_stats`` carries BatchNorm running statistics
+    for models that have them (ResNets); it is an empty dict otherwise, and
+    like torch DDP the statistics are per-replica (not cross-replica synced).
+    """
+
+    batch_stats: Any = flax.struct.field(default_factory=dict)
+
+
+def create_train_state(model, rng, sample_input, tx) -> TrainState:
+    """Initialize params and optimizer state (reference my_ray_module.py:131,
+    141-142: NeuralNetwork() + SGD(lr, momentum=0.9))."""
+    variables = model.init(rng, sample_input, train=False)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        tx=tx,
+    )
+
+
+def _variables(state: TrainState, params):
+    v = {"params": params}
+    if state.batch_stats:
+        v["batch_stats"] = state.batch_stats
+    return v
+
+
+def per_worker_batch_size(global_batch_size: int, num_workers: int) -> int:
+    """Per-shard batch = global // num_workers, floor division exactly as the
+    reference computes it (my_ray_module.py:230)."""
+    per = global_batch_size // num_workers
+    if per < 1:
+        raise ValueError(
+            f"global batch {global_batch_size} too small for {num_workers} workers"
+        )
+    return per
+
+
+def make_train_step(
+    loss_fn: Callable = cross_entropy_loss,
+    *,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted SPMD train step.
+
+    The returned ``fn(state, batch, rng) -> (state, metrics)`` is traced once
+    and compiled by XLA (static shapes; the Python epoch loop only feeds
+    sharded batches, SURVEY.md §3.5). ``rng`` is folded with ``state.step`` so
+    dropout masks differ per step while the traced function stays pure.
+    """
+
+    def train_step(state: TrainState, batch, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+        has_stats = bool(state.batch_stats)
+
+        def compute_loss(params):
+            out = state.apply_fn(
+                _variables(state, params),
+                batch["x"],
+                train=True,
+                rngs={"dropout": dropout_rng},
+                mutable=["batch_stats"] if has_stats else False,
+            )
+            logits, updates = out if has_stats else (out, {})
+            return loss_fn(logits, batch["y"]), (logits, updates)
+
+        (loss, (logits, updates)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        if has_stats:
+            new_state = new_state.replace(batch_stats=updates["batch_stats"])
+        metrics = {"loss": loss, "accuracy": accuracy(logits, batch["y"])}
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
+    """Build the jitted eval step for the full validation pass
+    (reference my_ray_module.py:162-175).
+
+    Returns per-batch ``{loss_sum, num_correct, count}`` so the caller can
+    accumulate across fixed-shape batches, honoring a ``mask`` entry (1 for
+    real rows, 0 for tail padding — SURVEY.md §7 hard-part 5: XLA needs
+    static shapes, so ragged tails are padded and masked out).
+    """
+
+    def eval_step(state: TrainState, batch):
+        logits = state.apply_fn(
+            _variables(state, state.params), batch["x"], train=False
+        )
+        labels = batch["y"]
+        per_row = -jnp.take_along_axis(
+            jax.nn.log_softmax(logits), labels[..., None], axis=-1
+        )[..., 0]
+        correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(labels.shape, jnp.float32)
+        return {
+            "loss_sum": jnp.sum(per_row * mask),
+            "num_correct": jnp.sum(correct * mask),
+            "count": jnp.sum(mask),
+        }
+
+    return jax.jit(eval_step)
