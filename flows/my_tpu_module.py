@@ -1,0 +1,254 @@
+"""Parity workload module: FashionMNIST training + batch prediction on TPU.
+
+TPU-native counterpart of the reference's ``my_ray_module.py`` — same
+capabilities, SPMD architecture:
+
+- ``train_fashion_mnist``       ↔ my_ray_module.py:216-251 (trainer driver)
+- ``train_func_per_worker``     ↔ my_ray_module.py:115-213 (per-worker loop);
+  runs once per host, devices are the workers, XLA emits the grad all-reduce
+- ``set_weights_from_checkpoint`` ↔ my_ray_module.py:253-264 (weights-only
+  warm start; optimizer state intentionally not restored — §3.2 parity; pass
+  resume="full" for the corrected full-state resume)
+- ``TpuPredictor``              ↔ my_ray_module.py:266-284 (stateful batch
+  predictor)
+- ``get_dataloaders``           ↔ my_ray_module.py:30-76 (re-exported from
+  tpuflow.data with identical modes)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpuflow import dist  # noqa: E402
+from tpuflow.ckpt import Checkpoint, restore_from_handle  # noqa: E402
+from tpuflow.data import get_dataloaders, get_labels_map  # noqa: E402
+from tpuflow.infer import BatchPredictor, map_batches  # noqa: E402
+from tpuflow.models import NeuralNetwork  # noqa: E402
+from tpuflow.train import (  # noqa: E402
+    CheckpointConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    Trainer,
+    create_train_state,
+    get_context,
+    make_eval_step,
+    make_train_step,
+    per_worker_batch_size,
+)
+
+_TAG = "[my_tpu_module]"
+
+
+def _log(msg: str) -> None:
+    print(f"{_TAG} {msg}")  # parity: tagged prints, my_ray_module.py:126,208
+
+
+def _state_tree(state) -> dict:
+    """Checkpoint payload (↔ the torch.save dict, my_ray_module.py:183-186;
+    metrics history rides in checkpoint metadata instead of the payload)."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+    }
+
+
+def set_weights_from_checkpoint(state, checkpoint: Checkpoint):
+    """Warm-start ONLY the model weights from a checkpoint handle
+    (↔ my_ray_module.py:253-264; no ``module.`` prefix strip is needed —
+    params are a pytree, the prefix was a DDP-wrapper artifact)."""
+    params = restore_from_handle(checkpoint, weights_only=True)
+    return state.replace(params=params)
+
+
+def train_func_per_worker(config: dict) -> None:
+    """Per-host training loop (↔ train_func_per_worker,
+    my_ray_module.py:115-213)."""
+    ctx = get_context()
+    lr = config.get("lr", 1e-3)
+    epochs = config.get("epochs", 3)
+    batch_size = config.get("batch_size_per_worker", 8)
+    dataset = config.get("dataset", "fashion_mnist")
+    data_dir = config.get("data_dir")
+
+    world = ctx.get_world_size()
+    rank = ctx.get_world_rank()
+    nproc = jax.process_count()
+    # Per-process slice of the data; within a process, shard_batch spreads
+    # the batch over the local devices of the 'data' mesh axis
+    # (↔ prepare_data_loader rank-sharding, my_ray_module.py:128-129).
+    train_loader, val_loader = get_dataloaders(
+        batch_size * world // nproc,
+        dataset=dataset,
+        data_dir=data_dir,
+        seed=config.get("seed", 0),
+        shard_index=jax.process_index(),
+        num_shards=nproc,
+    )
+    _log(f"dataloaders ready (world={world}, rank={rank})")
+
+    model = NeuralNetwork()
+    tx = optax.sgd(lr, momentum=0.9)  # parity: my_ray_module.py:142
+    state = create_train_state(
+        model, jax.random.PRNGKey(config.get("seed", 0)),
+        np.zeros((1, 28, 28), np.float32), tx,
+    )
+    if config.get("checkpoint") is not None:
+        ckpt = config["checkpoint"]
+        if isinstance(ckpt, dict):
+            ckpt = Checkpoint.from_json(ckpt)
+        if config.get("resume") == "full":
+            # Corrected behavior: restore params + opt state + step.
+            restored = restore_from_handle(ckpt, abstract_state=_state_tree(state))
+            state = state.replace(
+                step=restored["step"],
+                params=restored["params"],
+                opt_state=restored["opt_state"],
+            )
+            _log("full state restored from checkpoint (params+opt+step)")
+        else:
+            state = set_weights_from_checkpoint(state, ckpt)
+            _log("model weights warm-started from checkpoint")
+
+    # Replicate model+opt state over the mesh (↔ DDP replicate/broadcast,
+    # my_ray_module.py:135); normalizes device placement after any restore.
+    state = state.replace(
+        step=dist.replicate(state.step, ctx.mesh),
+        params=dist.replicate(state.params, ctx.mesh),
+        opt_state=dist.replicate(state.opt_state, ctx.mesh),
+    )
+
+    train_step = make_train_step()
+    eval_step = make_eval_step()
+    rng = jax.random.PRNGKey(config.get("seed", 0) + 1)
+
+    start = time.monotonic()
+    for epoch in range(epochs):
+        epoch_start = time.monotonic()
+        if world > 1:
+            # parity: sampler.set_epoch only when world > 1
+            # (my_ray_module.py:149-151)
+            train_loader.set_epoch(epoch)
+        n_batches = 0
+        for batch in train_loader:
+            placed = dist.shard_batch(
+                {"x": batch["x"], "y": batch["y"]}, ctx.mesh
+            )
+            state, train_metrics = train_step(state, placed, rng)
+            n_batches += 1
+        # Block before timing/eval: keeps host and devices in step (and on the
+        # CPU dev platform avoids queueing concurrent collective programs).
+        jax.block_until_ready(state.params)
+
+        loss_sum = correct = count = 0.0
+        for batch in val_loader:
+            placed = dist.shard_batch(batch, ctx.mesh)
+            out = eval_step(state, placed)
+            loss_sum += float(out["loss_sum"])
+            correct += float(out["num_correct"])
+            count += float(out["count"])
+        val_loss = loss_sum / max(count, 1.0)
+        accuracy = correct / max(count, 1.0)
+        _log(
+            f"epoch {epoch}: val_loss={val_loss:.4f} accuracy={accuracy:.4f} "
+            f"({n_batches} train batches, "
+            f"{time.monotonic() - epoch_start:.1f}s)"
+        )
+        # Per-epoch metrics + async sharded checkpoint; retention and
+        # best/latest policies live in the manager
+        # (↔ torch.save ×2 + report, my_ray_module.py:178-205).
+        ctx.report(
+            {"val_loss": val_loss, "accuracy": accuracy},
+            state=_state_tree(state),
+            step=epoch + 1,
+        )
+    _log(f"total training time: {time.monotonic() - start:.1f}s")
+
+
+def train_fashion_mnist(
+    num_workers: int | None = None,
+    use_tpu: bool = True,
+    *,
+    checkpoint_storage_path: str | None = None,
+    global_batch_size: int = 32,
+    lr: float = 1e-3,
+    epochs: int = 3,
+    num_to_keep: int = 2,
+    checkpoint: Checkpoint | dict | None = None,
+    resume: str = "weights",
+    dataset: str = "fashion_mnist",
+    data_dir: str | None = None,
+    seed: int = 0,
+) -> Result:
+    """Trainer driver (↔ train_fashion_mnist, my_ray_module.py:216-251)."""
+    workers = num_workers if num_workers and num_workers > 0 else len(jax.devices())
+    train_config = {
+        "lr": lr,
+        "epochs": epochs,
+        # parity batch math: global // num_workers (my_ray_module.py:230)
+        "batch_size_per_worker": per_worker_batch_size(global_batch_size, workers),
+        "checkpoint": checkpoint,
+        "resume": resume if resume in ("weights", "full") else "weights",
+        "dataset": dataset,
+        "data_dir": data_dir,
+        "seed": seed,
+    }
+    trainer = Trainer(
+        train_func_per_worker,
+        train_loop_config=train_config,
+        scaling_config=ScalingConfig(num_workers=workers, use_tpu=use_tpu),
+        run_config=RunConfig(
+            storage_path=checkpoint_storage_path,
+            checkpoint_config=CheckpointConfig(num_to_keep=num_to_keep),
+            verbose=1,
+        ),
+    )
+    result = trainer.fit()
+    return result
+
+
+class TpuPredictor:
+    """Stateful batch predictor (↔ TorchPredictor, my_ray_module.py:266-284):
+    loads best weights once, then maps batches to logits + argmax."""
+
+    def __init__(self, checkpoint: Checkpoint | dict, cpu_only: bool = False):
+        if isinstance(checkpoint, dict):
+            checkpoint = Checkpoint.from_json(checkpoint)
+        # cpu_only kept for signature parity; device choice belongs to jax.
+        self._predictor = BatchPredictor.from_checkpoint(
+            checkpoint, NeuralNetwork()
+        )
+
+    def __call__(self, batch: dict) -> dict:
+        return self._predictor(batch)
+
+
+__all__ = [
+    "TpuPredictor",
+    "get_dataloaders",
+    "get_labels_map",
+    "map_batches",
+    "set_weights_from_checkpoint",
+    "train_fashion_mnist",
+    "train_func_per_worker",
+]
+
+
+if __name__ == "__main__":
+    # Standalone harness (↔ my_ray_module.py:287-288): run the trainer outside
+    # any flow, all local devices.
+    res = train_fashion_mnist(
+        num_workers=None,
+        checkpoint_storage_path=os.environ.get("TPUFLOW_STORAGE", "/tmp/tpuflow_run"),
+        epochs=int(os.environ.get("EPOCHS", "3")),
+    )
+    print(res.to_json())

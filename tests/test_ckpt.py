@@ -1,0 +1,151 @@
+"""Checkpoint subsystem tests: round-trip, best/latest policies, retention,
+weights-only parity restore, and restore-across-topologies (SURVEY.md §4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuflow import dist
+from tpuflow.ckpt import Checkpoint, CheckpointManager, restore_from_handle
+from tpuflow.models import NeuralNetwork
+from tpuflow.train import create_train_state
+
+
+def _state(seed=0):
+    model = NeuralNetwork(hidden_dim=32)
+    return create_train_state(
+        model,
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, 28, 28)),
+        optax.sgd(1e-3, momentum=0.9),
+    )
+
+
+def _tree(state):
+    """Checkpoint payload: the parity dict {step, params, opt_state}
+    (↔ my_ray_module.py:183-185)."""
+    return {"step": state.step, "params": state.params, "opt_state": state.opt_state}
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, _tree(state), metrics={"val_loss": 0.5, "accuracy": 0.8})
+    restored = mgr.restore(1)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(_tree(state)),
+        jax.tree_util.tree_leaves(restored),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_best_latest_policies_and_retention(tmp_path):
+    """val_loss sequence 0.9, 0.4, 0.7, 0.6 with max_to_keep=2:
+    latest=4, best=2, and step 2 survives retention (kept in addition to the
+    newest two) — the reference keeps best reachable by duplicating files
+    (my_ray_module.py:190-201); here it's a retention policy."""
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, async_save=False)
+    for step, vl in [(1, 0.9), (2, 0.4), (3, 0.7), (4, 0.6)]:
+        mgr.save(step, _tree(state), metrics={"val_loss": vl})
+    assert mgr.latest_step() == 4
+    assert mgr.best_step() == 2
+    assert mgr.all_steps() == [2, 3, 4]  # 1 pruned; best 2 retained
+    meta = mgr.restore_metadata(best=True)
+    assert meta["metrics"]["val_loss"] == 0.4
+    # Metrics history rides in metadata (↔ val_losses list in the payload,
+    # my_ray_module.py:185-186).
+    assert [m["val_loss"] for m in meta["metrics_history"]] == [0.9, 0.4]
+    mgr.close()
+
+
+def test_history_rebuilt_on_reopen(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, _tree(state), metrics={"val_loss": 0.9})
+    mgr.save(2, _tree(state), metrics={"val_loss": 0.2})
+    mgr.close()
+    mgr2 = CheckpointManager(str(tmp_path), async_save=False)
+    assert mgr2.latest_step() == 2
+    assert mgr2.best_step() == 2
+    mgr2.save(3, _tree(state), metrics={"val_loss": 0.5})
+    assert mgr2.best_step() == 2
+    mgr2.close()
+
+
+def test_weights_only_restore_parity(tmp_path):
+    """Handle-level weights-only restore: params come back; the caller's
+    optimizer state stays fresh (↔ set_weights_from_checkpoint semantics,
+    my_ray_module.py:253-264 + §3.2 note)."""
+    state = _state(seed=1)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    ckpt = mgr.save(1, _tree(state), metrics={"val_loss": 0.1})
+    mgr.close()
+    handle = Checkpoint.from_json(ckpt.to_json())
+    params = restore_from_handle(handle, weights_only=True)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_save_completes(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(1, _tree(state), metrics={"val_loss": 1.0})
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1]
+    restored = mgr.restore(1)
+    assert int(np.asarray(restored["step"])) == 0
+    mgr.close()
+
+
+def test_restore_across_topologies(tmp_path, mesh8):
+    """A checkpoint whose arrays were sharded over 8 devices restores onto a
+    4-device mesh with a different layout — the resharding property the
+    north-star metric presumes (SURVEY.md §5 checkpoint/resume)."""
+    big = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+    sharded = jax.device_put(big, dist.batch_sharding(mesh8))
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, {"w": sharded}, metrics={"val_loss": 0.3})
+
+    mesh4 = dist.make_mesh({"data": 2, "tensor": 2}, devices=jax.devices()[:4])
+    target = jax.ShapeDtypeStruct(
+        (64, 16),
+        jnp.float32,
+        sharding=jax.sharding.NamedSharding(
+            mesh4, jax.sharding.PartitionSpec("data", "tensor")
+        ),
+    )
+    restored = mgr.restore(1, abstract_state={"w": target})
+    assert restored["w"].sharding.mesh.shape["tensor"] == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]), big)
+    mgr.close()
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+    with pytest.raises(FileNotFoundError):
+        mgr.checkpoint(best=True)
+    mgr.close()
+    with pytest.raises(FileNotFoundError):
+        Checkpoint.from_directory(str(tmp_path / "nope"))
+
+
+def test_handle_json_roundtrip(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    ckpt = mgr.save(7, _tree(state), metrics={"val_loss": 0.7})
+    mgr.close()
+    obj = ckpt.to_json()
+    assert isinstance(obj["path"], str) and obj["metadata"]["step"] == 7
+    again = Checkpoint.from_json(obj)
+    with again.as_directory() as d:
+        assert os.path.isdir(os.path.join(d, "state"))

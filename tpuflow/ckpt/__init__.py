@@ -1,0 +1,11 @@
+"""Checkpoint subsystem: async sharded save/restore with policies.
+
+Replaces the reference's torch.save + Ray Checkpoint + CheckpointConfig stack
+(my_ray_module.py:178-205,236-238,253-264) with Orbax-backed sharded
+checkpointing — see tpuflow.ckpt.manager for the full capability map.
+"""
+
+from tpuflow.ckpt.handle import Checkpoint
+from tpuflow.ckpt.manager import CheckpointManager, restore_from_handle
+
+__all__ = ["Checkpoint", "CheckpointManager", "restore_from_handle"]

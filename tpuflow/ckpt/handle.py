@@ -1,0 +1,55 @@
+"""Checkpoint handle: a path + metadata, never pickled tensors.
+
+Parity with Ray's ``Checkpoint`` object as the reference uses it
+(my_ray_module.py:202 ``Checkpoint.from_directory``, my_ray_module.py:254
+``as_directory``; flow artifact handoff at train_flow.py:71-73,
+eval_flow.py:42-49): the handle that crosses runs/flows is a *reference* to
+checkpoint storage, not the bytes — the flow runner persists it as JSON, so a
+checkpoint written by one topology can be restored (resharded) by another
+(SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+from typing import Any, Iterator
+
+
+@dataclasses.dataclass
+class Checkpoint:
+    """Reference to a checkpoint directory written by CheckpointManager."""
+
+    path: str
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_directory(cls, path: str, metadata: dict | None = None) -> "Checkpoint":
+        """Wrap an existing checkpoint directory (↔ Checkpoint.from_directory,
+        my_ray_module.py:202)."""
+        path = os.path.abspath(path)
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"checkpoint directory not found: {path}")
+        meta_path = os.path.join(path, "metadata.json")
+        if metadata is None and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                metadata = json.load(f)
+        return cls(path=path, metadata=metadata or {})
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Yield a local directory with the checkpoint contents
+        (↔ checkpoint.as_directory(), my_ray_module.py:254). Storage here is a
+        filesystem path already, so no materialization copy is needed."""
+        if not os.path.isdir(self.path):
+            raise FileNotFoundError(f"checkpoint directory gone: {self.path}")
+        yield self.path
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "metadata": self.metadata}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Checkpoint":
+        return cls(path=obj["path"], metadata=obj.get("metadata", {}))

@@ -1,0 +1,280 @@
+"""Async sharded checkpoint manager with best/latest policies and retention.
+
+The TPU-native replacement for the reference checkpoint subsystem
+(my_ray_module.py:178-205,236-238,253-264):
+
+- per-epoch ``torch.save`` of ``{epoch, model_state_dict,
+  optimizer_state_dict, val_losses, val_accuracy}``  →  async sharded Orbax
+  save of the TrainState pytree (each host writes its shards; tensorstore
+  OCDBT under the hood) plus a JSON metadata sidecar carrying the metrics
+  history;
+- duplicate ``latest_model.pt`` / ``best_model.pt`` files
+  (my_ray_module.py:27-28,190-201)  →  *policies*: ``latest_step()`` /
+  ``best_step()`` computed from recorded metrics — no duplicate bytes;
+- ``CheckpointConfig(num_to_keep=2)`` retention (my_ray_module.py:222,236)
+  →  retain the newest ``max_to_keep`` steps **plus** the best step (the
+  reference keeps best reachable by writing it into every checkpoint dir);
+- restore (my_ray_module.py:253-264: load best, strip the DDP ``module.``
+  prefix, weights only)  →  ``restore(weights_only=True, best=True)``; the
+  prefix-strip has no equivalent because params are a pytree, not
+  name-mangled — the normalization the reference needs is a wrapper artifact;
+- topology change: restore takes an abstract state (shapes + shardings) so a
+  checkpoint written on one mesh restores, resharded, on another — the
+  property the ≥2 GB/s/chip north-star metric presumes (SURVEY.md §5).
+
+Save is asynchronous: training continues while hosts flush shards; ``save``
+only blocks to drain a still-running *previous* save (double-buffering, the
+same overlap Orbax's own manager provides).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from tpuflow.ckpt.handle import Checkpoint
+
+_STATE_DIR = "state"
+_META_FILE = "metadata.json"
+_STEP_PREFIX = "step_"
+
+
+def _abstractify(tree):
+    """Pytree of arrays/scalars/ShapeDtypeStructs → pytree of
+    ShapeDtypeStructs (shardings preserved where present), tolerant of
+    non-array leaves like a Python-int step counter."""
+    import numpy as np
+
+    def one(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+        arr = np.asarray(x)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+class CheckpointManager:
+    """Manage per-step checkpoints under one directory.
+
+    Layout::
+
+        directory/
+          step_3/
+            state/          # Orbax OCDBT pytree (sharded arrays)
+            metadata.json   # step, metrics, metrics_history, mesh info
+          step_4/ ...
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int | None = 2,
+        best_metric: str = "val_loss",
+        best_mode: str = "min",
+        async_save: bool = True,
+    ):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.best_metric = best_metric
+        self.best_mode = best_mode
+        self._async = async_save
+        self._ckptr = ocp.StandardCheckpointer()
+        self._metrics_history: list[dict[str, Any]] = []
+        # Rebuild history from existing steps (in-run resume after retry).
+        for step in self.all_steps():
+            meta = self._read_meta(step)
+            if meta and "metrics" in meta:
+                self._metrics_history.append({"step": step, **meta["metrics"]})
+
+    # ------------------------------------------------------------------ paths
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    def _read_meta(self, step: int) -> dict | None:
+        try:
+            with open(os.path.join(self._step_dir(step), _META_FILE)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        try:
+            entries = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in entries:
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    step = int(name[len(_STEP_PREFIX) :])
+                except ValueError:
+                    continue
+                # Only completed saves count (state committed + metadata).
+                if os.path.exists(os.path.join(self.directory, name, _META_FILE)):
+                    steps.append(step)
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def best_step(self) -> int | None:
+        """Step with the best recorded ``best_metric`` (↔ best_model.pt
+        selection by val-loss improvement, my_ray_module.py:190-201)."""
+        best: tuple[float, int] | None = None
+        sign = 1.0 if self.best_mode == "min" else -1.0
+        for step in self.all_steps():
+            meta = self._read_meta(step)
+            if not meta:
+                continue
+            value = meta.get("metrics", {}).get(self.best_metric)
+            if value is None:
+                continue
+            key = (sign * float(value), step)
+            if best is None or key < best:
+                best = key
+        return best[1] if best else None
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, state, metrics: dict | None = None) -> Checkpoint:
+        """Asynchronously save ``state`` (a pytree) for ``step`` with metrics.
+
+        ↔ the reference's per-epoch torch.save + report(metrics, checkpoint)
+        (my_ray_module.py:178-205). Blocks only if the previous async save is
+        still in flight.
+        """
+        self._ckptr.wait_until_finished()
+        step_dir = self._step_dir(step)
+        state_dir = os.path.join(step_dir, _STATE_DIR)
+        if os.path.exists(state_dir):
+            shutil.rmtree(state_dir)  # overwrite a retried step cleanly
+        os.makedirs(step_dir, exist_ok=True)
+        self._ckptr.save(state_dir, state)
+        if not self._async:
+            self._ckptr.wait_until_finished()
+        metrics = {k: float(v) for k, v in (metrics or {}).items()}
+        self._metrics_history.append({"step": step, **metrics})
+        meta = {
+            "step": step,
+            "metrics": metrics,
+            "metrics_history": self._metrics_history,
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+        }
+        if jax.process_index() == 0:
+            with open(os.path.join(step_dir, _META_FILE), "w") as f:
+                json.dump(meta, f)
+        self._retain()
+        return Checkpoint(path=step_dir, metadata=meta)
+
+    def _retain(self) -> None:
+        """Keep the newest ``max_to_keep`` steps plus the best step."""
+        if self.max_to_keep is None or jax.process_index() != 0:
+            return
+        steps = self.all_steps()
+        keep = set(steps[-self.max_to_keep :]) if self.max_to_keep else set()
+        best = self.best_step()
+        if best is not None:
+            keep.add(best)
+        doomed = [s for s in steps if s not in keep]
+        if doomed:
+            # Never delete a dir whose async save may still be writing: saves
+            # are serialized by the wait in save(), and metadata.json is only
+            # written after the save call returns, so completed steps are safe
+            # except possibly the newest — which is always in `keep`.
+            for s in doomed:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def wait_until_finished(self) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self._ckptr.wait_until_finished()
+        self._ckptr.close()
+
+    # --------------------------------------------------------------- restore
+    def _resolve_step(self, step: int | None, best: bool) -> int:
+        chosen = (
+            self.best_step() if best else self.latest_step()
+        ) if step is None else step
+        if chosen is None or not os.path.isdir(self._step_dir(chosen)):
+            raise FileNotFoundError(
+                f"no checkpoint {'(best)' if best else ''} found in {self.directory}"
+            )
+        return chosen
+
+    def restore(
+        self,
+        step: int | None = None,
+        *,
+        abstract_state=None,
+        best: bool = False,
+    ):
+        """Restore the full pytree for ``step`` (default: latest; ``best=True``
+        picks by metric — the reference restores *best*, my_ray_module.py:255).
+
+        ``abstract_state``: a pytree of ``jax.ShapeDtypeStruct`` (with
+        shardings) or a template pytree of arrays. With shardings attached,
+        Orbax places/reshards shards directly onto the current mesh — this is
+        how a v5e-32-written checkpoint restores on v5e-16.
+        """
+        chosen = self._resolve_step(step, best)
+        state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
+        if abstract_state is not None:
+            return self._ckptr.restore(state_dir, _abstractify(abstract_state))
+        return self._ckptr.restore(state_dir)
+
+    def restore_metadata(self, step: int | None = None, *, best: bool = False) -> dict:
+        chosen = self._resolve_step(step, best)
+        meta = self._read_meta(chosen)
+        if meta is None:
+            raise FileNotFoundError(f"no metadata for step {chosen}")
+        return meta
+
+    def checkpoint(self, step: int | None = None, *, best: bool = False) -> Checkpoint:
+        """A flow-level handle to a saved step (path + metadata, no tensors)."""
+        chosen = self._resolve_step(step, best)
+        return Checkpoint(
+            path=self._step_dir(chosen), metadata=self._read_meta(chosen) or {}
+        )
+
+
+def restore_from_handle(
+    checkpoint: Checkpoint,
+    *,
+    abstract_state=None,
+    weights_only: bool = False,
+):
+    """Restore state from a flow-level ``Checkpoint`` handle.
+
+    ``weights_only=True`` is the parity semantic of the reference's
+    ``set_weights_from_checkpoint`` (my_ray_module.py:253-264): only model
+    params are returned — optimizer state and step are saved but deliberately
+    not restored (§3.2 note) — while ``False`` gives the full-state resume the
+    reference lacks.
+    """
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        with checkpoint.as_directory() as path:
+            state_dir = os.path.join(path, _STATE_DIR)
+            if abstract_state is not None:
+                restored = ckptr.restore(state_dir, _abstractify(abstract_state))
+            else:
+                restored = ckptr.restore(state_dir)
+    finally:
+        ckptr.close()
+    if weights_only:
+        return restored["params"] if "params" in restored else restored
+    return restored

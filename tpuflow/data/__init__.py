@@ -1,0 +1,18 @@
+"""Data layer: dataset registry, per-host sharding, seeded reshuffle."""
+
+from tpuflow.data.datasets import (
+    Dataset,
+    Split,
+    get_labels_map,
+    load_dataset,
+)
+from tpuflow.data.loader import ShardedLoader, get_dataloaders
+
+__all__ = [
+    "Dataset",
+    "ShardedLoader",
+    "Split",
+    "get_dataloaders",
+    "get_labels_map",
+    "load_dataset",
+]

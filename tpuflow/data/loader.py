@@ -1,0 +1,150 @@
+"""Per-host sharded batch loader with seeded per-epoch reshuffle.
+
+TPU-native replacement for ``DataLoader`` + ``DistributedSampler`` as wrapped
+by ``ray.train.torch.prepare_data_loader`` (reference my_ray_module.py:70-76,
+128-129): each data-parallel shard sees 1/world of the data, the per-epoch
+reshuffle is a permutation seeded by (seed, epoch) — the ``set_epoch``
+semantics of my_ray_module.py:149-151 — and train batches are fixed-shape
+(drop_last) so the jitted step never recompiles. Validation keeps the ragged
+tail by padding + masking (consumed by make_eval_step's ``mask``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from tpuflow.data.datasets import Split
+
+
+@dataclasses.dataclass
+class ShardedLoader:
+    """Iterate fixed-shape batches of one shard of a Split.
+
+    ``num_shards``/``shard_index`` default to a single shard; the trainer sets
+    them to (data-parallel world, this worker's rank). When the shard sizes
+    are uneven the permutation is wrap-padded so every shard sees the same
+    number of batches — the same trick DistributedSampler uses, which keeps
+    the collective-running gang in lockstep.
+    """
+
+    split: Split
+    batch_size: int
+    shuffle: bool = False
+    seed: int = 0
+    shard_index: int = 0
+    num_shards: int = 1
+    drop_last: bool = True
+    pad_tail: bool = False  # emit a final padded+masked batch (eval mode)
+
+    def __post_init__(self):
+        if not 0 <= self.shard_index < self.num_shards:
+            raise ValueError(
+                f"shard_index {self.shard_index} out of range for "
+                f"{self.num_shards} shards"
+            )
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle for a new epoch (parity: sampler.set_epoch,
+        reference my_ray_module.py:149-151)."""
+        self._epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.split)
+        if self.shuffle:
+            order = np.random.default_rng(
+                (self.seed, self._epoch)
+            ).permutation(n)
+        else:
+            order = np.arange(n)
+        if self.num_shards > 1:
+            per = -(-n // self.num_shards)  # ceil
+            padded = np.concatenate([order, order[: per * self.num_shards - n]])
+            order = padded[self.shard_index :: self.num_shards]
+        return order
+
+    def __len__(self) -> int:
+        n = len(self._indices())
+        if self.drop_last and not self.pad_tail:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[dict]:
+        order = self._indices()
+        bs = self.batch_size
+        n_full = len(order) // bs
+        for b in range(n_full):
+            idx = order[b * bs : (b + 1) * bs]
+            yield {
+                "x": self.split.images[idx],
+                "y": self.split.labels[idx],
+                "mask": np.ones(bs, np.float32),
+            }
+        tail = len(order) - n_full * bs
+        if tail and self.pad_tail:
+            idx = order[n_full * bs :]
+            pad = bs - tail
+            pad_idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
+            mask = np.concatenate(
+                [np.ones(tail, np.float32), np.zeros(pad, np.float32)]
+            )
+            yield {
+                "x": self.split.images[pad_idx],
+                "y": self.split.labels[pad_idx],
+                "mask": mask,
+            }
+        elif tail and not self.drop_last:
+            idx = order[n_full * bs :]
+            yield {
+                "x": self.split.images[idx],
+                "y": self.split.labels[idx],
+                "mask": np.ones(tail, np.float32),
+            }
+
+
+def get_dataloaders(
+    batch_size: int,
+    *,
+    dataset: str = "fashion_mnist",
+    val_only: bool = False,
+    as_rows: bool = False,
+    data_dir: str | None = None,
+    seed: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+):
+    """Parity entry point for the reference's ``get_dataloaders(batch_size,
+    val_only, as_ray_ds)`` (my_ray_module.py:30-76): returns (train, val)
+    ShardedLoaders, a val-only loader, or — with ``as_rows=True`` — the eval
+    split as a list of ``{"features", "labels"}`` rows, matching the
+    ``ray.data.from_items`` mode consumed by the batch-inference engine
+    (my_ray_module.py:32-36,50)."""
+    from tpuflow.data.datasets import load_dataset
+
+    ds = load_dataset(dataset, data_dir=data_dir)
+    if as_rows:
+        return [
+            {"features": ds.test.images[i], "labels": int(ds.test.labels[i])}
+            for i in range(len(ds.test))
+        ]
+    val = ShardedLoader(
+        ds.test,
+        batch_size,
+        shuffle=False,  # parity: val loader unshuffled (my_ray_module.py:74)
+        pad_tail=True,
+        drop_last=False,
+    )
+    if val_only:
+        return val
+    train = ShardedLoader(
+        ds.train,
+        batch_size,
+        shuffle=True,  # parity: train loader shuffled (my_ray_module.py:73)
+        seed=seed,
+        shard_index=shard_index,
+        num_shards=num_shards,
+    )
+    return train, val

@@ -21,6 +21,7 @@ from tpuflow.dist.mesh import (
     make_mesh,
     process_count,
     process_index,
+    replicate,
     replicated,
     shard_batch,
     shutdown,
@@ -39,6 +40,7 @@ __all__ = [
     "make_mesh",
     "process_count",
     "process_index",
+    "replicate",
     "replicated",
     "shard_batch",
     "shutdown",

@@ -234,6 +234,13 @@ def shard_batch(batch, mesh: Mesh):
     return jax.tree_util.tree_map(_put, batch)
 
 
+def replicate(tree, mesh: Mesh):
+    """Place a pytree fully-replicated on the mesh (parity: DDP's replicated
+    params + rank-0 broadcast at wrap time, reference my_ray_module.py:135).
+    Also normalizes mixed/committed device placements after a restore."""
+    return jax.device_put(tree, replicated(mesh))
+
+
 def barrier(name: str = "tpuflow") -> None:
     """Block until all processes reach this point (parity: the collective
     behavior of ray.train.report, reference my_ray_module.py:203-205)."""

@@ -1,0 +1,5 @@
+"""Batch inference engine (replaces Ray Data map_batches actor inference)."""
+
+from tpuflow.infer.engine import BatchPredictor, map_batches
+
+__all__ = ["BatchPredictor", "map_batches"]

@@ -1,0 +1,98 @@
+"""Batched inference over row datasets with a stateful jitted predictor.
+
+Replaces the reference's Ray Data pipeline (eval_flow.py:78-91 +
+my_ray_module.py:266-284): ``ray.data.from_items(rows).map_batches(
+TorchPredictor(checkpoint), batch_size=512, concurrency=1, num_gpus=1)`` —
+a stateful actor that loads weights once, then streams batches through
+``inference_mode`` forward + argmax.
+
+TPU shape: ``BatchPredictor`` loads weights once (from a flow Checkpoint
+handle) and jits the forward; ``map_batches`` feeds fixed-size batches —
+padding the ragged tail and trimming after — so XLA compiles exactly one
+program (SURVEY.md §7 hard-part 5); the batch is sharded over the mesh's
+data axis, which is the actor-pool parallelism of the original expressed as
+SPMD. Returns per-row dicts, so downstream assembly (the eval flow's
+dataframe join, eval_flow.py:91) is index-aligned with the input rows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from tpuflow import dist
+from tpuflow.ckpt import Checkpoint, restore_from_handle
+
+
+class BatchPredictor:
+    """Stateful predictor: weights loaded once, jitted forward per batch.
+
+    ↔ TorchPredictor (my_ray_module.py:266-284): ``__init__`` loads best
+    weights from the checkpoint; ``__call__`` squeezes accidental
+    ``(1,B,...)`` leading dims, runs a no-grad forward, and returns
+    ``{"logits": float32, "predicted_values": argmax}``.
+    """
+
+    def __init__(self, model, params, *, mesh=None):
+        self.model = model
+        self.params = params
+        self.mesh = mesh if mesh is not None else dist.make_mesh()
+        self._forward = jax.jit(
+            lambda params, x: model.apply({"params": params}, x, train=False)
+        )
+
+    @classmethod
+    def from_checkpoint(
+        cls, checkpoint: Checkpoint, model, *, mesh=None
+    ) -> "BatchPredictor":
+        """Load weights once at construction (↔ my_ray_module.py:268-273,
+        which restores best_model.pt in TorchPredictor.__init__)."""
+        params = restore_from_handle(checkpoint, weights_only=True)
+        return cls(model, params, mesh=mesh)
+
+    def __call__(self, batch: dict) -> dict:
+        x = np.asarray(batch["features"])
+        # Squeeze an accidental leading batch-of-batches dim (parity:
+        # my_ray_module.py:276-278 squeezes (1,B,1,28,28)).
+        while x.ndim > 0 and x.shape[0] == 1 and x.ndim > 3:
+            x = x[0]
+        placed = dist.shard_batch({"x": x}, self.mesh)
+        logits = self._forward(self.params, placed["x"])
+        logits = np.asarray(logits, dtype=np.float32)
+        return {
+            "logits": logits,
+            "predicted_values": logits.argmax(axis=-1),
+        }
+
+
+def map_batches(
+    rows: Sequence[dict],
+    predictor: Callable[[dict], dict],
+    *,
+    batch_size: int = 512,
+) -> list[dict]:
+    """Run ``predictor`` over ``rows`` in fixed-size batches; return one output
+    row per input row, in order (↔ ds.map_batches(...).take_all(),
+    eval_flow.py:85-90).
+
+    The final ragged batch is padded up to ``batch_size`` by repeating its
+    last row, then the outputs are trimmed — the jitted forward sees a single
+    static shape.
+    """
+    rows = list(rows)
+    if not rows:
+        return []
+    keys = rows[0].keys()
+    out_rows: list[dict] = []
+    for start in range(0, len(rows), batch_size):
+        chunk = rows[start : start + batch_size]
+        n = len(chunk)
+        if n < batch_size:
+            chunk = chunk + [chunk[-1]] * (batch_size - n)
+        batch = {k: np.stack([np.asarray(r[k]) for r in chunk]) for k in keys}
+        out = predictor(batch)
+        for i in range(n):
+            out_rows.append({k: np.asarray(v)[i] for k, v in out.items()})
+    return out_rows

@@ -5,11 +5,29 @@ from tpuflow.train.step import (
     create_train_state,
     make_eval_step,
     make_train_step,
+    per_worker_batch_size,
+)
+from tpuflow.train.trainer import (
+    CheckpointConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TrainContext,
+    Trainer,
+    get_context,
 )
 
 __all__ = [
+    "CheckpointConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
     "TrainState",
+    "Trainer",
     "create_train_state",
+    "get_context",
     "make_eval_step",
     "make_train_step",
+    "per_worker_batch_size",
 ]

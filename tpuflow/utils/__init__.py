@@ -1,0 +1,5 @@
+"""Shared utilities (file locking, timing, small helpers)."""
+
+from tpuflow.utils.locking import FileLock
+
+__all__ = ["FileLock"]

@@ -1,0 +1,33 @@
+"""Inter-process file lock (fcntl) for shared-filesystem races.
+
+Parity: the reference guards its dataset download with
+``FileLock(os.path.expanduser("~/data.lock"))`` so N co-located workers don't
+concurrently download/extract into the same directory
+(my_ray_module.py:10,41,54). Same pattern here, on fcntl so it needs no
+third-party package.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+
+
+class FileLock:
+    """``with FileLock(path):`` — exclusive advisory lock on ``path``."""
+
+    def __init__(self, path: str):
+        self.path = os.path.expanduser(path)
+        self._fd: int | None = None
+
+    def __enter__(self) -> "FileLock":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fd = os.open(self.path, os.O_CREAT | os.O_RDWR)
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        assert self._fd is not None
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        os.close(self._fd)
+        self._fd = None
