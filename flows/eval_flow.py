@@ -1,0 +1,175 @@
+"""Eval flow: event-triggered batch inference + error-analysis card.
+
+Parity pipeline for the reference's ``eval_flow.py`` (RayTorchEval):
+auto-triggered when TpuTrain finishes (eval_flow.py:19), resolves the
+training checkpoint (trigger → task pathspec → run pathspec → raise,
+eval_flow.py:40-54), runs batched inference over the test set through the
+stateful predictor (eval_flow.py:78-91), and renders a misclassification
+card: count + a table of sampled errors with the input image and a
+horizontal logits bar chart per row (eval_flow.py:96-139).
+
+Run:        python flows/eval_flow.py run --checkpoint-run-pathspec TpuTrain/<id>
+Triggered:  python flows/eval_flow.py run --triggered
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpuflow.flow import (  # noqa: E402
+    FlowSpec,
+    Image,
+    Markdown,
+    Parameter,
+    Run,
+    Table,
+    Task,
+    card,
+    current,
+    device_profile,
+    kubernetes,
+    namespace,
+    step,
+    trigger_on_finish,
+)
+
+N_ERROR_SAMPLES = 50  # ↔ eval_flow.py:17,38
+
+
+@trigger_on_finish(flow="TpuTrain")  # ↔ eval_flow.py:19
+class TpuEval(FlowSpec):
+    """Load the training checkpoint, run distributed batch inference on the
+    test set, and render an error-analysis card."""
+
+    checkpoint_task_pathspec = Parameter(
+        "checkpoint_task_pathspec",
+        default="",
+        help="task pathspec holding the result artifact (Flow/run/step/task)",
+    )
+    checkpoint_run_pathspec = Parameter(
+        "checkpoint_run_pathspec",
+        default="",
+        help="run pathspec holding the result artifact (Flow/run)",
+    )
+    eval_namespace = Parameter(
+        "eval_namespace", default="", help="namespace to read artifacts from"
+    )
+    batch_size = Parameter("batch_size", default=512, help="inference batch size")
+    dataset = Parameter("dataset", default="fashion_mnist", help="dataset name")
+
+    def _get_checkpoint(self):
+        """↔ eval_flow.py:40-54: trigger run first, then explicit pathspecs,
+        else raise."""
+        if current.trigger is not None and current.trigger.run is not None:
+            return current.trigger.run.data.result.best_checkpoint
+        if self.eval_namespace:
+            namespace(self.eval_namespace)  # ↔ eval_flow.py:32-36
+        if self.checkpoint_task_pathspec:
+            return Task(self.checkpoint_task_pathspec).data.result.best_checkpoint
+        if self.checkpoint_run_pathspec:
+            return Run(self.checkpoint_run_pathspec).data.result.best_checkpoint
+        raise ValueError(
+            "no checkpoint source: run with --triggered after a TpuTrain run, "
+            "or pass --checkpoint-run-pathspec / --checkpoint-task-pathspec"
+        )
+
+    @kubernetes(topology=os.environ.get("TPUFLOW_TOPOLOGY", "v5e-8"))
+    @device_profile(interval=1)  # ↔ eval_flow.py:57
+    @card(type="blank")  # ↔ eval_flow.py:56
+    @step
+    def start(self):
+        import numpy as np
+        import pandas as pd
+
+        import my_tpu_module
+
+        checkpoint = self._get_checkpoint()
+        print(f"[eval_flow] evaluating checkpoint {checkpoint.path}")
+
+        # Test set as rows (↔ get_dataloaders(val_only=True, as_ray_ds=True),
+        # eval_flow.py:83) → stateful predictor over fixed batches
+        # (↔ map_batches, eval_flow.py:85-90).
+        rows = my_tpu_module.get_dataloaders(
+            self.batch_size, dataset=self.dataset, as_rows=True
+        )
+        predictor = my_tpu_module.TpuPredictor(checkpoint)
+        outputs = my_tpu_module.map_batches(
+            rows, predictor, batch_size=self.batch_size
+        )
+
+        # Assemble the prediction dataframe (↔ eval_flow.py:91).
+        predictions = pd.DataFrame(
+            {
+                "labels": [r["labels"] for r in rows],
+                "predicted_values": [int(o["predicted_values"]) for o in outputs],
+            }
+        )
+        self.n_rows = len(predictions)
+        mis = predictions[predictions.labels != predictions.predicted_values]
+        self.n_misclassified = int(len(mis))
+        print(
+            f"[eval_flow] {self.n_misclassified}/{self.n_rows} misclassified"
+        )
+
+        # Error-analysis card (↔ eval_flow.py:96-139).
+        labels_map = my_tpu_module.get_labels_map(self.dataset)
+        current.card.append(Markdown("# Error analysis"))
+        current.card.append(
+            Markdown(
+                f"**{self.n_misclassified}** of **{self.n_rows}** test rows "
+                "were misclassified."
+            )
+        )
+        sample = mis.sample(
+            n=min(N_ERROR_SAMPLES, len(mis)), random_state=0
+        ) if len(mis) else mis
+        if len(sample):
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            table_rows = []
+            for idx in sample.index:
+                features = np.asarray(rows[idx]["features"])
+                logits = np.asarray(outputs[idx]["logits"], dtype=np.float32)
+                fig_img, ax = plt.subplots(figsize=(1.6, 1.6))
+                ax.imshow(features.reshape(28, 28), cmap="gray")
+                ax.axis("off")
+                img = Image.from_matplotlib(fig_img)
+                plt.close(fig_img)
+                fig_bar, ax = plt.subplots(figsize=(3.2, 1.6))
+                ax.barh(range(len(logits)), logits)
+                ax.set_yticks(range(len(logits)))
+                ax.set_yticklabels(
+                    [labels_map[i] for i in range(len(logits))], fontsize=5
+                )
+                bar = Image.from_matplotlib(fig_bar)
+                plt.close(fig_bar)
+                table_rows.append(
+                    [
+                        img,
+                        labels_map[int(rows[idx]["labels"])],
+                        labels_map[int(outputs[idx]["predicted_values"])],
+                        bar,
+                    ]
+                )
+            current.card.append(
+                Table(
+                    table_rows,
+                    headers=["input", "true label", "predicted", "logits"],
+                )
+            )
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(
+            f"[eval_flow] done: {self.n_misclassified}/{self.n_rows} misclassified"
+        )
+
+
+if __name__ == "__main__":
+    TpuEval.main()

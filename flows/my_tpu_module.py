@@ -225,7 +225,9 @@ class TpuPredictor:
             checkpoint = Checkpoint.from_json(checkpoint)
         # cpu_only kept for signature parity; device choice belongs to jax.
         self._predictor = BatchPredictor.from_checkpoint(
-            checkpoint, NeuralNetwork()
+            checkpoint,
+            NeuralNetwork(),
+            sample_input=np.zeros((1, 28, 28), np.float32),
         )
 
     def __call__(self, batch: dict) -> dict:

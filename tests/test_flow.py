@@ -1,0 +1,200 @@
+"""Flow runner tests: DAG execution, params, artifacts, retry, client API,
+cards, events/triggers, deployment records (SURVEY.md §4 integration tier)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpuflow.ckpt import Checkpoint
+from tpuflow.flow import (
+    FlowSpec,
+    Markdown,
+    Parameter,
+    Run,
+    Table,
+    Task,
+    card,
+    current,
+    retry,
+    schedule,
+    step,
+    trigger_on_finish,
+)
+from tpuflow.flow import store
+from tpuflow.flow.runner import FlowRunner
+
+
+@pytest.fixture(autouse=True)
+def isolated_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path / "home"))
+    yield tmp_path / "home"
+
+
+@schedule(cron="*/5 * * * *")
+class LinearFlow(FlowSpec):
+    x = Parameter("x", default=3, help="value")
+
+    @step
+    def start(self):
+        self.doubled = self.x * 2
+        self.arr = np.arange(4, dtype=np.float32)
+        self.next(self.middle)
+
+    @retry(times=2)
+    @card()
+    @step
+    def middle(self):
+        cls = type(self)
+        if not getattr(cls, "_failed", False):
+            cls._failed = True
+            raise RuntimeError("transient failure")
+        current.card.append(Markdown("# hello"))
+        current.card.append(Table([[1, 2]], headers=["a", "b"]))
+        self.tripled = self.doubled + self.x
+        self.next(self.end)
+
+    @step
+    def end(self):
+        self.final = self.tripled
+
+
+class NoNextFlow(FlowSpec):
+    @step
+    def start(self):
+        pass  # forgets self.next
+
+    @step
+    def end(self):
+        pass
+
+
+@trigger_on_finish(flow="LinearFlow")
+class DownstreamFlow(FlowSpec):
+    @step
+    def start(self):
+        if current.trigger is not None:
+            self.upstream = current.trigger.run.pathspec
+            self.upstream_final = current.trigger.run.data.final
+        else:
+            self.upstream = None
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_linear_flow_with_retry_artifacts_and_card(isolated_home):
+    LinearFlow._failed = False
+    pathspec = FlowRunner(LinearFlow).run({"x": 5})
+    run = Run(pathspec)
+    assert run.successful
+    assert run.data.doubled == 10
+    assert run.data.final == 15
+    np.testing.assert_array_equal(run.data.arr, np.arange(4, dtype=np.float32))
+    # Retry happened: run metadata recorded, step eventually succeeded.
+    assert run.meta["schedule"] == "*/5 * * * *"
+    # Card rendered with markdown + table.
+    flow, run_id = pathspec.split("/")
+    middle_task = run.meta["steps"][1]["head_task"]
+    card_path = os.path.join(
+        store.task_dir(flow, run_id, "middle", middle_task), "card.html"
+    )
+    html = open(card_path).read()
+    assert "<h1>hello</h1>" in html and "<table" in html
+
+
+def test_step_without_next_fails(isolated_home):
+    with pytest.raises(Exception):
+        FlowRunner(NoNextFlow).run({})
+
+
+def test_retry_exhaustion_marks_run_failed(isolated_home):
+    class AlwaysFails(FlowSpec):
+        @retry(times=1)
+        @step
+        def start(self):
+            raise RuntimeError("boom")
+
+        @step
+        def end(self):
+            pass
+
+    with pytest.raises(RuntimeError):
+        FlowRunner(AlwaysFails).run({})
+    meta = store.read_run_meta("AlwaysFails", 1)
+    assert meta["status"] == "failed" and "boom" in meta["error"]
+
+
+def test_task_client_and_pathspecs(isolated_home):
+    LinearFlow._failed = True  # no transient failure this time
+    pathspec = FlowRunner(LinearFlow).run({"x": 1})
+    run = Run(pathspec)
+    end_task = run["end"]
+    assert end_task.data.final == 3
+    t = Task(end_task.pathspec)
+    assert t.data.final == 3
+    with pytest.raises(KeyError):
+        Run("LinearFlow/9999")
+    with pytest.raises(KeyError):
+        Task("LinearFlow/9999/start/0")
+
+
+def test_trigger_event_handoff(isolated_home):
+    """↔ @trigger_on_finish + current.trigger.run (eval_flow.py:19,42)."""
+    LinearFlow._failed = True
+    up = FlowRunner(LinearFlow).run({"x": 2})
+    events = store.read_events("LinearFlow")
+    assert events and events[-1]["run"] == up and events[-1]["status"] == "success"
+
+    down = FlowRunner(DownstreamFlow).run({}, triggered=True)
+    drun = Run(down)
+    assert drun.data.upstream == up
+    assert drun.data.upstream_final == 6
+    assert drun.meta["triggered_by"] == up
+
+    # Untriggered run sees no trigger context.
+    down2 = FlowRunner(DownstreamFlow).run({})
+    assert Run(down2).data.upstream is None
+
+
+def test_checkpoint_artifact_is_reference_not_pickle(isolated_home, tmp_path):
+    """Checkpoint artifacts persist as JSON references (SURVEY.md §7
+    hard-part 3: path+metadata, never pickled tensors)."""
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+
+    class CkFlow(FlowSpec):
+        @step
+        def start(self):
+            self.ckpt = Checkpoint.from_directory(str(ckdir), {"step": 3})
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    pathspec = FlowRunner(CkFlow).run({})
+    flow, run_id = pathspec.split("/")
+    raw = json.load(
+        open(os.path.join(store.task_dir(flow, run_id, "start", 0), "artifacts.json"))
+    )
+    assert raw["ckpt"]["__type__"] == "checkpoint"
+    restored = Run(pathspec).data.ckpt
+    assert isinstance(restored, Checkpoint) and restored.metadata["step"] == 3
+
+
+def test_deploy_and_params_cli(isolated_home, capsys):
+    from tpuflow.flow.runner import main
+
+    path = main(LinearFlow, ["deploy"])
+    assert json.load(open(path))["schedule"] == "*/5 * * * *"
+    main(LinearFlow, ["show"])
+    out = capsys.readouterr().out
+    assert "--x" in out and "middle [retry×2, card]" in out
+    with pytest.raises(SystemExit):
+        main(LinearFlow, ["run", "--nope", "1"])
+    with pytest.raises(SystemExit):
+        main(LinearFlow, ["run", "--x"])

@@ -1,0 +1,128 @@
+"""Gang-step tests: multi-process jax.distributed world via the flow runner
+(SURVEY.md §4 "multi-process distributed tests without a cluster").
+
+These spawn real subprocesses that rendezvous over localhost with gloo CPU
+collectives — the dev-mode analogue of pod-slice hosts over DCN."""
+
+import os
+import textwrap
+
+import pytest
+
+from tpuflow.flow import store
+from tpuflow.flow.runner import FlowRunner
+
+
+@pytest.fixture(autouse=True)
+def isolated_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path / "home"))
+    monkeypatch.setenv("TPUFLOW_FORCE_CPU", "1")
+    yield tmp_path
+
+
+def _write_flow(tmp_path, body: str) -> str:
+    path = tmp_path / "gangflow.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path.write_text(
+        textwrap.dedent(
+            f"""
+            import sys
+            sys.path.insert(0, {repo!r})
+            from tpuflow.flow import FlowSpec, step, tpu, current
+            """
+        )
+        + textwrap.dedent(body)
+    )
+    return str(path)
+
+
+def _load_flow(path: str, name: str):
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location("gangflow_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["gangflow_test"] = mod
+    spec.loader.exec_module(mod)
+    return getattr(mod, name)
+
+
+@pytest.mark.slow
+def test_gang_psum_and_tolerant_join(tmp_path):
+    flow_path = _write_flow(
+        tmp_path,
+        """
+        class G(FlowSpec):
+            @step
+            def start(self):
+                self.next(self.work, num_parallel=2)
+
+            @tpu(all_hosts_started_timeout=120)
+            @step
+            def work(self):
+                import jax, numpy as np
+                from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+                mesh = Mesh(np.asarray(jax.devices()), ("i",))
+                local = np.asarray([float(jax.process_index() + 1)], np.float32)
+                arr = jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P("i")), local)
+                self.total = float(jax.jit(lambda x: x.sum())(arr))
+                self.world = jax.process_count()
+                self.next(self.done)
+
+            @step
+            def done(self, inputs):
+                vals = []
+                for inp in inputs:
+                    try:
+                        vals.append(inp.total)
+                    except AttributeError:
+                        vals.append(None)
+                self.vals = vals
+                self.next(self.end)
+
+            @step
+            def end(self):
+                pass
+        """,
+    )
+    G = _load_flow(flow_path, "G")
+    pathspec = FlowRunner(G).run({})
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    # Cross-process reduction saw both members (1+2); world formed with 2.
+    assert run.data.total == 3.0
+    assert run.data.world == 2
+    # Join saw the head's artifact and the non-head's absence.
+    assert run.data.vals == [3.0, None]
+
+
+@pytest.mark.slow
+def test_gang_member_failure_fails_step(tmp_path):
+    flow_path = _write_flow(
+        tmp_path,
+        """
+        class F(FlowSpec):
+            @step
+            def start(self):
+                self.next(self.work, num_parallel=2)
+
+            @tpu(all_hosts_started_timeout=60)
+            @step
+            def work(self):
+                import jax
+                if int(__import__("os").environ.get("TPUFLOW_PROCESS_ID", 0)) == 1:
+                    raise RuntimeError("member 1 crashed")
+                self.next(self.end)
+
+            @step
+            def end(self):
+                pass
+        """,
+    )
+    F = _load_flow(flow_path, "F")
+    with pytest.raises(Exception, match="gang step"):
+        FlowRunner(F).run({})
+    meta = store.read_run_meta("F", 1)
+    assert meta["status"] == "failed"

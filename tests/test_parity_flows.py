@@ -1,0 +1,101 @@
+"""End-to-end parity pipeline test: the reference README contract
+(README.md:10-25) — fresh train run → --from-run warm start → triggered eval
+with error card — through the actual flow CLIs."""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def pipeline_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path / "home"))
+    monkeypatch.setenv("TPUFLOW_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "256")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "64")
+    monkeypatch.setenv("TPUFLOW_N_PARALLEL", "1")  # in-process train step
+    flows_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "flows"
+    )
+    monkeypatch.syspath_prepend(flows_dir)
+    # Re-import flow modules so N_PARALLEL picks up the env.
+    for name in ("train_flow", "eval_flow", "my_tpu_module"):
+        sys.modules.pop(name, None)
+    yield tmp_path
+
+
+@pytest.mark.slow
+def test_readme_contract_end_to_end(pipeline_env, capsys):
+    train_flow = importlib.import_module("train_flow")
+    eval_flow = importlib.import_module("eval_flow")
+
+    # 1. Fresh run (↔ `python train_flow.py run`, README.md:10-11).
+    pathspec = train_flow.TpuTrain.main(
+        ["run", "--epochs", "2", "--batch-size", "64", "--learning-rate", "0.05"]
+    )
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    assert run.successful
+    result = run.data.result
+    assert result.checkpoint is not None
+    first_epoch_loss = result.metrics_history[0]["val_loss"]
+
+    # 2. Warm-start resume (↔ `run --from-run RayTorchTrain/<id>`,
+    #    README.md:17-20): first epoch beats the cold start's first epoch.
+    pathspec2 = train_flow.TpuTrain.main(
+        [
+            "run",
+            "--epochs",
+            "1",
+            "--batch-size",
+            "64",
+            "--learning-rate",
+            "0.05",
+            "--from-run",
+            pathspec,
+        ]
+    )
+    result2 = Run(pathspec2).data.result
+    assert result2.metrics_history[0]["val_loss"] < first_epoch_loss
+
+    # 3. Event-triggered eval (↔ @trigger_on_finish + Argo trigger,
+    #    README.md:22-45): consumes the latest successful train run.
+    eval_pathspec = eval_flow.TpuEval.main(
+        ["run", "--triggered", "--batch-size", "64"]
+    )
+    erun = Run(eval_pathspec)
+    assert erun.successful
+    assert erun.meta["triggered_by"] == pathspec2
+    assert erun.data.n_rows == 64
+    assert 0 <= erun.data.n_misclassified < 64
+
+    # Card rendered with images.
+    from tpuflow.flow import store
+
+    eflow, erid = eval_pathspec.split("/")
+    card = open(
+        os.path.join(store.task_dir(eflow, erid, "start", 0), "card.html")
+    ).read()
+    assert "Error analysis" in card
+    if erun.data.n_misclassified:
+        assert "data:image/png" in card
+
+    # 4. Explicit pathspec eval (↔ `--checkpoint-run-pathspec`,
+    #    README.md:24-25).
+    eval_pathspec2 = eval_flow.TpuEval.main(
+        [
+            "run",
+            "--checkpoint-run-pathspec",
+            pathspec,
+            "--batch-size",
+            "64",
+        ]
+    )
+    assert Run(eval_pathspec2).successful
+
+    # 5. No source at all → the parity error (eval_flow.py:50-54).
+    with pytest.raises(ValueError, match="no checkpoint source"):
+        eval_flow.TpuEval.main(["run", "--batch-size", "64"])

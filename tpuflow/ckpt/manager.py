@@ -263,18 +263,38 @@ def restore_from_handle(
     ``set_weights_from_checkpoint`` (my_ray_module.py:253-264): only model
     params are returned — optimizer state and step are saved but deliberately
     not restored (§3.2 note) — while ``False`` gives the full-state resume the
-    reference lacks.
+    reference lacks. With ``weights_only=True``, ``abstract_state`` is the
+    abstract **params** tree (shapes/dtypes/shardings); only that subtree is
+    read from storage (partial restore), which is also what makes a
+    checkpoint written on one topology load onto another here.
     """
-    ckptr = ocp.StandardCheckpointer()
-    try:
-        with checkpoint.as_directory() as path:
-            state_dir = os.path.join(path, _STATE_DIR)
+    with checkpoint.as_directory() as path:
+        state_dir = os.path.join(path, _STATE_DIR)
+        if weights_only and abstract_state is not None:
+            item = {"params": _abstractify(abstract_state)}
+            ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+            try:
+                out = ckptr.restore(
+                    state_dir,
+                    args=ocp.args.PyTreeRestore(
+                        item=item,
+                        restore_args=ocp.checkpoint_utils.construct_restore_args(
+                            item
+                        ),
+                        partial_restore=True,
+                    ),
+                )
+            finally:
+                ckptr.close()
+            return out["params"]
+        ckptr = ocp.StandardCheckpointer()
+        try:
             if abstract_state is not None:
                 restored = ckptr.restore(state_dir, _abstractify(abstract_state))
             else:
                 restored = ckptr.restore(state_dir)
-    finally:
-        ckptr.close()
+        finally:
+            ckptr.close()
     if weights_only:
         return restored["params"] if "params" in restored else restored
     return restored

@@ -1,0 +1,43 @@
+"""Flow orchestration layer: the Metaflow-capability replacement.
+
+Authoring: ``FlowSpec``, ``@step``, ``Parameter``, ``current``; decorators
+``@retry``, ``@tpu`` (gang), ``@kubernetes``, ``@pypi``, ``@card``,
+``@device_profile``, ``@schedule``, ``@trigger_on_finish``; client API
+``Run``/``Task``/``namespace``; card components ``Markdown``/``Table``/
+``Image``. See tpuflow.flow.runner for execution semantics."""
+
+from tpuflow.flow.cards import CardBuffer, Image, Markdown, Table
+from tpuflow.flow.client import Run, Task, namespace
+from tpuflow.flow.decorators import (
+    card,
+    device_profile,
+    kubernetes,
+    pypi,
+    retry,
+    schedule,
+    tpu,
+    trigger_on_finish,
+)
+from tpuflow.flow.spec import FlowSpec, Parameter, current, step
+
+__all__ = [
+    "CardBuffer",
+    "FlowSpec",
+    "Image",
+    "Markdown",
+    "Parameter",
+    "Run",
+    "Table",
+    "Task",
+    "card",
+    "current",
+    "device_profile",
+    "kubernetes",
+    "namespace",
+    "pypi",
+    "retry",
+    "schedule",
+    "step",
+    "tpu",
+    "trigger_on_finish",
+]

@@ -1,0 +1,110 @@
+"""Card components + HTML renderer.
+
+Replaces metaflow.cards as the eval flow uses them (eval_flow.py:15,56,
+96-139): ``Markdown``, ``Table`` (rows of component/str cells), and
+``Image.from_matplotlib``. A step decorated with ``@card`` gets
+``current.card`` — an appendable buffer rendered to ``card.html`` in the task
+directory when the step completes."""
+
+from __future__ import annotations
+
+import base64
+import html
+import io
+from typing import Any, Sequence
+
+
+class Markdown:
+    """Markdown component (headers, bold, inline text — the subset the
+    reference cards use)."""
+
+    def __init__(self, text: str):
+        self.text = text
+
+    def _render(self) -> str:
+        lines = []
+        for line in self.text.split("\n"):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                level = len(stripped) - len(stripped.lstrip("#"))
+                level = min(level, 6)
+                lines.append(
+                    f"<h{level}>{html.escape(stripped[level:].strip())}</h{level}>"
+                )
+            elif stripped:
+                text = html.escape(stripped)
+                # minimal **bold** support
+                while "**" in text:
+                    text = text.replace("**", "<b>", 1).replace("**", "</b>", 1)
+                lines.append(f"<p>{text}</p>")
+        return "\n".join(lines)
+
+
+class Image:
+    """Image component; ``from_matplotlib`` rasterizes a figure to PNG
+    (↔ Image.from_matplotlib, eval_flow.py:124,134)."""
+
+    def __init__(self, png_bytes: bytes):
+        self.png_bytes = png_bytes
+
+    @classmethod
+    def from_matplotlib(cls, fig) -> "Image":
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", bbox_inches="tight")
+        return cls(buf.getvalue())
+
+    def _render(self) -> str:
+        b64 = base64.b64encode(self.png_bytes).decode()
+        return f'<img src="data:image/png;base64,{b64}"/>'
+
+
+class Table:
+    """Table of rows; cells may be components or plain values
+    (↔ Table, eval_flow.py:109,134-139)."""
+
+    def __init__(self, rows: Sequence[Sequence[Any]], headers: Sequence[str] = ()):
+        self.rows = rows
+        self.headers = headers
+
+    def _render(self) -> str:
+        parts = ["<table border='1' cellpadding='4' style='border-collapse:collapse'>"]
+        if self.headers:
+            parts.append(
+                "<tr>"
+                + "".join(f"<th>{html.escape(str(h))}</th>" for h in self.headers)
+                + "</tr>"
+            )
+        for row in self.rows:
+            cells = []
+            for cell in row:
+                if hasattr(cell, "_render"):
+                    cells.append(f"<td>{cell._render()}</td>")
+                else:
+                    cells.append(f"<td>{html.escape(str(cell))}</td>")
+            parts.append("<tr>" + "".join(cells) + "</tr>")
+        parts.append("</table>")
+        return "\n".join(parts)
+
+
+class CardBuffer:
+    """``current.card`` — append components during the step
+    (↔ current.card.append, eval_flow.py:98-100,109)."""
+
+    def __init__(self):
+        self.components: list[Any] = []
+
+    def append(self, component: Any) -> None:
+        self.components.append(component)
+
+    def render_html(self, title: str = "tpuflow card") -> str:
+        body = "\n".join(
+            c._render() if hasattr(c, "_render") else f"<p>{html.escape(str(c))}</p>"
+            for c in self.components
+        )
+        return (
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{font-size:13px}</style></head>"
+            f"<body>{body}</body></html>"
+        )

@@ -1,0 +1,110 @@
+"""Client API: Run / Task handles + namespace.
+
+Replaces the Metaflow client as the reference uses it for cross-run/cross-flow
+checkpoint handoff (train_flow.py:69-73: ``Run(pathspec).data.result``;
+eval_flow.py:45-49: ``Task(pathspec).data.result``; eval_flow.py:32-36
+namespace switching)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from tpuflow.flow import store
+
+_NAMESPACE: str | None = None
+
+
+def namespace(ns: str | None) -> str | None:
+    """↔ metaflow.namespace(...) (eval_flow.py:36): recorded for API parity;
+    the local datastore is single-namespace, so this only tags reads."""
+    global _NAMESPACE
+    _NAMESPACE = ns
+    return ns
+
+
+class _DataNamespace:
+    """Attribute access over a dict of artifacts (↔ run.data.result)."""
+
+    def __init__(self, artifacts: dict[str, Any]):
+        self._artifacts = artifacts
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._artifacts[name]
+        except KeyError:
+            raise AttributeError(f"no artifact {name!r}") from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._artifacts
+
+
+class Task:
+    """Handle to one task: ``Task("Flow/run_id/step/task_id")``
+    (↔ eval_flow.py:45)."""
+
+    def __init__(self, pathspec: str):
+        parts = pathspec.strip("/").split("/")
+        if len(parts) != 4:
+            raise ValueError(
+                f"task pathspec must be Flow/run_id/step/task_id, got {pathspec!r}"
+            )
+        self.flow, self.run_id, self.step, self.task_id = (
+            parts[0],
+            parts[1],
+            parts[2],
+            int(parts[3]),
+        )
+        self.pathspec = pathspec
+        if not os.path.isdir(
+            store.task_dir(self.flow, self.run_id, self.step, self.task_id)
+        ):
+            raise KeyError(f"no such task: {pathspec}")
+
+    @property
+    def data(self) -> _DataNamespace:
+        return _DataNamespace(
+            store.load_artifacts(self.flow, self.run_id, self.step, self.task_id)
+        )
+
+
+class Run:
+    """Handle to one run: ``Run("Flow/run_id")`` (↔ train_flow.py:73,
+    eval_flow.py:48). ``run.data`` merges artifacts along executed-step order,
+    later steps winning — matching the reference's read of end-of-run state."""
+
+    def __init__(self, pathspec: str):
+        parts = pathspec.strip("/").split("/")
+        if len(parts) != 2:
+            raise ValueError(f"run pathspec must be Flow/run_id, got {pathspec!r}")
+        self.flow, self.run_id = parts
+        self.pathspec = pathspec
+        if not os.path.isdir(store.run_dir(self.flow, self.run_id)):
+            raise KeyError(f"no such run: {pathspec}")
+
+    @property
+    def meta(self) -> dict:
+        return store.read_run_meta(self.flow, self.run_id)
+
+    @property
+    def successful(self) -> bool:
+        return self.meta.get("status") == "success"
+
+    @property
+    def data(self) -> _DataNamespace:
+        merged: dict[str, Any] = {}
+        for rec in self.meta.get("steps", []):
+            merged.update(
+                store.load_artifacts(
+                    self.flow, self.run_id, rec["step"], rec["head_task"]
+                )
+            )
+        return _DataNamespace(merged)
+
+    def __getitem__(self, step: str) -> Task:
+        for rec in self.meta.get("steps", []):
+            if rec["step"] == step:
+                return Task(
+                    f"{self.flow}/{self.run_id}/{step}/{rec['head_task']}"
+                )
+        raise KeyError(f"step {step!r} not found in {self.pathspec}")

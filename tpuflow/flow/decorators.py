@@ -1,0 +1,118 @@
+"""Step / flow decorators: retry, gang, card, resources, schedule, triggers.
+
+Replaces the reference's decorator stack (train_flow.py:20,41-52,
+eval_flow.py:15-19,56-68): ``@retry`` (fault tolerance), ``@tpu`` (the
+@metaflow_ray-equivalent gang step: N processes form one jax.distributed gang
+with a formation timeout, and only the head persists artifacts),
+``@kubernetes``/``@pypi``-style resource/env records, ``@card``,
+``@device_profile`` (the @gpu_profile equivalent), ``@schedule`` (cron
+record), and ``@trigger_on_finish`` (event handoff)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def retry(times: int = 3):
+    """Step-level retry (↔ @retry(times=3), train_flow.py:41): a failed step
+    reruns up to ``times`` extra attempts; combined with in-run checkpoint
+    resume this bounds lost work to one epoch (SURVEY.md §5)."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.__retry_times__ = times
+        return fn
+
+    return wrap
+
+
+def tpu(num_parallel: int | None = None, all_hosts_started_timeout: float = 300.0):
+    """Gang step (↔ @metaflow_ray(all_nodes_started_timeout=60*5),
+    train_flow.py:42): the step body runs as a gang of processes forming one
+    ``jax.distributed`` world — process 0 is the head, and only the head's
+    artifacts persist (the join step tolerates headless inputs exactly like
+    train_flow.py:85-88). Locally the gang is simulated with N host processes
+    on CPU devices; on a real pod slice each host runs the same step and the
+    rendezvous happens over DCN."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.__gang__ = {
+            "num_parallel": num_parallel,
+            "timeout": all_hosts_started_timeout,
+        }
+        return fn
+
+    return wrap
+
+
+def kubernetes(**resources):
+    """Resource request record (↔ @kubernetes(gpu=N, compute_pool=...),
+    train_flow.py:43-52). Locally informational; a deployer maps it to pod
+    slice topology (e.g. topology='v5e-16')."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.__resources__ = resources
+        return fn
+
+    return wrap
+
+
+def pypi(**env):
+    """Per-step environment pin record (↔ @pypi(packages={...}),
+    train_flow.py:43-50). This build vendors everything, so it is a record."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.__pypi__ = env
+        return fn
+
+    return wrap
+
+
+def card(type: str = "blank"):
+    """Attach a report card to the step (↔ @card(type="blank"),
+    eval_flow.py:56): the step gets ``current.card`` to append
+    Markdown/Table/Image components; rendered to card.html on completion."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.__card__ = type
+        return fn
+
+    return wrap
+
+
+def device_profile(interval: float = 1.0):
+    """Device metrics sampling during the step (↔ @gpu_profile(interval=1),
+    train_flow.py:51): samples per-device memory stats every ``interval``
+    seconds on a background thread; the profile is saved as profile.json in
+    the task dir and summarized on the step card if one exists."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.__device_profile__ = {"interval": interval}
+        return fn
+
+    return wrap
+
+
+def schedule(cron: str):
+    """Flow-level cron record (↔ @schedule(cron="*/5 * * * *"),
+    train_flow.py:20). ``deploy`` writes it to the deployment record; an
+    external scheduler (or the ``trigger`` CLI) fires runs — the handoff
+    semantics are in scope, the cron daemon is infra (SURVEY.md §2b D10)."""
+
+    def wrap(cls):
+        cls.__schedule__ = cron
+        return cls
+
+    return wrap
+
+
+def trigger_on_finish(flow: str):
+    """Event trigger (↔ @trigger_on_finish(flow="RayTorchTrain"),
+    eval_flow.py:19): when the named flow finishes successfully it appends an
+    event record; running this flow with ``--triggered`` consumes the newest
+    unconsumed event and exposes ``current.trigger.run``."""
+
+    def wrap(cls):
+        cls.__trigger_on_finish__ = flow
+        return cls
+
+    return wrap

@@ -1,0 +1,97 @@
+"""Gang member bootstrap: one host process of a gang step.
+
+Invoked by FlowRunner._exec_gang as
+``python -m tpuflow.flow.gang_exec <flow_file> <class> <step> <run_id>
+<task_id> <state_path>`` with TPUFLOW_NUM_PROCESSES / TPUFLOW_PROCESS_ID /
+TPUFLOW_COORDINATOR in the env. Each member joins the ``jax.distributed``
+world (rendezvous with timeout ↔ @metaflow_ray's all_nodes_started_timeout,
+train_flow.py:42), runs the step body SPMD, persists its artifacts to its own
+task dir (head = task_id of the gang step; the join step reads all of them),
+and shuts down.
+
+On the local CPU simulation each member contributes
+``TPUFLOW_GANG_LOCAL_DEVICES`` (default 1) virtual CPU devices with gloo
+cross-process collectives — the dev-mode analogue of one TPU host per pod
+slice."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import pickle
+import sys
+
+
+def _bootstrap_jax() -> None:
+    import jax
+
+    if os.environ.get("TPUFLOW_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+        local = int(os.environ.get("TPUFLOW_GANG_LOCAL_DEVICES", "1"))
+        jax.config.update("jax_num_cpu_devices", local)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main(argv: list[str]) -> None:
+    flow_file, class_name, step_name, run_id, task_id, state_path = argv
+    _bootstrap_jax()
+
+    spec = importlib.util.spec_from_file_location("_tpuflow_gang_flow", flow_file)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_tpuflow_gang_flow"] = module
+    spec.loader.exec_module(module)
+    flow_cls = getattr(module, class_name)
+
+    with open(state_path, "rb") as f:
+        state = pickle.load(f)
+
+    from tpuflow import dist
+    from tpuflow.flow import store
+    from tpuflow.flow.spec import current
+
+    timeout = float(os.environ.get("TPUFLOW_GANG_TIMEOUT", "300"))
+    dist.initialize(timeout_s=timeout)
+
+    import jax
+
+    flow = flow_cls()
+    for k, v in state["artifacts"].items():
+        setattr(flow, k, v)
+
+    current.flow_name = flow_cls.__name__
+    current.run_id = str(run_id)
+    current.step_name = step_name
+    current.task_id = int(task_id)
+    current.gang_index = jax.process_index()
+    current.gang_size = jax.process_count()
+    current.tpu_storage_path = os.path.join(
+        store.run_dir(flow_cls.__name__, run_id), "tpu_storage", step_name
+    )
+    os.makedirs(current.tpu_storage_path, exist_ok=True)
+
+    fn = flow_cls.steps()[step_name]
+    fn(flow)
+
+    # Every member persists its own artifacts; the head's land at the gang
+    # step's task_id and are what the flow continues with (non-head members
+    # mirror the reference's artifact-less worker tasks, train_flow.py:85-88).
+    store.save_artifacts(
+        flow_cls.__name__, run_id, step_name, int(task_id), flow._artifacts
+        if jax.process_index() == 0
+        else {},
+    )
+    if jax.process_index() == 0:
+        # Hand the step's transition back to the parent runner.
+        transition = getattr(flow, "_next", None)
+        if transition is not None:
+            import json
+
+            tdir = store.task_dir(flow_cls.__name__, run_id, step_name, int(task_id))
+            with open(os.path.join(tdir, "next.json"), "w") as f:
+                json.dump({"target": transition.target}, f)
+    dist.barrier("gang-step-done")
+    dist.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

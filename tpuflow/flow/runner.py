@@ -1,0 +1,507 @@
+"""Flow execution engine + CLI.
+
+Drives a FlowSpec DAG the way the Metaflow runtime drives the reference's
+(train_flow.py, eval_flow.py): steps execute in transition order from
+``start`` to ``end``; ``@retry`` reruns failures; gang steps
+(``num_parallel>1`` or ``@tpu``) launch N host processes that form one
+``jax.distributed`` world with a formation timeout, only the head process
+persisting artifacts (the reference's @metaflow_ray head/worker split,
+train_flow.py:42 + the tolerant join at train_flow.py:85-88); completed runs
+append trigger events consumed by ``--triggered`` downstream flows
+(eval_flow.py:19,42). CLI: ``run`` / ``show`` / ``deploy`` / ``trigger``
+mirroring the reference runbook (README.md:10-45)."""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from typing import Any
+
+from tpuflow.flow import store
+from tpuflow.flow.cards import CardBuffer
+from tpuflow.flow.client import Run
+from tpuflow.flow.spec import FlowSpec, current
+
+
+class StepFailed(Exception):
+    pass
+
+
+class _GangInput:
+    """One gang member's view passed to a join step (↔ metaflow join inputs,
+    train_flow.py:83-88: non-head members lack artifacts — accessing them
+    raises AttributeError, which the reference's try/except absorbs)."""
+
+    def __init__(self, artifacts: dict[str, Any] | None):
+        self._artifacts = artifacts or {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self._artifacts[name]
+        except KeyError:
+            raise AttributeError(f"no artifact {name!r} on this gang member") from None
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _DeviceProfiler:
+    """Background sampler of per-device memory stats (↔ @gpu_profile's 1 s
+    nvidia-smi polling, train_flow.py:51). Writes profile.json to the task
+    dir."""
+
+    def __init__(self, interval: float, out_path: str):
+        self.interval = interval
+        self.out_path = out_path
+        self.samples: list[dict] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        import jax
+
+        while not self._stop.is_set():
+            entry: dict[str, Any] = {"ts": time.time(), "devices": []}
+            for d in jax.local_devices():
+                stats = {}
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:
+                    pass
+                entry["devices"].append(
+                    {
+                        "id": d.id,
+                        "bytes_in_use": stats.get("bytes_in_use"),
+                        "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    }
+                )
+            self.samples.append(entry)
+            self._stop.wait(self.interval)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        try:
+            with open(self.out_path, "w") as f:
+                json.dump({"interval": self.interval, "samples": self.samples}, f)
+        except OSError:
+            pass
+
+
+class FlowRunner:
+    def __init__(self, flow_cls: type[FlowSpec]):
+        self.flow_cls = flow_cls
+        self.flow_name = flow_cls.__name__
+
+    # ----------------------------------------------------------------- run
+    def run(
+        self,
+        params: dict[str, Any],
+        *,
+        triggered: bool = False,
+        run_id: int | None = None,
+    ) -> str:
+        run_id = run_id if run_id is not None else store.new_run_id(self.flow_name)
+        rdir = store.run_dir(self.flow_name, run_id)
+        os.makedirs(rdir, exist_ok=True)
+        meta = {
+            "flow": self.flow_name,
+            "run_id": run_id,
+            "status": "running",
+            "params": {k: _jsonable(v) for k, v in params.items()},
+            "started": time.time(),
+            "steps": [],
+            "schedule": getattr(self.flow_cls, "__schedule__", None),
+            "trigger_on_finish": getattr(
+                self.flow_cls, "__trigger_on_finish__", None
+            ),
+        }
+        store.write_run_meta(self.flow_name, run_id, meta)
+
+        flow = self.flow_cls()
+        for name, value in params.items():
+            setattr(flow, name, value)
+
+        self._trigger_run = None
+        if triggered:
+            upstream = getattr(self.flow_cls, "__trigger_on_finish__", None)
+            if upstream:
+                events = [
+                    e
+                    for e in store.read_events(upstream)
+                    if e.get("status") == "success"
+                ]
+                if events:
+                    self._trigger_run = Run(events[-1]["run"])
+                    meta["triggered_by"] = events[-1]["run"]
+
+        steps = self.flow_cls.steps()
+        if "start" not in steps or "end" not in steps:
+            raise ValueError("flow must define 'start' and 'end' steps")
+
+        step_name = "start"
+        task_counter = 0
+        pathspec = f"{self.flow_name}/{run_id}"
+        print(f"[tpuflow] run {pathspec} starting")
+        try:
+            while True:
+                fn = steps[step_name]
+                task_id = task_counter
+                gang = getattr(fn, "__gang__", None)
+                transition = getattr(flow, "_next", None)
+                num_parallel = 1
+                if transition is not None and transition.target == step_name:
+                    num_parallel = transition.num_parallel
+                if gang and gang.get("num_parallel"):
+                    num_parallel = max(num_parallel, gang["num_parallel"])
+                task_counter += num_parallel  # gang members own task_id..+N-1
+                object.__setattr__(flow, "_next", None)
+
+                retries = getattr(fn, "__retry_times__", 0)
+                attempt = 0
+                while True:
+                    try:
+                        if num_parallel > 1:
+                            gang_inputs = self._exec_gang(
+                                flow, step_name, run_id, task_id, num_parallel,
+                                timeout=(gang or {}).get("timeout", 300.0),
+                            )
+                        else:
+                            self._exec_local(
+                                flow, fn, step_name, run_id, task_id
+                            )
+                            # A following join sees this task as a 1-member
+                            # gang (num_parallel=1 degenerate case).
+                            gang_inputs = [_GangInput(dict(flow._artifacts))]
+                        break
+                    except Exception:
+                        attempt += 1
+                        if attempt > retries:
+                            raise
+                        print(
+                            f"[tpuflow] step {step_name} failed "
+                            f"(attempt {attempt}/{retries}), retrying:\n"
+                            f"{traceback.format_exc(limit=3)}"
+                        )
+
+                meta["steps"].append(
+                    {"step": step_name, "head_task": task_id, "tasks": num_parallel}
+                )
+                store.write_run_meta(self.flow_name, run_id, meta)
+
+                if step_name == "end":
+                    break
+                transition = getattr(flow, "_next", None)
+                if transition is None:
+                    raise StepFailed(
+                        f"step {step_name!r} did not call self.next(...)"
+                    )
+                next_name = transition.target
+                next_fn = steps[next_name]
+                # A join step (2nd positional arg) receives gang inputs.
+                if gang_inputs is not None and _takes_inputs(next_fn):
+                    object.__setattr__(flow, "_join_inputs", gang_inputs)
+                step_name = next_name
+        except Exception as e:
+            meta["status"] = "failed"
+            meta["error"] = repr(e)
+            meta["finished"] = time.time()
+            store.write_run_meta(self.flow_name, run_id, meta)
+            print(f"[tpuflow] run {pathspec} FAILED: {e!r}")
+            raise
+        meta["status"] = "success"
+        meta["finished"] = time.time()
+        store.write_run_meta(self.flow_name, run_id, meta)
+        store.append_event(
+            {"flow": self.flow_name, "run": pathspec, "status": "success"}
+        )
+        print(f"[tpuflow] run {pathspec} succeeded")
+        return pathspec
+
+    # ----------------------------------------------------- single-task exec
+    def _exec_local(
+        self, flow: FlowSpec, fn, step_name: str, run_id, task_id: int
+    ) -> None:
+        tdir = store.task_dir(self.flow_name, run_id, step_name, task_id)
+        os.makedirs(tdir, exist_ok=True)
+        from tpuflow.flow.spec import _Trigger
+
+        current.flow_name = self.flow_name
+        current.run_id = str(run_id)
+        current.step_name = step_name
+        current.task_id = task_id
+        current.trigger = (
+            _Trigger(self._trigger_run) if getattr(self, "_trigger_run", None) else None
+        )
+        current.tpu_storage_path = os.path.join(
+            store.run_dir(self.flow_name, run_id), "tpu_storage", step_name
+        )
+        os.makedirs(current.tpu_storage_path, exist_ok=True)
+        card_type = getattr(fn, "__card__", None)
+        current.card = CardBuffer() if card_type else None
+
+        profile_cfg = getattr(fn, "__device_profile__", None)
+        profiler = (
+            _DeviceProfiler(
+                profile_cfg["interval"], os.path.join(tdir, "profile.json")
+            )
+            if profile_cfg
+            else None
+        )
+        join_inputs = getattr(flow, "_join_inputs", None)
+        if join_inputs is not None:
+            object.__setattr__(flow, "_join_inputs", None)
+        try:
+            if profiler:
+                with profiler:
+                    self._call_step(flow, fn, join_inputs)
+            else:
+                self._call_step(flow, fn, join_inputs)
+            if current.card is not None:
+                with open(os.path.join(tdir, "card.html"), "w") as f:
+                    f.write(
+                        current.card.render_html(
+                            f"{self.flow_name}/{run_id}/{step_name}"
+                        )
+                    )
+            store.save_artifacts(
+                self.flow_name, run_id, step_name, task_id, flow._artifacts
+            )
+        finally:
+            current.card = None
+
+    @staticmethod
+    def _call_step(flow: FlowSpec, fn, join_inputs) -> None:
+        if _takes_inputs(fn):
+            fn(flow, join_inputs or [])
+        else:
+            fn(flow)
+
+    # ------------------------------------------------------------ gang exec
+    def _exec_gang(
+        self,
+        flow: FlowSpec,
+        step_name: str,
+        run_id,
+        task_id: int,
+        num_parallel: int,
+        *,
+        timeout: float,
+    ) -> list[_GangInput]:
+        """Launch N processes running the step body as one jax.distributed
+        world (local simulation of the pod-slice gang, SURVEY.md §2b D8)."""
+        tdir = store.task_dir(self.flow_name, run_id, step_name, task_id)
+        os.makedirs(tdir, exist_ok=True)
+        state_path = os.path.join(tdir, "gang_state.pkl")
+        with open(state_path, "wb") as f:
+            pickle.dump(
+                {"artifacts": flow._artifacts, "module": self._flow_module()}, f
+            )
+        port = _free_port()
+        procs = []
+        import tpuflow
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(tpuflow.__file__)))
+        for i in range(num_parallel):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+            env.update(
+                TPUFLOW_NUM_PROCESSES=str(num_parallel),
+                TPUFLOW_PROCESS_ID=str(i),
+                TPUFLOW_COORDINATOR=f"127.0.0.1:{port}",
+                TPUFLOW_GANG_TIMEOUT=str(timeout),
+                TPUFLOW_FORCE_CPU=env_force_cpu(),
+            )
+            cmd = [
+                sys.executable,
+                "-m",
+                "tpuflow.flow.gang_exec",
+                self._flow_module(),
+                self.flow_cls.__name__,
+                step_name,
+                str(run_id),
+                str(task_id + i),
+                state_path,
+            ]
+            log = open(os.path.join(tdir, f"gang_{i}.log"), "w")
+            procs.append(
+                (
+                    subprocess.Popen(
+                        cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
+                        cwd=os.getcwd(),
+                    ),
+                    log,
+                )
+            )
+        deadline = time.time() + timeout + 600
+        failed = False
+        for p, log in procs:
+            try:
+                rc = p.wait(timeout=max(deadline - time.time(), 1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc = -9
+            log.close()
+            failed = failed or rc != 0
+        if failed:
+            logs = []
+            for i in range(num_parallel):
+                lp = os.path.join(tdir, f"gang_{i}.log")
+                if os.path.exists(lp):
+                    with open(lp) as f:
+                        tail = f.read()[-2000:]
+                    logs.append(f"--- gang member {i} ---\n{tail}")
+            raise StepFailed(
+                f"gang step {step_name!r} failed:\n" + "\n".join(logs)
+            )
+        # Load head artifacts back into the in-process flow to continue.
+        head_artifacts = store.load_artifacts(
+            self.flow_name, run_id, step_name, task_id
+        )
+        for k, v in head_artifacts.items():
+            setattr(flow, k, v)
+        # Recover the head's self.next(...) transition.
+        next_path = os.path.join(tdir, "next.json")
+        if os.path.exists(next_path):
+            with open(next_path) as f:
+                target = json.load(f)["target"]
+            flow.next(getattr(flow, target))
+        inputs = [_GangInput(head_artifacts)]
+        for i in range(1, num_parallel):
+            arts = store.load_artifacts(
+                self.flow_name, run_id, step_name, task_id + i
+            )
+            inputs.append(_GangInput(arts))
+        return inputs
+
+    def _flow_module(self) -> str:
+        mod = inspect.getmodule(self.flow_cls)
+        path = getattr(mod, "__file__", None)
+        if path is None:
+            raise RuntimeError("flow class must live in an importable file")
+        return os.path.abspath(path)
+
+
+def _takes_inputs(fn) -> bool:
+    params = list(inspect.signature(fn).parameters)
+    return len(params) >= 2 and params[1] not in ("args", "kwargs")
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def env_force_cpu() -> str:
+    """Gang subprocesses run on CPU when explicitly requested
+    (TPUFLOW_FORCE_CPU=1) or when the parent itself runs on CPU."""
+    explicit = os.environ.get("TPUFLOW_FORCE_CPU")
+    if explicit is not None:
+        return explicit
+    import jax
+
+    try:
+        return "1" if jax.default_backend() == "cpu" else "0"
+    except Exception:
+        return "0"
+
+
+# --------------------------------------------------------------------- CLI
+def main(flow_cls: type[FlowSpec], argv: list[str] | None = None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    runner = FlowRunner(flow_cls)
+    if not argv or argv[0] in ("-h", "--help", "show"):
+        _show(flow_cls)
+        return None
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "run":
+        params, triggered = _parse_params(flow_cls, rest)
+        return runner.run(params, triggered=triggered)
+    if cmd == "deploy":
+        record = {
+            "flow": flow_cls.__name__,
+            "schedule": getattr(flow_cls, "__schedule__", None),
+            "trigger_on_finish": getattr(flow_cls, "__trigger_on_finish__", None),
+            "deployed": time.time(),
+        }
+        path = store.write_deployment(flow_cls.__name__, record)
+        print(f"[tpuflow] deployed {flow_cls.__name__}: {record} → {path}")
+        return path
+    if cmd == "trigger":
+        params, _ = _parse_params(flow_cls, rest)
+        return runner.run(params, triggered=True)
+    raise SystemExit(f"unknown command {cmd!r}; use run|show|deploy|trigger")
+
+
+def _parse_params(flow_cls, rest: list[str]):
+    specs = flow_cls.parameters()
+    by_cli = {}
+    for attr, p in specs.items():
+        by_cli[p.name.replace("_", "-")] = (attr, p)
+        by_cli[p.name] = (attr, p)
+    params = {attr: p.default for attr, p in specs.items()}
+    triggered = False
+    i = 0
+    while i < len(rest):
+        arg = rest[i]
+        if arg == "--triggered":
+            triggered = True
+            i += 1
+            continue
+        if not arg.startswith("--"):
+            raise SystemExit(f"unexpected argument {arg!r}")
+        key = arg[2:]
+        if key not in by_cli:
+            raise SystemExit(
+                f"unknown parameter --{key}; known: "
+                + ", ".join(sorted(c for c in by_cli if "-" in c or "_" not in c))
+            )
+        if i + 1 >= len(rest):
+            raise SystemExit(f"--{key} requires a value")
+        attr, p = by_cli[key]
+        params[attr] = p.parse(rest[i + 1])
+        i += 2
+    missing = [p.name for a, p in specs.items() if p.required and params[a] is None]
+    if missing:
+        raise SystemExit(f"missing required parameters: {missing}")
+    return params, triggered
+
+
+def _show(flow_cls) -> None:
+    print(f"Flow {flow_cls.__name__}")
+    doc = (flow_cls.__doc__ or "").strip()
+    if doc:
+        print(f"  {doc.splitlines()[0]}")
+    print("Steps:")
+    for name, fn in flow_cls.steps().items():
+        tags = []
+        if getattr(fn, "__retry_times__", 0):
+            tags.append(f"retry×{fn.__retry_times__}")
+        if getattr(fn, "__gang__", None):
+            tags.append("gang")
+        if getattr(fn, "__card__", None):
+            tags.append("card")
+        print(f"  {name}{(' [' + ', '.join(tags) + ']') if tags else ''}")
+    print("Parameters:")
+    for attr, p in flow_cls.parameters().items():
+        print(f"  --{p.name.replace('_', '-')} (default {p.default!r}) {p.help}")

@@ -1,0 +1,187 @@
+"""Flow datastore: run directories, artifact persistence, run metadata.
+
+Replaces the Metaflow datastore as the reference exercises it: step artifacts
+(``self.result = ...`` at train_flow.py:77,87) persisted per task and readable
+across processes/flows via the client API (train_flow.py:69-73,
+eval_flow.py:45-49). Checkpoint/Result artifacts are stored as JSON
+*references* (path + metadata) — never pickled tensors (SURVEY.md §7
+hard-part 3); plain JSON types stay JSON; numpy arrays go to .npy; anything
+else falls back to pickle.
+
+Layout under ``$TPUFLOW_HOME`` (default ``~/.tpuflow``)::
+
+    flows/<FlowName>/<run_id>/run.json
+    flows/<FlowName>/<run_id>/<step>/<task_id>/artifacts.json (+ blobs)
+    flows/<FlowName>/<run_id>/tpu_storage/          # checkpoint area (D8)
+    events/<flow_name>.jsonl                        # trigger records (D10)
+    deployments/<FlowName>.json                     # schedule records (D10)
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import pickle
+import time
+from typing import Any
+
+import numpy as np
+
+from tpuflow.ckpt import Checkpoint
+from tpuflow.utils import FileLock
+
+
+def home() -> str:
+    return os.path.abspath(
+        os.environ.get("TPUFLOW_HOME", os.path.expanduser("~/.tpuflow"))
+    )
+
+
+def flow_dir(flow: str) -> str:
+    return os.path.join(home(), "flows", flow)
+
+
+def run_dir(flow: str, run_id: str | int) -> str:
+    return os.path.join(flow_dir(flow), str(run_id))
+
+
+def task_dir(flow: str, run_id: str | int, step: str, task_id: int) -> str:
+    return os.path.join(run_dir(flow, run_id), step, str(task_id))
+
+
+def new_run_id(flow: str) -> int:
+    """Monotonic per-flow run ids, atomic under concurrent launches."""
+    d = flow_dir(flow)
+    os.makedirs(d, exist_ok=True)
+    with FileLock(os.path.join(d, ".id.lock")):
+        path = os.path.join(d, "latest_run_id")
+        last = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                last = int(f.read().strip() or 0)
+        run_id = last + 1
+        with open(path, "w") as f:
+            f.write(str(run_id))
+    return run_id
+
+
+def latest_run_id(flow: str) -> int | None:
+    path = os.path.join(flow_dir(flow), "latest_run_id")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return int(f.read().strip())
+
+
+# ------------------------------------------------------------------ metadata
+def write_run_meta(flow: str, run_id, meta: dict) -> None:
+    d = run_dir(flow, run_id)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "run.json"), "w") as f:
+        json.dump(meta, f, indent=1, default=str)
+
+
+def read_run_meta(flow: str, run_id) -> dict:
+    with open(os.path.join(run_dir(flow, run_id), "run.json")) as f:
+        return json.load(f)
+
+
+# ----------------------------------------------------------------- artifacts
+def _encode(name: str, value: Any, blob_dir: str) -> dict:
+    from tpuflow.train.trainer import Result
+
+    if isinstance(value, Checkpoint):
+        return {"__type__": "checkpoint", **value.to_json()}
+    if isinstance(value, Result):
+        return {"__type__": "result", "value": value.to_json()}
+    if isinstance(value, np.ndarray):
+        fname = f"{name}.npy"
+        np.save(os.path.join(blob_dir, fname), value)
+        return {"__type__": "ndarray", "file": fname}
+    try:
+        json.dumps(value)
+        return {"__type__": "json", "value": value}
+    except (TypeError, ValueError):
+        fname = f"{name}.pkl"
+        with open(os.path.join(blob_dir, fname), "wb") as f:
+            pickle.dump(value, f)
+        return {"__type__": "pickle", "file": fname}
+
+
+def _decode(entry: dict, blob_dir: str) -> Any:
+    from tpuflow.train.trainer import Result
+
+    t = entry["__type__"]
+    if t == "checkpoint":
+        return Checkpoint.from_json(entry)
+    if t == "result":
+        return Result.from_json(entry["value"])
+    if t == "ndarray":
+        return np.load(os.path.join(blob_dir, entry["file"]))
+    if t == "json":
+        return entry["value"]
+    if t == "pickle":
+        with open(os.path.join(blob_dir, entry["file"]), "rb") as f:
+            return pickle.load(f)
+    raise ValueError(f"unknown artifact type {t!r}")
+
+
+def save_artifacts(
+    flow: str, run_id, step: str, task_id: int, artifacts: dict[str, Any]
+) -> None:
+    d = task_dir(flow, run_id, step, task_id)
+    os.makedirs(d, exist_ok=True)
+    encoded = {k: _encode(k, v, d) for k, v in artifacts.items()}
+    with open(os.path.join(d, "artifacts.json"), "w") as f:
+        json.dump(encoded, f, indent=1)
+
+
+def load_artifacts(flow: str, run_id, step: str, task_id: int) -> dict[str, Any]:
+    d = task_dir(flow, run_id, step, task_id)
+    path = os.path.join(d, "artifacts.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        encoded = json.load(f)
+    return {k: _decode(v, d) for k, v in encoded.items()}
+
+
+# -------------------------------------------------------------------- events
+def append_event(event: dict) -> None:
+    d = os.path.join(home(), "events")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{event['flow']}.jsonl")
+    line = json.dumps({**event, "ts": time.time()})
+    # O_APPEND + flock: concurrent flows may finish simultaneously.
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        os.write(fd, (line + "\n").encode())
+    finally:
+        os.close(fd)
+
+
+def read_events(flow: str) -> list[dict]:
+    path = os.path.join(home(), "events", f"{flow}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def write_deployment(flow: str, record: dict) -> str:
+    d = os.path.join(home(), "deployments")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{flow}.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    return path
+
+
+def read_deployment(flow: str) -> dict | None:
+    path = os.path.join(home(), "deployments", f"{flow}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

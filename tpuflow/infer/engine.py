@@ -45,11 +45,30 @@ class BatchPredictor:
 
     @classmethod
     def from_checkpoint(
-        cls, checkpoint: Checkpoint, model, *, mesh=None
+        cls, checkpoint: Checkpoint, model, *, sample_input=None, mesh=None
     ) -> "BatchPredictor":
         """Load weights once at construction (↔ my_ray_module.py:268-273,
-        which restores best_model.pt in TorchPredictor.__init__)."""
-        params = restore_from_handle(checkpoint, weights_only=True)
+        which restores best_model.pt in TorchPredictor.__init__).
+
+        When ``sample_input`` is given, params are restored against an
+        abstract tree derived from the model (replicated on the current
+        mesh), so a checkpoint written on any training topology loads on the
+        inference topology.
+        """
+        mesh = mesh if mesh is not None else dist.make_mesh()
+        abstract = None
+        if sample_input is not None:
+            shapes = jax.eval_shape(
+                model.init, jax.random.PRNGKey(0), sample_input
+            )["params"]
+            sharding = dist.replicated(mesh)
+            abstract = jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
+                shapes,
+            )
+        params = restore_from_handle(
+            checkpoint, weights_only=True, abstract_state=abstract
+        )
         return cls(model, params, mesh=mesh)
 
     def __call__(self, batch: dict) -> dict:
