@@ -1,0 +1,9 @@
+"""Parallelism layouts over the named mesh: FSDP, tensor, sequence (ring)."""
+
+from tpuflow.parallel.sharding import (
+    create_sharded_state,
+    gpt2_tensor_rules,
+    make_shardings,
+)
+
+__all__ = ["create_sharded_state", "gpt2_tensor_rules", "make_shardings"]
