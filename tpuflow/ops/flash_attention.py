@@ -1,0 +1,198 @@
+"""Blockwise / flash attention: O(T) memory attention for TPU.
+
+Two tiers with identical numerics:
+
+- ``blockwise_attention`` — pure-JAX online-softmax attention via ``lax.scan``
+  over KV chunks. O(block) memory instead of O(T^2), differentiable, runs on
+  any backend; the building block of ring attention.
+- ``flash_attention`` — Pallas TPU kernel (MXU matmuls in the q/k blocks,
+  float32 online-softmax state in VMEM scratch). Forward is the kernel;
+  backward (custom VJP) recomputes through ``blockwise_attention`` —
+  the flash-style compute-for-memory trade.
+
+The reference has no attention anywhere (its model is an image MLP,
+my_ray_module.py:94-112); these exist for the GPT-2 acceptance config and
+first-class long-context support (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _chunk_positions(t: int, block: int):
+    n = t // block
+    return jnp.arange(n)[:, None] * block + jnp.arange(block)[None, :]
+
+
+def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
+    """Online-softmax attention, scanning KV in chunks. q,k,v: (B,T,H,D)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    block_k = min(block_k, Tk)
+    if Tk % block_k:
+        return _reference_attention(q, k, v, causal=causal)
+    nk = Tk // block_k
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+
+    q32 = q.astype(jnp.float32)
+    kc = k.reshape(B, nk, block_k, H, D).transpose(1, 0, 2, 3, 4)
+    vc = v.reshape(B, nk, block_k, H, D).transpose(1, 0, 2, 3, 4)
+    k_pos = _chunk_positions(Tk, block_k)
+    q_pos = jnp.arange(Tq)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        k_blk, v_blk, kp = inp
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            mask = q_pos[:, None] >= kp[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kc, vc, k_pos))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _reference_attention(q, k, v, *, causal: bool):
+    from tpuflow.ops.attention import xla_attention
+
+    return xla_attention(q, k, v, causal=causal)
+
+
+# ----------------------------------------------------------- pallas kernel
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
+                causal, block_q, block_k):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (block_q, block_k)
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_old = m_scr[:, 0]
+    m_new = jnp.maximum(m_old, s.max(axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    corr = jnp.exp(m_old - m_new)
+    l_new = l_scr[:, 0] * corr + p.sum(axis=-1)
+    acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scr[:, 0] = m_new
+    l_scr[:, 0] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _final():
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    grid = (B * H, Tq // block_q, Tk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom (col 0)
+            pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    # Flash-style backward: recompute through the O(T)-memory blockwise path.
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = True, block_q: int = 256, block_k: int = 256
+):
+    """Pallas TPU flash attention. q,k,v: (B,T,H,D) → (B,T,H,D).
+
+    Falls back to ``blockwise_attention`` when shapes don't tile (T not
+    divisible by the blocks, or tiny head_dim on CPU interpret mode).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k or D % 8:
+        return blockwise_attention(q, k, v, causal=causal)
+    return _flash(q, k, v, causal, block_q, block_k)
