@@ -1,0 +1,147 @@
+"""Native IO plane tests: ctypes wrappers, raw checkpoint format, and the
+manager's raw/orbax format dispatch."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuflow import _native, dist
+from tpuflow.ckpt import Checkpoint, CheckpointManager, restore_from_handle
+from tpuflow.ckpt.raw import is_raw, restore_raw, save_raw
+from tpuflow.models import NeuralNetwork
+from tpuflow.train import create_train_state
+
+
+def test_native_lib_builds_and_loads():
+    assert _native.lib() is not None, "native toolchain present but lib missing"
+
+
+def test_write_read_roundtrip(tmp_path):
+    a = np.random.default_rng(0).standard_normal((37, 129)).astype(np.float32)
+    path = str(tmp_path / "x.bin")
+    _native.write_bytes(path, a)
+    assert os.path.getsize(path) == a.nbytes
+    b = _native.read_bytes(path, a.nbytes).view(np.float32).reshape(a.shape)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_read_missing_file_raises(tmp_path):
+    with pytest.raises(OSError):
+        _native.read_bytes(str(tmp_path / "nope.bin"), 10)
+
+
+def test_read_truncated_raises(tmp_path):
+    path = str(tmp_path / "short.bin")
+    with open(path, "wb") as f:
+        f.write(b"abc")
+    with pytest.raises(OSError):
+        _native.read_bytes(path, 100)
+
+
+def test_gather_normalize_u8_matches_numpy():
+    src = np.random.default_rng(0).integers(0, 256, (100, 28, 28), dtype=np.uint8)
+    idx = np.random.default_rng(1).permutation(100)[:17]
+    out = _native.gather_normalize_u8(src, idx, mean=0.5, std=0.5)
+    ref = ((src[idx].astype(np.float32) / 255.0) - 0.5) / 0.5
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_gather_f32_matches_numpy():
+    src = np.random.default_rng(0).standard_normal((50, 7, 3)).astype(np.float32)
+    idx = np.asarray([4, 4, 0, 49])
+    np.testing.assert_array_equal(_native.gather_f32(src, idx), src[idx])
+
+
+def _tree(seed=0):
+    state = create_train_state(
+        NeuralNetwork(hidden_dim=16),
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, 28, 28)),
+        optax.sgd(1e-2, momentum=0.9),
+    )
+    return {"step": state.step, "params": state.params, "opt_state": state.opt_state}
+
+
+def test_raw_roundtrip_with_template(tmp_path):
+    tree = _tree()
+    save_raw(str(tmp_path / "c"), tree)
+    assert is_raw(str(tmp_path / "c"))
+    restored = restore_raw(str(tmp_path / "c"), tree)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_raw_partial_subtree(tmp_path):
+    tree = _tree()
+    save_raw(str(tmp_path / "c"), tree)
+    params = restore_raw(str(tmp_path / "c"), subtree=("params",))
+    assert set(params) == {"dense1", "dense2", "dense3"}
+    np.testing.assert_array_equal(
+        params["dense1"]["kernel"], np.asarray(tree["params"]["dense1"]["kernel"])
+    )
+    with pytest.raises(KeyError):
+        restore_raw(str(tmp_path / "c"), subtree=("nope",))
+
+
+def test_manager_auto_uses_raw_and_restores_sharded(tmp_path, mesh8):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    assert mgr.format == "raw"
+    big = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+    sharded = jax.device_put(big, dist.batch_sharding(mesh8))
+    mgr.save(1, {"w": sharded}, metrics={"val_loss": 0.5})
+    mgr.wait_until_finished()
+    assert is_raw(os.path.join(mgr.directory, "step_1", "state"))
+    # Restore onto a different layout (raw is topology-free by construction).
+    mesh4 = dist.make_mesh({"data": 4}, devices=jax.devices()[:4])
+    target = jax.ShapeDtypeStruct(
+        (64, 16),
+        jnp.float32,
+        sharding=jax.sharding.NamedSharding(
+            mesh4, jax.sharding.PartitionSpec(None, "data")
+        ),
+    )
+    out = mgr.restore(1, abstract_state={"w": target})
+    np.testing.assert_array_equal(np.asarray(out["w"]), big)
+    assert out["w"].sharding.spec[1] == "data"
+    mgr.close()
+
+
+def test_manager_orbax_format_still_works(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False, format="orbax")
+    tree = _tree()
+    ckpt = mgr.save(1, tree, metrics={"val_loss": 0.5})
+    restored = mgr.restore(1)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["dense1"]["kernel"]),
+        np.asarray(tree["params"]["dense1"]["kernel"]),
+    )
+    mgr.close()
+    # Handle restore also handles the orbax layout.
+    params = restore_from_handle(ckpt, weights_only=True)
+    assert "dense1" in params
+
+
+def test_handle_weights_only_raw_with_abstract(tmp_path, mesh8):
+    mgr = CheckpointManager(str(tmp_path), async_save=False, format="raw")
+    tree = _tree(seed=2)
+    ckpt = mgr.save(1, tree, metrics={"val_loss": 0.1})
+    mgr.close()
+    handle = Checkpoint.from_json(ckpt.to_json())
+    abstract = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=dist.replicated(mesh8)
+        ),
+        tree["params"],
+    )
+    params = restore_from_handle(handle, weights_only=True, abstract_state=abstract)
+    leaf = params["dense1"]["kernel"]
+    assert leaf.sharding.is_fully_replicated
+    np.testing.assert_array_equal(
+        np.asarray(leaf), np.asarray(tree["params"]["dense1"]["kernel"])
+    )

@@ -1,0 +1,197 @@
+"""ctypes binding for the native IO library, with build-on-demand.
+
+``lib()`` returns the loaded library or None (never raises): if the shared
+object is missing it is built with ``make`` once per process under a file
+lock; if no toolchain is available, callers fall back to NumPy paths — the
+framework stays pure-Python-functional, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+logger = logging.getLogger("tpuflow.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libtpuflow_io.so")
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    from tpuflow.utils import FileLock
+
+    try:
+        with FileLock(os.path.join(_DIR, ".build.lock")):
+            if os.path.exists(_SO):
+                return True
+            proc = subprocess.run(
+                ["make", "-C", _DIR],
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+        if proc.returncode != 0:
+            logger.warning("native build failed:\n%s", proc.stderr[-1000:])
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable: %r", e)
+        return False
+
+
+def lib() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        L = ctypes.CDLL(_SO)
+    except OSError as e:
+        logger.warning("cannot load %s: %r", _SO, e)
+        return None
+    L.ckptio_write.restype = ctypes.c_int
+    L.ckptio_write.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    L.ckptio_read.restype = ctypes.c_int
+    L.ckptio_read.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    L.ckptio_file_size.restype = ctypes.c_int64
+    L.ckptio_file_size.argtypes = [ctypes.c_char_p]
+    L.dataio_gather_normalize_u8.restype = ctypes.c_int
+    L.dataio_gather_normalize_u8.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_float,
+        ctypes.c_float,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    L.dataio_gather_f32.restype = ctypes.c_int
+    L.dataio_gather_f32.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    _lib = L
+    return _lib
+
+
+def default_threads() -> int:
+    return int(
+        os.environ.get("TPUFLOW_IO_THREADS", min(os.cpu_count() or 1, 16))
+    )
+
+
+# ------------------------------------------------------------ typed wrappers
+def write_bytes(path: str, arr: np.ndarray, *, threads: int | None = None) -> None:
+    """Striped threaded write of a contiguous array's bytes to ``path``."""
+    L = lib()
+    arr = np.ascontiguousarray(arr)
+    if L is None:
+        with open(path, "wb", buffering=0) as f:
+            f.write(memoryview(arr).cast("B"))
+        return
+    rc = L.ckptio_write(
+        path.encode(),
+        arr.ctypes.data_as(ctypes.c_void_p),
+        arr.nbytes,
+        threads if threads is not None else default_threads(),
+    )
+    if rc != 0:
+        raise OSError(rc, os.strerror(rc), path)
+
+
+def read_bytes(path: str, nbytes: int, *, threads: int | None = None) -> np.ndarray:
+    """Striped threaded read of ``nbytes`` from ``path`` into a u8 array."""
+    out = np.empty(nbytes, np.uint8)
+    L = lib()
+    if L is None:
+        with open(path, "rb", buffering=0) as f:
+            f.readinto(memoryview(out))
+        return out
+    rc = L.ckptio_read(
+        path.encode(),
+        out.ctypes.data_as(ctypes.c_void_p),
+        nbytes,
+        threads if threads is not None else default_threads(),
+    )
+    if rc != 0:
+        raise OSError(rc, os.strerror(rc), path)
+    return out
+
+
+def gather_normalize_u8(
+    src: np.ndarray,
+    idx: np.ndarray,
+    *,
+    mean: float = 0.5,
+    std: float = 0.5,
+    threads: int | None = None,
+) -> np.ndarray:
+    """Fused batch gather + normalize for uint8 image datasets:
+    out[i] = (src[idx[i]]/255 - mean)/std, shape (len(idx), *src.shape[1:])."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    row_elems = int(np.prod(src.shape[1:]))
+    L = lib()
+    if L is None or src.dtype != np.uint8:
+        return ((src[idx].astype(np.float32) / 255.0) - mean) / std
+    out = np.empty((len(idx), *src.shape[1:]), np.float32)
+    rc = L.dataio_gather_normalize_u8(
+        src.ctypes.data_as(ctypes.c_void_p),
+        row_elems,
+        idx.ctypes.data_as(ctypes.c_void_p),
+        len(idx),
+        mean,
+        1.0 / std,
+        out.ctypes.data_as(ctypes.c_void_p),
+        threads if threads is not None else default_threads(),
+    )
+    if rc != 0:
+        raise OSError(rc, os.strerror(rc))
+    return out
+
+
+def gather_f32(
+    src: np.ndarray, idx: np.ndarray, *, threads: int | None = None
+) -> np.ndarray:
+    """Threaded indexed row copy: out[i] = src[idx[i]] for float32 rows."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    L = lib()
+    if L is None or src.dtype != np.float32:
+        return src[idx]
+    row_elems = int(np.prod(src.shape[1:]))
+    out = np.empty((len(idx), *src.shape[1:]), np.float32)
+    rc = L.dataio_gather_f32(
+        src.ctypes.data_as(ctypes.c_void_p),
+        row_elems,
+        idx.ctypes.data_as(ctypes.c_void_p),
+        len(idx),
+        out.ctypes.data_as(ctypes.c_void_p),
+        threads if threads is not None else default_threads(),
+    )
+    if rc != 0:
+        raise OSError(rc, os.strerror(rc))
+    return out

@@ -1,0 +1,173 @@
+// tpuflow native IO plane: threaded checkpoint file IO + dataset batch ops.
+//
+// TPU-native counterpart of the native components in the reference's
+// dependency stack (SURVEY.md §2b/2d: Ray core's C++ object store and
+// torch's C++ serialization under torch.save at my_ray_module.py:179-201).
+// The JAX/XLA compute path stays in jaxlib's C++ runtime; this library covers
+// the framework's own host-side hot paths:
+//
+//   - ckptio_write / ckptio_read: striped multi-threaded pwrite/pread of one
+//     contiguous buffer <-> file. Threads each own a disjoint byte range, so
+//     storage tiers with per-stream limits (page cache, NVMe queues, network
+//     FS) are driven in parallel. Used by the 'raw' checkpoint format.
+//   - dataio_gather_normalize_*: batch assembly fused with normalization
+//     ((x/255 - mean)/std for u8, identity gather for f32), multithreaded
+//     across batch rows. Used by the data loader (replaces the per-batch
+//     Python/NumPy gather of DataLoader workers).
+//
+// Build: `make` in this directory (g++ -O3 -shared -fPIC -pthread).
+// Python binding: ctypes (tpuflow/_native/__init__.py) — no pybind11 needed.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// Run fn(i) on n threads; returns first nonzero error code.
+template <typename F> int parallel_for(int n, F fn) {
+  if (n <= 1) return fn(0);
+  std::atomic<int> err{0};
+  std::vector<std::thread> threads;
+  threads.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    threads.emplace_back([&, i] {
+      int e = fn(i);
+      int expected = 0;
+      if (e != 0) err.compare_exchange_strong(expected, e);
+    });
+  }
+  for (auto &t : threads) t.join();
+  return err.load();
+}
+
+int full_pwrite(int fd, const char *buf, size_t count, off_t offset) {
+  while (count > 0) {
+    ssize_t w = pwrite(fd, buf, count, offset);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return errno;
+    }
+    buf += w;
+    offset += w;
+    count -= static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+int full_pread(int fd, char *buf, size_t count, off_t offset) {
+  while (count > 0) {
+    ssize_t r = pread(fd, buf, count, offset);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return errno;
+    }
+    if (r == 0) return EIO;  // truncated file
+    buf += r;
+    offset += r;
+    count -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write `nbytes` from `data` to `path` with `nthreads` striped writers.
+// Returns 0 on success, else errno.
+int ckptio_write(const char *path, const void *data, uint64_t nbytes,
+                 int nthreads) {
+  int fd = open(path, O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) return errno;
+  if (ftruncate(fd, static_cast<off_t>(nbytes)) != 0) {
+    int e = errno;
+    close(fd);
+    return e;
+  }
+  if (nthreads < 1) nthreads = 1;
+  uint64_t stripe = (nbytes + nthreads - 1) / nthreads;
+  const char *base = static_cast<const char *>(data);
+  int err = parallel_for(nthreads, [&](int i) -> int {
+    uint64_t off = stripe * static_cast<uint64_t>(i);
+    if (off >= nbytes) return 0;
+    uint64_t len = std::min(stripe, nbytes - off);
+    return full_pwrite(fd, base + off, len, static_cast<off_t>(off));
+  });
+  if (fsync(fd) != 0 && err == 0) err = errno;
+  if (close(fd) != 0 && err == 0) err = errno;
+  return err;
+}
+
+// Read `nbytes` into `data` from `path` with `nthreads` striped readers.
+int ckptio_read(const char *path, void *data, uint64_t nbytes, int nthreads) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return errno;
+  if (nthreads < 1) nthreads = 1;
+  uint64_t stripe = (nbytes + nthreads - 1) / nthreads;
+  char *base = static_cast<char *>(data);
+  int err = parallel_for(nthreads, [&](int i) -> int {
+    uint64_t off = stripe * static_cast<uint64_t>(i);
+    if (off >= nbytes) return 0;
+    uint64_t len = std::min(stripe, nbytes - off);
+    return full_pread(fd, base + off, len, static_cast<off_t>(off));
+  });
+  if (close(fd) != 0 && err == 0) err = errno;
+  return err;
+}
+
+// File size helper (-1 on error).
+int64_t ckptio_file_size(const char *path) {
+  struct stat st;
+  if (stat(path, &st) != 0) return -1;
+  return static_cast<int64_t>(st.st_size);
+}
+
+// Gather rows of a uint8 source into a float32 batch, fused with
+// (x/255 - mean)/std normalization. src: (n_rows, row_elems) u8;
+// out: (n_idx, row_elems) f32.
+int dataio_gather_normalize_u8(const uint8_t *src, uint64_t row_elems,
+                               const int64_t *idx, uint64_t n_idx,
+                               float mean, float inv_std, float *out,
+                               int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  uint64_t stripe = (n_idx + nthreads - 1) / nthreads;
+  const float scale = inv_std / 255.0f;
+  const float bias = -mean * inv_std;
+  return parallel_for(nthreads, [&](int t) -> int {
+    uint64_t lo = stripe * static_cast<uint64_t>(t);
+    uint64_t hi = std::min(lo + stripe, n_idx);
+    for (uint64_t r = lo; r < hi; ++r) {
+      const uint8_t *s = src + static_cast<uint64_t>(idx[r]) * row_elems;
+      float *d = out + r * row_elems;
+      for (uint64_t e = 0; e < row_elems; ++e)
+        d[e] = static_cast<float>(s[e]) * scale + bias;
+    }
+    return 0;
+  });
+}
+
+// Gather rows of a float32 source into a float32 batch (plain indexed copy).
+int dataio_gather_f32(const float *src, uint64_t row_elems, const int64_t *idx,
+                      uint64_t n_idx, float *out, int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  uint64_t stripe = (n_idx + nthreads - 1) / nthreads;
+  return parallel_for(nthreads, [&](int t) -> int {
+    uint64_t lo = stripe * static_cast<uint64_t>(t);
+    uint64_t hi = std::min(lo + stripe, n_idx);
+    for (uint64_t r = lo; r < hi; ++r) {
+      std::memcpy(out + r * row_elems,
+                  src + static_cast<uint64_t>(idx[r]) * row_elems,
+                  row_elems * sizeof(float));
+    }
+    return 0;
+  });
+}
+
+}  // extern "C"

@@ -83,6 +83,7 @@ class CheckpointManager:
         best_metric: str = "val_loss",
         best_mode: str = "min",
         async_save: bool = True,
+        format: str = "auto",
     ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -90,6 +91,18 @@ class CheckpointManager:
         self.best_metric = best_metric
         self.best_mode = best_mode
         self._async = async_save
+        # 'raw' = native striped-IO per-leaf files (fast path; needs fully
+        # addressable leaves, i.e. single-host); 'orbax' = tensorstore OCDBT
+        # (multi-host sharded writes). 'auto' picks raw when possible.
+        format = os.environ.get("TPUFLOW_CKPT_FORMAT", format)
+        if format == "auto":
+            format = "raw" if jax.process_count() == 1 else "orbax"
+        if format not in ("raw", "orbax"):
+            raise ValueError(f"unknown checkpoint format {format!r}")
+        self.format = format
+        from tpuflow.ckpt.raw import AsyncRawSaver
+
+        self._raw_saver = AsyncRawSaver()
         self._ckptr = ocp.StandardCheckpointer()
         self._metrics_history: list[dict[str, Any]] = []
         # Rebuild history from existing steps (in-run resume after retry).
@@ -155,15 +168,18 @@ class CheckpointManager:
         (my_ray_module.py:178-205). Blocks only if the previous async save is
         still in flight.
         """
-        self._ckptr.wait_until_finished()
+        self.wait_until_finished()
         step_dir = self._step_dir(step)
         state_dir = os.path.join(step_dir, _STATE_DIR)
         if os.path.exists(state_dir):
             shutil.rmtree(state_dir)  # overwrite a retried step cleanly
         os.makedirs(step_dir, exist_ok=True)
-        self._ckptr.save(state_dir, state)
+        if self.format == "raw":
+            self._raw_saver.save(state_dir, state)
+        else:
+            self._ckptr.save(state_dir, state)
         if not self._async:
-            self._ckptr.wait_until_finished()
+            self.wait_until_finished()
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         self._metrics_history.append({"step": step, **metrics})
         meta = {
@@ -199,9 +215,10 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self._ckptr.wait_until_finished()
+        self._raw_saver.wait()
 
     def close(self) -> None:
-        self._ckptr.wait_until_finished()
+        self.wait_until_finished()
         self._ckptr.close()
 
     # --------------------------------------------------------------- restore
@@ -230,8 +247,15 @@ class CheckpointManager:
         Orbax places/reshards shards directly onto the current mesh — this is
         how a v5e-32-written checkpoint restores on v5e-16.
         """
+        from tpuflow.ckpt import raw as raw_fmt
+
         chosen = self._resolve_step(step, best)
         state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
+        if raw_fmt.is_raw(state_dir):
+            return raw_fmt.restore_raw(
+                state_dir,
+                _abstractify(abstract_state) if abstract_state is not None else None,
+            )
         if abstract_state is not None:
             return self._ckptr.restore(state_dir, _abstractify(abstract_state))
         return self._ckptr.restore(state_dir)
@@ -268,8 +292,32 @@ def restore_from_handle(
     read from storage (partial restore), which is also what makes a
     checkpoint written on one topology load onto another here.
     """
+    from tpuflow.ckpt import raw as raw_fmt
+
     with checkpoint.as_directory() as path:
         state_dir = os.path.join(path, _STATE_DIR)
+        if raw_fmt.is_raw(state_dir):
+            if weights_only:
+                params = raw_fmt.restore_raw(state_dir, subtree=("params",))
+                if abstract_state is not None:
+                    abstract = _abstractify(abstract_state)
+                    params = jax.tree_util.tree_map(
+                        lambda arr, t: jax.device_put(
+                            arr.astype(t.dtype)
+                            if arr.dtype != t.dtype
+                            else arr,
+                            t.sharding,
+                        )
+                        if t.sharding is not None
+                        else arr,
+                        params,
+                        abstract,
+                    )
+                return params
+            return raw_fmt.restore_raw(
+                state_dir,
+                _abstractify(abstract_state) if abstract_state is not None else None,
+            )
         if weights_only and abstract_state is not None:
             item = {"params": _abstractify(abstract_state)}
             ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())

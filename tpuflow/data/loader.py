@@ -19,6 +19,16 @@ import numpy as np
 from tpuflow.data.datasets import Split
 
 
+def _take(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Batch row gather; float32 image rows go through the multithreaded
+    native copy (tpuflow/_native/io.cpp dataio_gather_f32)."""
+    if arr.dtype == np.float32 and arr.ndim >= 2:
+        from tpuflow import _native
+
+        return _native.gather_f32(arr, idx)
+    return arr[idx]
+
+
 @dataclasses.dataclass
 class ShardedLoader:
     """Iterate fixed-shape batches of one shard of a Split.
@@ -79,7 +89,7 @@ class ShardedLoader:
         for b in range(n_full):
             idx = order[b * bs : (b + 1) * bs]
             yield {
-                "x": self.split.images[idx],
+                "x": _take(self.split.images, idx),
                 "y": self.split.labels[idx],
                 "mask": np.ones(bs, np.float32),
             }
@@ -92,14 +102,14 @@ class ShardedLoader:
                 [np.ones(tail, np.float32), np.zeros(pad, np.float32)]
             )
             yield {
-                "x": self.split.images[pad_idx],
+                "x": _take(self.split.images, pad_idx),
                 "y": self.split.labels[pad_idx],
                 "mask": mask,
             }
         elif tail and not self.drop_last:
             idx = order[n_full * bs :]
             yield {
-                "x": self.split.images[idx],
+                "x": _take(self.split.images, idx),
                 "y": self.split.labels[idx],
                 "mask": np.ones(tail, np.float32),
             }
