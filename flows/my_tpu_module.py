@@ -31,7 +31,7 @@ from tpuflow import dist  # noqa: E402
 from tpuflow.ckpt import Checkpoint, restore_from_handle  # noqa: E402
 from tpuflow.data import get_dataloaders, get_labels_map  # noqa: E402
 from tpuflow.infer import BatchPredictor, map_batches  # noqa: E402
-from tpuflow.models import NeuralNetwork  # noqa: E402
+from tpuflow.models import NeuralNetwork, get_model  # noqa: E402
 from tpuflow.train import (  # noqa: E402
     CheckpointConfig,
     Result,
@@ -55,11 +55,14 @@ def _log(msg: str) -> None:
 def _state_tree(state) -> dict:
     """Checkpoint payload (↔ the torch.save dict, my_ray_module.py:183-186;
     metrics history rides in checkpoint metadata instead of the payload)."""
-    return {
+    tree = {
         "step": state.step,
         "params": state.params,
         "opt_state": state.opt_state,
     }
+    if state.batch_stats:
+        tree["batch_stats"] = state.batch_stats
+    return tree
 
 
 def set_weights_from_checkpoint(state, checkpoint: Checkpoint):
@@ -68,6 +71,18 @@ def set_weights_from_checkpoint(state, checkpoint: Checkpoint):
     params are a pytree, the prefix was a DDP-wrapper artifact)."""
     params = restore_from_handle(checkpoint, weights_only=True)
     return state.replace(params=params)
+
+
+def _build_model(config: dict):
+    """Models are pluggable behind the same trainer API (the acceptance
+    configs name ResNet-18/50 beyond the reference's MLP, BASELINE.md)."""
+    name = config.get("model", "mlp")
+    kwargs = dict(config.get("model_kwargs") or {})
+    kwargs.setdefault("num_classes", config.get("num_classes", 10))
+    if name in ("resnet18", "resnet50"):
+        # CIFAR-sized inputs use the 3x3 stem unless told otherwise.
+        kwargs.setdefault("small_inputs", config.get("dataset") != "imagenet_synth")
+    return get_model(name, **kwargs)
 
 
 def train_func_per_worker(config: dict) -> None:
@@ -96,11 +111,13 @@ def train_func_per_worker(config: dict) -> None:
     )
     _log(f"dataloaders ready (world={world}, rank={rank})")
 
-    model = NeuralNetwork()
+    model = _build_model(config)
     tx = optax.sgd(lr, momentum=0.9)  # parity: my_ray_module.py:142
+    sample = np.zeros(
+        (1, *train_loader.split.images.shape[1:]), np.float32
+    )
     state = create_train_state(
-        model, jax.random.PRNGKey(config.get("seed", 0)),
-        np.zeros((1, 28, 28), np.float32), tx,
+        model, jax.random.PRNGKey(config.get("seed", 0)), sample, tx
     )
     if config.get("checkpoint") is not None:
         ckpt = config["checkpoint"]
@@ -113,6 +130,7 @@ def train_func_per_worker(config: dict) -> None:
                 step=restored["step"],
                 params=restored["params"],
                 opt_state=restored["opt_state"],
+                batch_stats=restored.get("batch_stats", state.batch_stats),
             )
             _log("full state restored from checkpoint (params+opt+step)")
         else:
@@ -125,6 +143,7 @@ def train_func_per_worker(config: dict) -> None:
         step=dist.replicate(state.step, ctx.mesh),
         params=dist.replicate(state.params, ctx.mesh),
         opt_state=dist.replicate(state.opt_state, ctx.mesh),
+        batch_stats=dist.replicate(state.batch_stats, ctx.mesh),
     )
 
     train_step = make_train_step()
@@ -174,10 +193,13 @@ def train_func_per_worker(config: dict) -> None:
     _log(f"total training time: {time.monotonic() - start:.1f}s")
 
 
-def train_fashion_mnist(
+def train_model(
     num_workers: int | None = None,
     use_tpu: bool = True,
     *,
+    model: str = "mlp",
+    model_kwargs: dict | None = None,
+    num_classes: int = 10,
     checkpoint_storage_path: str | None = None,
     global_batch_size: int = 32,
     lr: float = 1e-3,
@@ -189,7 +211,10 @@ def train_fashion_mnist(
     data_dir: str | None = None,
     seed: int = 0,
 ) -> Result:
-    """Trainer driver (↔ train_fashion_mnist, my_ray_module.py:216-251)."""
+    """Trainer driver (↔ train_fashion_mnist, my_ray_module.py:216-251),
+    generalized to the model zoo: the acceptance configs run ResNet-18/
+    CIFAR-10 and ResNet-50/ImageNet through this same entry point
+    (BASELINE.md configs 1-2)."""
     workers = num_workers if num_workers and num_workers > 0 else len(jax.devices())
     train_config = {
         "lr": lr,
@@ -201,6 +226,9 @@ def train_fashion_mnist(
         "dataset": dataset,
         "data_dir": data_dir,
         "seed": seed,
+        "model": model,
+        "model_kwargs": model_kwargs,
+        "num_classes": num_classes,
     }
     trainer = Trainer(
         train_func_per_worker,
@@ -216,18 +244,31 @@ def train_fashion_mnist(
     return result
 
 
+def train_fashion_mnist(num_workers: int | None = None, use_tpu: bool = True, **kw):
+    """Parity alias (↔ train_fashion_mnist, my_ray_module.py:216)."""
+    kw.setdefault("model", "mlp")
+    return train_model(num_workers, use_tpu, **kw)
+
+
 class TpuPredictor:
     """Stateful batch predictor (↔ TorchPredictor, my_ray_module.py:266-284):
     loads best weights once, then maps batches to logits + argmax."""
 
-    def __init__(self, checkpoint: Checkpoint | dict, cpu_only: bool = False):
+    def __init__(
+        self,
+        checkpoint: Checkpoint | dict,
+        cpu_only: bool = False,
+        *,
+        model=None,
+        sample_shape: tuple = (28, 28),
+    ):
         if isinstance(checkpoint, dict):
             checkpoint = Checkpoint.from_json(checkpoint)
         # cpu_only kept for signature parity; device choice belongs to jax.
         self._predictor = BatchPredictor.from_checkpoint(
             checkpoint,
-            NeuralNetwork(),
-            sample_input=np.zeros((1, 28, 28), np.float32),
+            model if model is not None else NeuralNetwork(),
+            sample_input=np.zeros((1, *sample_shape), np.float32),
         )
 
     def __call__(self, batch: dict) -> dict:
@@ -242,6 +283,7 @@ __all__ = [
     "set_weights_from_checkpoint",
     "train_fashion_mnist",
     "train_func_per_worker",
+    "train_model",
 ]
 
 

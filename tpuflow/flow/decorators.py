@@ -79,14 +79,15 @@ def card(type: str = "blank"):
     return wrap
 
 
-def device_profile(interval: float = 1.0):
+def device_profile(interval: float = 1.0, trace: bool = False):
     """Device metrics sampling during the step (↔ @gpu_profile(interval=1),
     train_flow.py:51): samples per-device memory stats every ``interval``
-    seconds on a background thread; the profile is saved as profile.json in
-    the task dir and summarized on the step card if one exists."""
+    seconds on a background thread into profile.json in the task dir.
+    ``trace=True`` additionally captures a ``jax.profiler`` trace of the
+    whole step (viewable in XProf/TensorBoard) under ``trace/``."""
 
     def wrap(fn: Callable) -> Callable:
-        fn.__device_profile__ = {"interval": interval}
+        fn.__device_profile__ = {"interval": interval, "trace": trace}
         return fn
 
     return wrap

@@ -268,6 +268,18 @@ class FlowRunner:
         join_inputs = getattr(flow, "_join_inputs", None)
         if join_inputs is not None:
             object.__setattr__(flow, "_join_inputs", None)
+        trace_ctx = None
+        if profile_cfg and profile_cfg.get("trace"):
+            import contextlib
+
+            import jax
+
+            trace_ctx = contextlib.ExitStack()
+            try:
+                jax.profiler.start_trace(os.path.join(tdir, "trace"))
+                trace_ctx.callback(jax.profiler.stop_trace)
+            except Exception:
+                trace_ctx = None
         try:
             if profiler:
                 with profiler:
@@ -285,6 +297,8 @@ class FlowRunner:
                 self.flow_name, run_id, step_name, task_id, flow._artifacts
             )
         finally:
+            if trace_ctx is not None:
+                trace_ctx.close()
             current.card = None
 
     @staticmethod
