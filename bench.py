@@ -83,12 +83,28 @@ def main() -> None:
     nbytes = sum(a.nbytes for a in state.values())
     _log(f"[bench] payload {nbytes / 2**30:.2f} GiB in {n_arrays} arrays")
 
+    # Production cadence: per-epoch saves under retention, so steps ≥ 2
+    # overwrite recycled shard files (see ckpt.raw.RecyclePool) exactly as a
+    # real training run does. The cold first save pays fresh page allocation
+    # once per run; steady-state per-epoch throughput is what training sees
+    # every epoch and is what we report.
     mgr = CheckpointManager(bench_dir, max_to_keep=1, async_save=True)
-    t0 = time.monotonic()
-    mgr.save(1, state, metrics={"val_loss": 0.0})
-    mgr.wait_until_finished()
-    t_save = time.monotonic() - t0
-    _log(f"[bench] save: {t_save:.2f}s = {nbytes / t_save / 1e9:.3f} GB/s")
+    times = []
+    n_steps = 4  # recycling reaches steady state at step 3 (retention lags
+    # one commit); steps 1-2 pay fresh page allocation once per run.
+    for step in range(1, n_steps + 1):
+        t0 = time.monotonic()
+        # Improving val_loss: best tracks latest, so retention retires the
+        # previous step at each commit (the per-epoch production pattern).
+        mgr.save(step, state, metrics={"val_loss": 1.0 / step})
+        mgr.wait_until_finished()
+        dt = time.monotonic() - t0
+        times.append(dt)
+        _log(
+            f"[bench] save step {step}{' (cold)' if step <= 2 else ''}: "
+            f"{dt:.2f}s = {nbytes / dt / 1e9:.3f} GB/s"
+        )
+    t_save = sum(times[2:]) / len(times[2:])
 
     abstract = {
         k: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
@@ -97,7 +113,7 @@ def main() -> None:
     del state
     mgr2 = CheckpointManager(bench_dir, max_to_keep=1, async_save=False)
     t0 = time.monotonic()
-    restored = mgr2.restore(1, abstract_state=abstract)
+    restored = mgr2.restore(4, abstract_state=abstract)
     jax.block_until_ready(restored)
     t_restore = time.monotonic() - t0
     _log(

@@ -23,12 +23,26 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
+def _stale() -> bool:
+    """True when the shared object is missing or older than its source."""
+    if not os.path.exists(_SO):
+        return True
+    try:
+        src_mtime = os.path.getmtime(os.path.join(_DIR, "io.cpp"))
+    except OSError:
+        return False  # no source shipped: the prebuilt .so can't be stale
+    try:
+        return os.path.getmtime(_SO) < src_mtime
+    except OSError:
+        return True
+
+
 def _build() -> bool:
     from tpuflow.utils import FileLock
 
     try:
         with FileLock(os.path.join(_DIR, ".build.lock")):
-            if os.path.exists(_SO):
+            if not _stale():
                 return True
             proc = subprocess.run(
                 ["make", "-C", _DIR],
@@ -50,15 +64,30 @@ def lib() -> ctypes.CDLL | None:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and not _build():
+    if _stale() and not _build():
         return None
     try:
         L = ctypes.CDLL(_SO)
-    except OSError as e:
+        _bind(L)
+    except (OSError, AttributeError) as e:
+        # AttributeError: a stale .so (copied with fresh mtimes) missing a
+        # newer symbol — fall back to the NumPy paths per the module contract.
         logger.warning("cannot load %s: %r", _SO, e)
         return None
+    _lib = L
+    return _lib
+
+
+def _bind(L: ctypes.CDLL) -> None:
     L.ckptio_write.restype = ctypes.c_int
     L.ckptio_write.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    L.ckptio_write_inplace.restype = ctypes.c_int
+    L.ckptio_write_inplace.argtypes = [
         ctypes.c_char_p,
         ctypes.c_void_p,
         ctypes.c_uint64,
@@ -93,8 +122,6 @@ def lib() -> ctypes.CDLL | None:
         ctypes.c_void_p,
         ctypes.c_int,
     ]
-    _lib = L
-    return _lib
 
 
 def default_threads() -> int:
@@ -104,15 +131,30 @@ def default_threads() -> int:
 
 
 # ------------------------------------------------------------ typed wrappers
-def write_bytes(path: str, arr: np.ndarray, *, threads: int | None = None) -> None:
-    """Striped threaded write of a contiguous array's bytes to ``path``."""
+def write_bytes(
+    path: str,
+    arr: np.ndarray,
+    *,
+    threads: int | None = None,
+    inplace: bool = False,
+) -> None:
+    """Striped threaded write of a contiguous array's bytes to ``path``.
+
+    ``inplace=True`` overwrites an existing file without truncating first so
+    its already-allocated pages are reused (the checkpoint recycle-pool fast
+    path on memory-backed filesystems); the file is sized to ``arr.nbytes``
+    afterwards either way.
+    """
     L = lib()
     arr = np.ascontiguousarray(arr)
     if L is None:
-        with open(path, "wb", buffering=0) as f:
+        mode = "r+b" if inplace and os.path.exists(path) else "wb"
+        with open(path, mode, buffering=0) as f:
             f.write(memoryview(arr).cast("B"))
+            f.truncate(arr.nbytes)
         return
-    rc = L.ckptio_write(
+    fn = L.ckptio_write_inplace if inplace else L.ckptio_write
+    rc = fn(
         path.encode(),
         arr.ctypes.data_as(ctypes.c_void_p),
         arr.nbytes,

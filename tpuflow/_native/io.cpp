@@ -80,13 +80,18 @@ int full_pread(int fd, char *buf, size_t count, off_t offset) {
 
 extern "C" {
 
-// Write `nbytes` from `data` to `path` with `nthreads` striped writers.
-// Returns 0 on success, else errno.
-int ckptio_write(const char *path, const void *data, uint64_t nbytes,
-                 int nthreads) {
-  int fd = open(path, O_CREAT | O_WRONLY | O_TRUNC, 0644);
+namespace {
+
+// Shared implementation: `truncate_first` picks between the fresh-file path
+// (O_TRUNC up front — releases the old pages) and the in-place path (keep
+// existing pages so filesystems backed by memory — tmpfs page cache — skip
+// the fresh-page zeroing cost; final ftruncate fixes the size either way).
+int write_impl(const char *path, const void *data, uint64_t nbytes,
+               int nthreads, bool truncate_first) {
+  int flags = O_CREAT | O_WRONLY | (truncate_first ? O_TRUNC : 0);
+  int fd = open(path, flags, 0644);
   if (fd < 0) return errno;
-  if (ftruncate(fd, static_cast<off_t>(nbytes)) != 0) {
+  if (truncate_first && ftruncate(fd, static_cast<off_t>(nbytes)) != 0) {
     int e = errno;
     close(fd);
     return e;
@@ -100,9 +105,30 @@ int ckptio_write(const char *path, const void *data, uint64_t nbytes,
     uint64_t len = std::min(stripe, nbytes - off);
     return full_pwrite(fd, base + off, len, static_cast<off_t>(off));
   });
+  if (!truncate_first && err == 0 &&
+      ftruncate(fd, static_cast<off_t>(nbytes)) != 0)
+    err = errno;
   if (fsync(fd) != 0 && err == 0) err = errno;
   if (close(fd) != 0 && err == 0) err = errno;
   return err;
+}
+
+}  // namespace
+
+// Write `nbytes` from `data` to `path` with `nthreads` striped writers.
+// Returns 0 on success, else errno.
+int ckptio_write(const char *path, const void *data, uint64_t nbytes,
+                 int nthreads) {
+  return write_impl(path, data, nbytes, nthreads, /*truncate_first=*/true);
+}
+
+// Same, but overwrite an existing (recycled) file in place instead of
+// truncating: on tmpfs/page-cache-backed storage this reuses the file's
+// already-faulted pages, which is several times faster than allocating and
+// zeroing fresh ones. Used by the checkpoint recycle pool.
+int ckptio_write_inplace(const char *path, const void *data, uint64_t nbytes,
+                         int nthreads) {
+  return write_impl(path, data, nbytes, nthreads, /*truncate_first=*/false);
 }
 
 // Read `nbytes` into `data` from `path` with `nthreads` striped readers.

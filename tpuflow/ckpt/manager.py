@@ -100,16 +100,49 @@ class CheckpointManager:
         if format not in ("raw", "orbax"):
             raise ValueError(f"unknown checkpoint format {format!r}")
         self.format = format
-        from tpuflow.ckpt.raw import AsyncRawSaver
+        from tpuflow.ckpt.raw import AsyncRawSaver, RecyclePool
 
         self._raw_saver = AsyncRawSaver()
+        # Retired step files are recycled (pages reused) instead of unlinked;
+        # see RecyclePool. Orbax manages its own files, so raw-only.
+        self._pool = (
+            RecyclePool(os.path.join(self.directory, ".recycle"))
+            if self.format == "raw"
+            else None
+        )
         self._ckptr = ocp.StandardCheckpointer()
         self._metrics_history: list[dict[str, Any]] = []
+        self._sweep_orphans()
         # Rebuild history from existing steps (in-run resume after retry).
         for step in self.all_steps():
             meta = self._read_meta(step)
             if meta and "metrics" in meta:
                 self._metrics_history.append({"step": step, **meta["metrics"]})
+
+    def _sweep_orphans(self) -> None:
+        """Reclaim step dirs whose save never committed (crash mid-write).
+
+        Uncommitted dirs (no ``metadata.json``) are invisible to
+        ``all_steps()`` and would otherwise leak storage forever; at manager
+        construction no save is in flight, so every uncommitted dir here is a
+        crash orphan — recycle (raw) or delete it."""
+        if jax.process_index() != 0:
+            return
+        try:
+            entries = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for name in entries:
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            if os.path.isdir(path) and not os.path.exists(
+                os.path.join(path, _META_FILE)
+            ):
+                if self._pool is not None:
+                    self._pool.adopt_dir(path)
+                else:
+                    shutil.rmtree(path, ignore_errors=True)
 
     # ------------------------------------------------------------------ paths
     def _step_dir(self, step: int) -> str:
@@ -122,7 +155,8 @@ class CheckpointManager:
         except (OSError, json.JSONDecodeError):
             return None
 
-    def all_steps(self) -> list[int]:
+    def _all_steps(self) -> list[int]:
+        """Completed steps on disk (no wait — safe on the saver thread)."""
         steps = []
         try:
             entries = os.listdir(self.directory)
@@ -139,16 +173,10 @@ class CheckpointManager:
                     steps.append(step)
         return sorted(steps)
 
-    def latest_step(self) -> int | None:
-        steps = self.all_steps()
-        return steps[-1] if steps else None
-
-    def best_step(self) -> int | None:
-        """Step with the best recorded ``best_metric`` (↔ best_model.pt
-        selection by val-loss improvement, my_ray_module.py:190-201)."""
+    def _best_step(self) -> int | None:
         best: tuple[float, int] | None = None
         sign = 1.0 if self.best_mode == "min" else -1.0
-        for step in self.all_steps():
+        for step in self._all_steps():
             meta = self._read_meta(step)
             if not meta:
                 continue
@@ -159,6 +187,20 @@ class CheckpointManager:
             if best is None or key < best:
                 best = key
         return best[1] if best else None
+
+    def all_steps(self) -> list[int]:
+        self.wait_until_finished()  # a step is visible once its save commits
+        return self._all_steps()
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def best_step(self) -> int | None:
+        """Step with the best recorded ``best_metric`` (↔ best_model.pt
+        selection by val-loss improvement, my_ray_module.py:190-201)."""
+        self.wait_until_finished()
+        return self._best_step()
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, state, metrics: dict | None = None) -> Checkpoint:
@@ -171,46 +213,72 @@ class CheckpointManager:
         self.wait_until_finished()
         step_dir = self._step_dir(step)
         state_dir = os.path.join(step_dir, _STATE_DIR)
+        # A retried step must first become invisible (stale metadata gone)
+        # before its old state is recycled and rewritten.
+        try:
+            os.unlink(os.path.join(step_dir, _META_FILE))
+        except FileNotFoundError:
+            pass
         if os.path.exists(state_dir):
-            shutil.rmtree(state_dir)  # overwrite a retried step cleanly
+            if self._pool is not None:
+                self._pool.adopt_dir(state_dir)  # recycle a retried step
+            else:
+                shutil.rmtree(state_dir)
         os.makedirs(step_dir, exist_ok=True)
-        if self.format == "raw":
-            self._raw_saver.save(state_dir, state)
-        else:
-            self._ckptr.save(state_dir, state)
-        if not self._async:
-            self.wait_until_finished()
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         self._metrics_history.append({"step": step, **metrics})
         meta = {
             "step": step,
             "metrics": metrics,
-            "metrics_history": self._metrics_history,
+            "metrics_history": list(self._metrics_history),
             "process_count": jax.process_count(),
             "device_count": jax.device_count(),
         }
-        if jax.process_index() == 0:
-            with open(os.path.join(step_dir, _META_FILE), "w") as f:
-                json.dump(meta, f)
-        self._retain()
+
+        def _commit() -> None:
+            # The step becomes visible (metadata.json present) only once its
+            # payload is fully on disk — ↔ Orbax's commit-marker semantics; a
+            # crash mid-write leaves an invisible directory — and only then
+            # is retention applied, so a crash never leaves fewer than
+            # ``max_to_keep`` complete checkpoints. Retired files land in the
+            # recycle pool in time for the *next* save to overwrite them.
+            if jax.process_index() == 0:
+                # Atomic marker: a crash mid-dump must not leave a visible
+                # step with unreadable metadata.
+                tmp = os.path.join(step_dir, _META_FILE + ".tmp")
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, os.path.join(step_dir, _META_FILE))
+            self._retain()
+
+        if self.format == "raw":
+            self._raw_saver.save(state_dir, state, pool=self._pool, on_commit=_commit)
+        else:
+            self._ckptr.save(state_dir, state)
+            _commit()
+        if not self._async:
+            self.wait_until_finished()
         return Checkpoint(path=step_dir, metadata=meta)
 
     def _retain(self) -> None:
-        """Keep the newest ``max_to_keep`` steps plus the best step."""
+        """Keep the newest ``max_to_keep`` steps plus the best step.
+
+        Runs on the saver thread right after a save commits (saves are
+        serialized by the wait in ``save()``, so every step seen here is
+        complete)."""
         if self.max_to_keep is None or jax.process_index() != 0:
             return
-        steps = self.all_steps()
+        steps = self._all_steps()
         keep = set(steps[-self.max_to_keep :]) if self.max_to_keep else set()
-        best = self.best_step()
+        best = self._best_step()
         if best is not None:
             keep.add(best)
-        doomed = [s for s in steps if s not in keep]
-        if doomed:
-            # Never delete a dir whose async save may still be writing: saves
-            # are serialized by the wait in save(), and metadata.json is only
-            # written after the save call returns, so completed steps are safe
-            # except possibly the newest — which is always in `keep`.
-            for s in doomed:
+        for s in steps:
+            if s in keep:
+                continue
+            if self._pool is not None:
+                self._pool.adopt_dir(self._step_dir(s))
+            else:
                 shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def wait_until_finished(self) -> None:
@@ -223,9 +291,12 @@ class CheckpointManager:
 
     # --------------------------------------------------------------- restore
     def _resolve_step(self, step: int | None, best: bool) -> int:
-        chosen = (
-            self.best_step() if best else self.latest_step()
-        ) if step is None else step
+        self.wait_until_finished()  # an in-flight save commits on its thread
+        if step is None:
+            steps = self._all_steps()
+            chosen = self._best_step() if best else (steps[-1] if steps else None)
+        else:
+            chosen = step
         if chosen is None or not os.path.isdir(self._step_dir(chosen)):
             raise FileNotFoundError(
                 f"no checkpoint {'(best)' if best else ''} found in {self.directory}"
@@ -302,7 +373,7 @@ def restore_from_handle(
                 if abstract_state is not None:
                     abstract = _abstractify(abstract_state)
                     params = jax.tree_util.tree_map(
-                        lambda arr, t: jax.device_put(
+                        lambda arr, t: raw_fmt._place(
                             arr.astype(t.dtype)
                             if arr.dtype != t.dtype
                             else arr,

@@ -37,6 +37,115 @@ MANIFEST = "manifest.json"
 FORMAT_NAME = "tpuflow-raw-v2"
 
 
+def _mmap_enabled() -> bool:
+    """Opt-in zero-copy restore via file mapping (TPUFLOW_CKPT_MMAP=1).
+
+    OFF by default for a correctness reason: ``jax.device_put`` on CPU
+    zero-copy *aliases* page-aligned host memory, so an array restored from a
+    mapped shard file shares pages with that file — and the recycle pool
+    overwrites retired shard files in place, which would silently mutate the
+    restored array. Only enable for strictly read-only consumers of finished
+    runs (e.g. batch eval); while enabled, this process's managers unlink
+    retired files instead of recycling them (see RecyclePool.adopt_dir).
+    """
+    return os.environ.get("TPUFLOW_CKPT_MMAP", "0") == "1"
+
+
+class RecyclePool:
+    """Pool of retired shard files whose pages get reused by later saves.
+
+    Retention hands doomed step directories to :meth:`adopt_dir`, which
+    renames their ``.bin`` files into the pool instead of unlinking them;
+    :meth:`take` hands a file back to a new save, which overwrites it in
+    place (``write_bytes(..., inplace=True)``). On memory-backed storage
+    (tmpfs staging tiers, page cache) this skips the fresh-page zeroing
+    that otherwise dominates checkpoint write cost — steady-state per-epoch
+    saves run at memcpy speed. Thread-safe: retention (main thread) and the
+    async saver (background thread) share one pool.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._lock = threading.Lock()
+        self._files: dict[int, list[str]] = {}  # size -> paths
+        self._counter = 0
+        if os.path.isdir(directory):
+            for name in os.listdir(directory):
+                path = os.path.join(directory, name)
+                try:
+                    self._files.setdefault(os.path.getsize(path), []).append(path)
+                except OSError:
+                    continue
+                # Seed the name counter past every surviving pool file so a
+                # restarted process never renames over a still-pooled inode.
+                try:
+                    self._counter = max(
+                        self._counter, int(name[1:].split(".")[0])
+                    )
+                except (ValueError, IndexError):
+                    self._counter += 1
+
+    def adopt_dir(self, step_dir: str) -> None:
+        """Absorb every ``.bin`` under ``step_dir`` and delete the rest."""
+        import shutil
+
+        if _mmap_enabled():
+            # Restored arrays may alias these files' pages — never reuse
+            # their inodes in place (see _mmap_enabled).
+            shutil.rmtree(step_dir, ignore_errors=True)
+            return
+        # The step must become invisible before its payload is harvested: a
+        # crash mid-adopt must not leave a committed-looking step with
+        # missing shard files. (When adopting a bare state/ dir the caller
+        # has already unlinked the metadata; this is then a no-op.)
+        try:
+            os.unlink(os.path.join(step_dir, "metadata.json"))
+        except OSError:
+            pass
+        os.makedirs(self.directory, exist_ok=True)
+        for root, _, names in os.walk(step_dir):
+            for name in names:
+                if not name.endswith(".bin"):
+                    continue
+                src = os.path.join(root, name)
+                with self._lock:
+                    self._counter += 1
+                    dst = os.path.join(self.directory, f"r{self._counter:08d}.bin")
+                    try:
+                        size = os.path.getsize(src)
+                        os.rename(src, dst)
+                    except OSError:
+                        continue
+                    self._files.setdefault(size, []).append(dst)
+        shutil.rmtree(step_dir, ignore_errors=True)
+
+    def take(self, nbytes: int) -> str | None:
+        """Pop a pooled file (exact-size match preferred) or None."""
+        with self._lock:
+            bucket = self._files.get(nbytes)
+            if bucket:
+                path = bucket.pop()
+                if not bucket:
+                    del self._files[nbytes]
+                return path
+            # Any file still beats a fresh one: overlapping pages are reused,
+            # the tail (if growing) faults like a fresh write.
+            for size in list(self._files):
+                bucket = self._files[size]
+                path = bucket.pop()
+                if not bucket:
+                    del self._files[size]
+                return path
+        return None
+
+    def clear(self) -> None:
+        import shutil
+
+        with self._lock:
+            self._files.clear()
+            shutil.rmtree(self.directory, ignore_errors=True)
+
+
 def _path_names(path) -> list[str]:
     names = []
     for entry in path:
@@ -84,13 +193,28 @@ def _gather_host(tree):
     return out
 
 
-def _write_entries(directory: str, host_leaves) -> None:
+def _write_one(directory: str, fname: str, arr, pool: RecyclePool | None) -> None:
+    dst = os.path.join(directory, fname)
+    recycled = pool.take(arr.nbytes) if pool is not None else None
+    if recycled is not None:
+        try:
+            os.rename(recycled, dst)
+            _native.write_bytes(dst, arr, inplace=True)
+            return
+        except OSError:
+            pass  # fall through to a fresh write
+    _native.write_bytes(dst, arr)
+
+
+def _write_entries(
+    directory: str, host_leaves, pool: RecyclePool | None = None
+) -> None:
     manifest = {"format": FORMAT_NAME, "leaves": []}
     for i, (names, shape, dtype, shards) in enumerate(host_leaves):
         entry = {"path": names, "shape": shape, "dtype": dtype, "shards": []}
         for j, (starts, arr) in enumerate(shards):
             fname = f"leaf_{i:05d}_{j:03d}.bin"
-            _native.write_bytes(os.path.join(directory, fname), arr)
+            _write_one(directory, fname, arr, pool)
             entry["shards"].append(
                 {"file": fname, "start": starts, "shape": list(arr.shape)}
             )
@@ -99,29 +223,44 @@ def _write_entries(directory: str, host_leaves) -> None:
         json.dump(manifest, f)
 
 
-def save_raw(directory: str, tree: Any) -> None:
+def save_raw(directory: str, tree: Any, pool: RecyclePool | None = None) -> None:
     """Write ``tree`` synchronously."""
     os.makedirs(directory, exist_ok=True)
-    _write_entries(directory, _gather_host(tree))
+    _write_entries(directory, _gather_host(tree), pool)
 
 
 class AsyncRawSaver:
     """Double-buffered async save: the device→host shard fetch happens
     synchronously (same contract as Orbax async — callers may donate device
-    buffers immediately), file IO runs on a background thread."""
+    buffers immediately), file IO runs on a background thread.
+
+    ``on_commit`` (if given) runs on the background thread strictly after all
+    shard files are on disk — the manager uses it to write ``metadata.json``,
+    so a step only becomes visible once its payload is complete (a crash
+    mid-write leaves an invisible directory, reclaimed by the next manager's
+    orphan sweep)."""
 
     def __init__(self):
         self._thread: threading.Thread | None = None
         self._error: list[BaseException] = []
 
-    def save(self, directory: str, tree: Any) -> None:
+    def save(
+        self,
+        directory: str,
+        tree: Any,
+        *,
+        pool: RecyclePool | None = None,
+        on_commit=None,
+    ) -> None:
         self.wait()
         os.makedirs(directory, exist_ok=True)
         host_leaves = _gather_host(tree)
 
         def _write():
             try:
-                _write_entries(directory, host_leaves)
+                _write_entries(directory, host_leaves, pool)
+                if on_commit is not None:
+                    on_commit()
             except BaseException as e:  # surfaced on next wait()
                 self._error.append(e)
 
@@ -148,24 +287,106 @@ def _read_manifest(directory: str) -> dict:
     return m
 
 
-def _read_shard(directory: str, shard: dict, dtype: np.dtype) -> np.ndarray:
+def _read_shard(
+    directory: str,
+    shard: dict,
+    dtype: np.dtype,
+    *,
+    allow_mmap: bool | None = None,
+    threads: int | None = None,
+) -> np.ndarray:
     nbytes = int(np.prod(shard["shape"]) * dtype.itemsize) if shard["shape"] else dtype.itemsize
-    buf = _native.read_bytes(os.path.join(directory, shard["file"]), nbytes)
+    path = os.path.join(directory, shard["file"])
+    if _mmap_enabled() if allow_mmap is None else allow_mmap:
+        # Zero-copy: map the file's pages instead of reading into a fresh
+        # buffer (copy-on-write so callers get a writable array without
+        # touching the checkpoint). Consumers that place onto devices copy
+        # exactly once, from the mapped pages.
+        try:
+            flat = np.memmap(path, dtype=np.uint8, mode="c", shape=(nbytes,))
+            return flat.view(dtype).reshape(shard["shape"])
+        except (OSError, ValueError):
+            pass  # zero-length or unmappable file: fall through
+    buf = _native.read_bytes(path, nbytes, threads=threads)
     return buf.view(dtype).reshape(shard["shape"])
 
 
-def _read_leaf(directory: str, entry: dict) -> np.ndarray:
+def _place(arr: np.ndarray, sharding) -> Any:
+    """Host array → sharded jax.Array via per-shard placement.
+
+    ``jax.device_put(arr, sharding)`` routes through a slow generic path for
+    sharded layouts; assembling from per-device slices is the fast path (each
+    device copies only its own contiguous window of the mapped pages).
+    """
+    shape = arr.shape
+    try:
+        index_map = sharding.addressable_devices_indices_map(shape)
+        shards = [
+            jax.device_put(np.ascontiguousarray(arr[index]), device)
+            for device, index in index_map.items()
+        ]
+        return jax.make_array_from_single_device_arrays(shape, sharding, shards)
+    except (TypeError, AttributeError, ValueError):
+        return jax.device_put(arr, sharding)
+
+
+def _resolve_index(index, shape) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """A device's index (tuple of slices) → (starts, extents)."""
+    starts, extents = [], []
+    for sl, dim in zip(index, shape):
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else dim
+        starts.append(start)
+        extents.append(stop - start)
+    return tuple(starts), tuple(extents)
+
+
+def _plan_entry(entry: dict, tmpl) -> list | None:
+    """Aligned-restore plan for one manifest entry: ``[(device, shard), …]``
+    when every device's required slice coincides with a saved shard file
+    (restoring onto the sharding the state was saved with — the common
+    case); None when host assembly + resharding is needed instead."""
+    sharding = getattr(tmpl, "sharding", None)
+    if sharding is None:
+        return None
+    shape = tuple(entry["shape"])
+    try:
+        index_map = sharding.addressable_devices_indices_map(shape)
+        lookup = {
+            (tuple(s["start"]), tuple(s["shape"])): s for s in entry["shards"]
+        }
+        placements = []
+        for device, index in index_map.items():
+            shard = lookup.get(_resolve_index(index, shape))
+            if shard is None:
+                return None
+            placements.append((device, shard))
+        return placements
+    except (TypeError, AttributeError, ValueError):
+        return None
+
+
+def _cast(arr: np.ndarray, tmpl) -> np.ndarray:
+    dtype = getattr(tmpl, "dtype", None)
+    return arr if dtype is None or arr.dtype == dtype else arr.astype(dtype)
+
+
+def _read_leaf(
+    directory: str, entry: dict, *, threads: int | None = None
+) -> np.ndarray:
     dtype = np.dtype(entry["dtype"])
     shards = entry["shards"]
     if len(shards) == 1 and shards[0]["shape"] == entry["shape"]:
-        return _read_shard(directory, shards[0], dtype)
+        return _read_shard(directory, shards[0], dtype, threads=threads)
     full = np.empty(entry["shape"], dtype)
     for shard in shards:
         idx = tuple(
             slice(start, start + dim)
             for start, dim in zip(shard["start"], shard["shape"])
         )
-        full[idx] = _read_shard(directory, shard, dtype)
+        # The copy into `full` makes the data private, so mapping the shard
+        # file here is always safe (no alias escapes).
+        full[idx] = _read_shard(directory, shard, dtype, allow_mmap=True)
     return full
 
 
@@ -200,16 +421,80 @@ def restore_raw(
             raise ValueError(
                 f"template has {len(flat)} leaves, checkpoint {len(entries)}"
             )
-        out = []
-        for tmpl, entry in zip(flat, entries):
-            arr = _read_leaf(directory, entry)
-            dtype = getattr(tmpl, "dtype", None)
-            if dtype is not None and arr.dtype != dtype:
-                arr = arr.astype(dtype)
-            sharding = getattr(tmpl, "sharding", None)
-            out.append(
-                jax.device_put(arr, sharding) if sharding is not None else arr
+        # Restore parallelism is at SHARD granularity: every (device, shard
+        # file) pair is an independent read+place task (file IO and device
+        # copies are C++-side with the GIL released), so faults and copies
+        # overlap across all cores — the multi-host analogue is every host
+        # reading only its own shards concurrently.
+        from concurrent.futures import ThreadPoolExecutor
+
+        aligned = [_plan_entry(entry, tmpl) for tmpl, entry in zip(flat, entries)]
+
+        # One task per unique shard FILE: replicated leaves map several
+        # devices onto one file, which is read once and placed per device
+        # inside the task (no IO amplification). Sharded leaves get one task
+        # per shard. File IO and device copies are C++-side with the GIL
+        # released, so tasks overlap across cores.
+        grouped = []  # per aligned entry: list[(shard, [devices])]
+        n_tasks = 0
+        for plan in aligned:
+            if plan is None:
+                n_tasks += 1
+                grouped.append(None)
+                continue
+            by_file: dict[str, tuple[dict, list]] = {}
+            for dev, shard in plan:
+                by_file.setdefault(shard["file"], (shard, []))[1].append(dev)
+            grouped.append(list(by_file.values()))
+            n_tasks += len(by_file)
+        workers = min(n_tasks, _native.default_threads()) or 1
+        # Each pooled task gets its slice of the native-reader thread budget
+        # so task-level parallelism doesn't multiply into oversubscription.
+        read_threads = max(1, _native.default_threads() // workers)
+
+        def read_group(entry, tmpl, shard, devices):
+            arr = _cast(
+                _read_shard(
+                    directory, shard, np.dtype(entry["dtype"]), threads=read_threads
+                ),
+                tmpl,
             )
+            return [jax.device_put(arr, dev) for dev in devices]
+
+        def assemble_fallback(entry, tmpl):
+            arr = _cast(_read_leaf(directory, entry, threads=read_threads), tmpl)
+            sharding = getattr(tmpl, "sharding", None)
+            return _place(arr, sharding) if sharding is not None else arr
+
+        with ThreadPoolExecutor(workers) as pool:
+            futures = []
+            for (tmpl, entry), groups in zip(zip(flat, entries), grouped):
+                if groups is None:
+                    futures.append(
+                        (None, pool.submit(assemble_fallback, entry, tmpl))
+                    )
+                else:
+                    futures.append(
+                        (
+                            (tmpl, entry),
+                            [
+                                pool.submit(read_group, entry, tmpl, shard, devs)
+                                for shard, devs in groups
+                            ],
+                        )
+                    )
+            out = []
+            for key, fs in futures:
+                if key is None:
+                    out.append(fs.result())
+                else:
+                    tmpl, entry = key
+                    shards = [a for f in fs for a in f.result()]
+                    out.append(
+                        jax.make_array_from_single_device_arrays(
+                            tuple(entry["shape"]), tmpl.sharding, shards
+                        )
+                    )
         return jax.tree_util.tree_unflatten(treedef, out)
 
     # Path-based nested-dict reconstruction.
