@@ -119,7 +119,25 @@ def train_func_per_worker(config: dict) -> None:
     state = create_train_state(
         model, jax.random.PRNGKey(config.get("seed", 0)), sample, tx
     )
-    if config.get("checkpoint") is not None:
+    start_epoch = 0
+    mgr = ctx.checkpoint_manager
+    in_run_step = mgr.latest_step() if mgr is not None else None
+    if in_run_step is not None:
+        # In-run fault tolerance (SURVEY.md §5): a retried gang step resumes
+        # FULL state from its own run's newest retained checkpoint before
+        # considering cross-run warm starts — the reference's @retry
+        # (train_flow.py:41) only gives a blind from-scratch rerun; with
+        # per-epoch retention this loses at most one epoch.
+        restored = mgr.restore(in_run_step, abstract_state=_state_tree(state))
+        state = state.replace(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            batch_stats=restored.get("batch_stats", state.batch_stats),
+        )
+        start_epoch = int(in_run_step)
+        _log(f"in-run resume: restored retained step {in_run_step} after retry")
+    elif config.get("checkpoint") is not None:
         ckpt = config["checkpoint"]
         if isinstance(ckpt, dict):
             ckpt = Checkpoint.from_json(ckpt)
@@ -151,7 +169,7 @@ def train_func_per_worker(config: dict) -> None:
     rng = jax.random.PRNGKey(config.get("seed", 0) + 1)
 
     start = time.monotonic()
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         epoch_start = time.monotonic()
         if world > 1:
             # parity: sampler.set_epoch only when world > 1

@@ -34,3 +34,9 @@ def mesh8():
     from tpuflow import dist
 
     return dist.make_mesh({"data": 8})
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-process/integration test"
+    )

@@ -123,3 +123,45 @@ def test_fashion_mnist_end_to_end_with_resume(tmp_path):
         [int(o["predicted_values"]) == r["labels"] for o, r in zip(out, rows)]
     )
     assert acc > 0.3
+
+
+def test_retry_resumes_from_own_runs_latest_checkpoint(tmp_path, capsys):
+    """Fault injection (SURVEY.md §4): a retried step reruns against the SAME
+    storage path and must resume full state from the newest retained
+    checkpoint instead of restarting at epoch 0 — at most one epoch lost."""
+    import my_tpu_module as m
+
+    storage = str(tmp_path / "run")
+    # "Crash" after epoch 1: a first attempt that only completes 1 of 3 epochs.
+    first = m.train_fashion_mnist(
+        num_workers=8,
+        checkpoint_storage_path=storage,
+        global_batch_size=64,
+        epochs=1,
+        lr=0.05,
+        data_dir=str(tmp_path / "data"),
+    )
+    assert len(first.metrics_history) == 1
+    capsys.readouterr()
+
+    # The retry: same storage path, full target epoch count.
+    retried = m.train_fashion_mnist(
+        num_workers=8,
+        checkpoint_storage_path=storage,
+        global_batch_size=64,
+        epochs=3,
+        lr=0.05,
+        data_dir=str(tmp_path / "data"),
+    )
+    out = capsys.readouterr()
+    combined = out.out + out.err
+    assert "in-run resume: restored retained step 1" in combined
+    # The retry trained only the 2 missing epochs...
+    assert len(retried.metrics_history) == 2
+    # ...and the checkpoint metadata's history spans all 3 (1 rebuilt + 2 new).
+    from tpuflow.ckpt import CheckpointManager
+
+    meta = CheckpointManager(
+        os.path.join(storage, "checkpoints")
+    ).restore_metadata()
+    assert [h["step"] for h in meta["metrics_history"]] == [1, 2, 3]
