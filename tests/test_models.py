@@ -64,3 +64,36 @@ def test_gpt2_weight_tying():
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     assert variables["params"]["wte"].shape == (cfg.vocab_size, cfg.n_embd)
     assert "lm_head" not in variables["params"]  # tied to wte
+
+
+def test_gpt2_remat_matches_nonremat():
+    """remat=True trades FLOPs for memory without changing the math: same
+    params, same logits, and the train step still compiles and runs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.models.gpt2 import GPT2, GPT2Config
+
+    tokens = np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % 512
+    cfgs = [
+        GPT2Config.small_test(dropout=0.0, remat=False),
+        GPT2Config.small_test(dropout=0.0, remat=True),
+    ]
+    outs, grads = [], []
+    for cfg in cfgs:
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+        def loss_fn(p, model=model):
+            logits = model.apply({"params": p}, tokens, train=True)
+            return jnp.mean(logits**2)
+
+        loss, g = jax.jit(jax.value_and_grad(loss_fn))(params)
+        outs.append(float(loss))
+        grads.append(g)
+    assert np.isclose(outs[0], outs[1], rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads[0]), jax.tree_util.tree_leaves(grads[1])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

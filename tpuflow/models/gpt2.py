@@ -28,6 +28,11 @@ class GPT2Config:
     dropout: float = 0.1
     attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
     dtype: jnp.dtype = jnp.float32  # activation dtype; bfloat16 on TPU
+    # Rematerialize each block on the backward pass (jax.checkpoint): peak
+    # activation memory drops from O(n_layer·B·T·C) to O(B·T·C) + one block's
+    # intermediates, the standard HBM-for-FLOPs trade for long-context /
+    # large-model training on TPU.
+    remat: bool = False
 
     @classmethod
     def small_test(cls, **kw) -> "GPT2Config":
@@ -55,7 +60,7 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -103,8 +108,11 @@ class GPT2(nn.Module):
         )
         x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        block_cls = (
+            nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+        )
         for i in range(cfg.n_layer):
-            x = Block(cfg, name=f"h{i}")(x, train=train)
+            x = block_cls(cfg, name=f"h{i}")(x, train)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Weight-tied LM head; logits in float32 for a stable softmax/CE.
         return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(
