@@ -96,7 +96,10 @@ class CheckpointManager:
         # (multi-host sharded writes). 'auto' picks raw when possible.
         format = os.environ.get("TPUFLOW_CKPT_FORMAT", format)
         if format == "auto":
-            format = "raw" if jax.process_count() == 1 else "orbax"
+            # The native raw format handles both single- and multi-host
+            # states (each host writes its own shards); Orbax/ocdbt stays
+            # available via TPUFLOW_CKPT_FORMAT=orbax.
+            format = "raw"
         if format not in ("raw", "orbax"):
             raise ValueError(f"unknown checkpoint format {format!r}")
         self.format = format
@@ -112,7 +115,17 @@ class CheckpointManager:
         )
         self._ckptr = ocp.StandardCheckpointer()
         self._metrics_history: list[dict[str, Any]] = []
+        self._pending_commit = None  # multi-host raw: commit deferred to drain
+        # Multi-host: construction is collective (like every other manager
+        # operation) — the barriers ensure no host is already writing while
+        # process 0 sweeps, and no host starts writing before the sweep ends.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpuflow_ckpt_mgr_preinit")
         self._sweep_orphans()
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices("tpuflow_ckpt_mgr_swept")
         # Rebuild history from existing steps (in-run resume after retry).
         for step in self.all_steps():
             meta = self._read_meta(step)
@@ -213,17 +226,32 @@ class CheckpointManager:
         self.wait_until_finished()
         step_dir = self._step_dir(step)
         state_dir = os.path.join(step_dir, _STATE_DIR)
-        # A retried step must first become invisible (stale metadata gone)
-        # before its old state is recycled and rewritten.
-        try:
-            os.unlink(os.path.join(step_dir, _META_FILE))
-        except FileNotFoundError:
-            pass
-        if os.path.exists(state_dir):
-            if self._pool is not None:
-                self._pool.adopt_dir(state_dir)  # recycle a retried step
-            else:
-                shutil.rmtree(state_dir)
+
+        def _clean_stale() -> None:
+            # A retried step must first become invisible (stale metadata
+            # gone) before its old state is recycled and rewritten.
+            try:
+                os.unlink(os.path.join(step_dir, _META_FILE))
+            except FileNotFoundError:
+                pass
+            if os.path.exists(state_dir):
+                if self._pool is not None:
+                    self._pool.adopt_dir(state_dir)  # recycle a retried step
+                else:
+                    shutil.rmtree(state_dir)
+
+        if jax.process_count() > 1:
+            # Shared-directory mutation is process 0's job, fenced so no
+            # other host is writing yet (first barrier) and none starts
+            # before the cleanup is done (second barrier).
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpuflow_ckpt_save_prep")
+            if jax.process_index() == 0:
+                _clean_stale()
+            multihost_utils.sync_global_devices("tpuflow_ckpt_save_prepped")
+        else:
+            _clean_stale()
         os.makedirs(step_dir, exist_ok=True)
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         self._metrics_history.append({"step": step, **metrics})
@@ -235,7 +263,7 @@ class CheckpointManager:
             "device_count": jax.device_count(),
         }
 
-        def _commit() -> None:
+        def _commit(merge: bool = False) -> None:
             # The step becomes visible (metadata.json present) only once its
             # payload is fully on disk — ↔ Orbax's commit-marker semantics; a
             # crash mid-write leaves an invisible directory — and only then
@@ -243,6 +271,10 @@ class CheckpointManager:
             # ``max_to_keep`` complete checkpoints. Retired files land in the
             # recycle pool in time for the *next* save to overwrite them.
             if jax.process_index() == 0:
+                if merge:
+                    from tpuflow.ckpt import raw as raw_fmt
+
+                    raw_fmt.merge_manifests(state_dir)
                 # Atomic marker: a crash mid-dump must not leave a visible
                 # step with unreadable metadata.
                 tmp = os.path.join(step_dir, _META_FILE + ".tmp")
@@ -252,7 +284,17 @@ class CheckpointManager:
             self._retain()
 
         if self.format == "raw":
-            self._raw_saver.save(state_dir, state, pool=self._pool, on_commit=_commit)
+            if jax.process_count() > 1:
+                # Multi-host: every host writes its own shards; the commit
+                # needs an all-hosts barrier (a collective), which must run
+                # on the MAIN thread — it happens in wait_until_finished(),
+                # which the next save()/restore()/query drains through.
+                self._raw_saver.save(state_dir, state, pool=self._pool)
+                self._pending_commit = _commit
+            else:
+                self._raw_saver.save(
+                    state_dir, state, pool=self._pool, on_commit=_commit
+                )
         else:
             self._ckptr.save(state_dir, state)
             _commit()
@@ -283,7 +325,30 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self._ckptr.wait_until_finished()
-        self._raw_saver.wait()
+        try:
+            self._raw_saver.wait()
+        except BaseException:
+            # Never publish a step whose shard writes failed: discard the
+            # commit. Peers block in the commit barrier until the collective
+            # times out and the coordination service propagates the failure —
+            # a loud step failure handled by the retry layer.
+            self._pending_commit = None
+            raise
+        pending = self._pending_commit
+        if pending is not None:
+            self._pending_commit = None
+            # All hosts' local writes are done; barrier so the merged
+            # manifest covers every host's shards. SPMD contract: every
+            # process drains saves at the same program points (report/
+            # restore/queries), exactly like any other collective.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpuflow_ckpt_commit")
+            pending(merge=True)
+            # Second barrier: no host may read the step (restore right after
+            # a drain) until process 0 has written the merged manifest and
+            # the metadata marker.
+            multihost_utils.sync_global_devices("tpuflow_ckpt_committed")
 
     def close(self) -> None:
         self.wait_until_finished()

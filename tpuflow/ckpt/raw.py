@@ -16,9 +16,13 @@ dtypes / shard index offsets. No chunking, no compression, no gather:
 - partial restore (e.g. the params subtree for weights-only warm starts)
   reads only the matching files.
 
-Scope: leaves must be fully addressable (single-host runs, or replicated on
-any topology). The manager automatically uses Orbax for multi-host sharded
-state — both formats share the manager's layout and policies.
+Multi-host: each process writes only the shards it owns (``replica_id == 0``
+filter — disjoint across hosts, so per-host bandwidth adds up) plus a
+manifest fragment; process 0 merges fragments into the unified manifest at
+commit, after an all-hosts barrier. Restore reads only the files backing the
+local devices of the target sharding. Orbax/ocdbt remains available via
+``TPUFLOW_CKPT_FORMAT=orbax`` — both formats share the manager's layout and
+policies.
 """
 
 from __future__ import annotations
@@ -161,15 +165,16 @@ def _path_names(path) -> list[str]:
 
 
 def _leaf_shards(leaf) -> list[tuple[list[int], np.ndarray]]:
-    """(start_indices, host_array) per distinct shard of a leaf."""
+    """(start_indices, host_array) per locally-owned shard of a leaf.
+
+    Ownership = ``replica_id == 0``: across the whole mesh exactly one copy
+    of every distinct shard has replica 0, so N hosts each write only their
+    own disjoint shard set (per-host write bandwidth adds up — the
+    multi-host production model the ≥2 GB/s/chip target presumes) and
+    replicated leaves are written exactly once globally. A host owning no
+    replica-0 shard of a leaf returns [] for it.
+    """
     if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
-        if not leaf.is_fully_addressable:
-            raise ValueError(
-                "raw format needs fully-addressable arrays; use format='orbax' "
-                "for multi-host sharded state"
-            )
-        if leaf.sharding.is_fully_replicated:
-            return [([0] * leaf.ndim, np.asarray(leaf.addressable_shards[0].data))]
         out = []
         for shard in leaf.addressable_shards:
             if shard.replica_id != 0:
@@ -179,17 +184,28 @@ def _leaf_shards(leaf) -> list[tuple[list[int], np.ndarray]]:
             ]
             out.append((starts, np.asarray(shard.data)))
         return out
+    # Non-jax leaves (host scalars, plain numpy) exist identically on every
+    # process: the same ownership rule applies — process 0 writes, the rest
+    # contribute no shard (otherwise N hosts race on one shared file).
+    if jax.process_index() != 0:
+        return []
     arr = np.asarray(leaf)
     return [([0] * arr.ndim, arr)]
 
 
 def _gather_host(tree):
-    """Synchronous device→host stage: (path, full_shape, dtype, shards)."""
+    """Synchronous device→host stage: (path, full_shape, dtype, shards).
+
+    Every process lists every leaf (the pytree is global), each with only
+    its locally-owned shards — possibly none on this process."""
     out = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         shards = _leaf_shards(leaf)
-        shape = list(getattr(leaf, "shape", shards[0][1].shape))
-        out.append((_path_names(path), shape, shards[0][1].dtype.str, shards))
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            shape, dtype = list(leaf.shape), np.dtype(leaf.dtype).str
+        else:
+            shape, dtype = list(shards[0][1].shape), shards[0][1].dtype.str
+        out.append((_path_names(path), shape, dtype, shards))
     return out
 
 
@@ -209,18 +225,60 @@ def _write_one(directory: str, fname: str, arr, pool: RecyclePool | None) -> Non
 def _write_entries(
     directory: str, host_leaves, pool: RecyclePool | None = None
 ) -> None:
+    """Write this process's shards. Single-process: the unified manifest is
+    written directly. Multi-process: each process writes a manifest FRAGMENT
+    (``manifest.p<rank>.json``) listing only the shards it owns; process 0
+    merges fragments at commit time (``merge_manifests``) after the
+    cross-process barrier, so the unified manifest — and hence step
+    visibility — appears only once every host's shards are on storage."""
     manifest = {"format": FORMAT_NAME, "leaves": []}
     for i, (names, shape, dtype, shards) in enumerate(host_leaves):
         entry = {"path": names, "shape": shape, "dtype": dtype, "shards": []}
-        for j, (starts, arr) in enumerate(shards):
-            fname = f"leaf_{i:05d}_{j:03d}.bin"
+        for starts, arr in shards:
+            # Start coordinates are globally unique per distinct shard, so
+            # hosts never collide on names and the merge is a plain union.
+            coord = "x".join(map(str, starts)) or "0"
+            fname = f"leaf_{i:05d}_{coord}.bin"
             _write_one(directory, fname, arr, pool)
             entry["shards"].append(
                 {"file": fname, "start": starts, "shape": list(arr.shape)}
             )
         manifest["leaves"].append(entry)
+    if jax.process_count() > 1:
+        frag = os.path.join(directory, f"manifest.p{jax.process_index():05d}.json")
+        with open(frag + ".tmp", "w") as f:
+            json.dump(manifest, f)
+        os.replace(frag + ".tmp", frag)
+        return
     with open(os.path.join(directory, MANIFEST), "w") as f:
         json.dump(manifest, f)
+
+
+def merge_manifests(directory: str) -> None:
+    """Union all manifest fragments into the unified manifest (process 0,
+    after the all-hosts barrier). Fragments agree on leaf order/shape/dtype
+    (the pytree is global); shard lists are disjoint unions."""
+    names = sorted(
+        n for n in os.listdir(directory)
+        if n.startswith("manifest.p") and n.endswith(".json")
+    )
+    if not names:
+        raise FileNotFoundError(f"no manifest fragments in {directory}")
+    merged: dict | None = None
+    for name in names:
+        with open(os.path.join(directory, name)) as f:
+            frag = json.load(f)
+        if merged is None:
+            merged = frag
+            continue
+        for entry, add in zip(merged["leaves"], frag["leaves"]):
+            entry["shards"].extend(add["shards"])
+    with open(os.path.join(directory, MANIFEST + ".tmp"), "w") as f:
+        json.dump(merged, f)
+    os.replace(
+        os.path.join(directory, MANIFEST + ".tmp"),
+        os.path.join(directory, MANIFEST),
+    )
 
 
 def save_raw(directory: str, tree: Any, pool: RecyclePool | None = None) -> None:
