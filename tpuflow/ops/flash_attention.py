@@ -93,31 +93,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
-    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
-    v = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (block_q, block_k)
-    if causal:
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    def _compute():
+        # MXU feeds stay in the input dtype (bf16 multiplies at full MXU
+        # rate); accumulation is f32 via preferred_element_type. Only the
+        # softmax statistics run in f32 on the VPU.
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k) f32
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
-    m_old = m_scr[:, 0]
-    m_new = jnp.maximum(m_old, s.max(axis=-1))
-    p = jnp.exp(s - m_new[:, None])
-    corr = jnp.exp(m_old - m_new)
-    l_new = l_scr[:, 0] * corr + p.sum(axis=-1)
-    acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    m_scr[:, 0] = m_new
-    l_scr[:, 0] = l_new
+        m_old = m_scr[:, 0]
+        m_new = jnp.maximum(m_old, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_old - m_new)
+        l_new = l_scr[:, 0] * corr + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_new
+
+    if causal:
+        # Causal block skip: a KV block strictly above the diagonal is fully
+        # masked — skip its compute entirely (~2x fewer FLOPs at long T).
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _maybe():
+            _compute()
+    else:
+        _compute()
 
     @pl.when(ik == nk - 1)
     def _final():
@@ -154,6 +166,11 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom (col 0)
             pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
         ],
+        # batch·head and q-block programs are independent; the k loop is a
+        # sequential reduction (carries the softmax state in scratch).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
