@@ -29,7 +29,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpuflow import dist  # noqa: E402
 from tpuflow.ckpt import Checkpoint, restore_from_handle  # noqa: E402
-from tpuflow.data import get_dataloaders, get_labels_map  # noqa: E402
+from tpuflow.data import (  # noqa: E402
+    get_dataloaders,
+    get_labels_map,
+    prefetch_to_device,
+)
 from tpuflow.infer import BatchPredictor, map_batches  # noqa: E402
 from tpuflow.models import NeuralNetwork, get_model  # noqa: E402
 from tpuflow.train import (  # noqa: E402
@@ -176,10 +180,12 @@ def train_func_per_worker(config: dict) -> None:
             # (my_ray_module.py:149-151)
             train_loader.set_epoch(epoch)
         n_batches = 0
-        for batch in train_loader:
-            placed = dist.shard_batch(
-                {"x": batch["x"], "y": batch["y"]}, ctx.mesh
-            )
+        # Batch assembly + host→device placement run one batch ahead on a
+        # background thread while the devices crunch (async dispatch): the
+        # input pipeline hides behind compute.
+        for placed in prefetch_to_device(
+            train_loader, ctx.mesh, keys=("x", "y")
+        ):
             state, train_metrics = train_step(state, placed, rng)
             n_batches += 1
         # Block before timing/eval: keeps host and devices in step (and on the

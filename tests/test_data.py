@@ -157,3 +157,36 @@ def test_filelock_mutual_exclusion(tmp_path):
     for i in range(0, 6, 2):
         assert order[i].endswith("-in") and order[i + 1].endswith("-out")
         assert order[i].split("-")[0] == order[i + 1].split("-")[0]
+
+
+def test_prefetch_to_device_matches_direct_iteration(mesh8):
+    """The prefetch pipeline yields exactly the batches the loader produces,
+    in order, already placed on the mesh; early break doesn't wedge."""
+    import numpy as np
+
+    from tpuflow import dist
+    from tpuflow.data import prefetch_to_device
+    from tpuflow.data.datasets import Split
+    from tpuflow.data.loader import ShardedLoader
+
+    images = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+    labels = np.arange(64, dtype=np.int64) % 10
+    split = Split(images=images, labels=labels)
+    mk = lambda: ShardedLoader(split, batch_size=16, shuffle=True, seed=3)
+
+    direct = [
+        {k: np.asarray(v) for k, v in b.items()} for b in mk()
+    ]
+    placed = list(prefetch_to_device(mk(), mesh8, keys=("x", "y")))
+    assert len(placed) == len(direct)
+    for d, p in zip(direct, placed):
+        assert set(p) == {"x", "y"}
+        np.testing.assert_array_equal(np.asarray(p["x"]), d["x"])
+        np.testing.assert_array_equal(np.asarray(p["y"]), d["y"])
+        # Batch axis is sharded over the mesh's data axes.
+        assert len(p["x"].sharding.device_set) == 8
+
+    # Early break: generator closes cleanly.
+    gen = prefetch_to_device(mk(), mesh8)
+    next(gen)
+    gen.close()

@@ -6,13 +6,18 @@ from tpuflow.data.datasets import (
     get_labels_map,
     load_dataset,
 )
-from tpuflow.data.loader import ShardedLoader, get_dataloaders
+from tpuflow.data.loader import (
+    ShardedLoader,
+    get_dataloaders,
+    prefetch_to_device,
+)
 
 __all__ = [
     "Dataset",
     "ShardedLoader",
     "Split",
     "get_dataloaders",
+    "prefetch_to_device",
     "get_labels_map",
     "load_dataset",
 ]

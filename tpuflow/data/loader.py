@@ -115,6 +115,62 @@ class ShardedLoader:
             }
 
 
+def prefetch_to_device(loader, mesh, *, depth: int = 2, keys=None):
+    """Pipeline batch assembly + host→device placement against compute.
+
+    A background thread assembles batches (the threaded C++ gather) and
+    places them on the mesh (``dist.shard_batch``) up to ``depth`` ahead,
+    while the main thread's jitted steps run — double-buffering the host
+    side of the input pipeline the way ``prepare_data_loader``'s device
+    iterator does in the reference stack (my_ray_module.py:128-129). Safe
+    under multi-host: placement is per-process local (no collectives).
+
+    ``keys``: optional subset of batch entries to keep (e.g. ("x", "y")).
+    """
+    import queue
+    import threading
+
+    from tpuflow import dist
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    done = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker():
+        try:
+            for batch in loader:
+                if keys is not None:
+                    batch = {k: batch[k] for k in keys}
+                if not _put(dist.shard_batch(batch, mesh)):
+                    return  # consumer went away (early break)
+            _put(done)
+        except BaseException as e:  # surfaced on the consuming thread
+            _put(e)
+
+    thread = threading.Thread(target=_worker, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        thread.join(timeout=1.0)
+
+
 def get_dataloaders(
     batch_size: int,
     *,
