@@ -165,3 +165,31 @@ def test_retry_resumes_from_own_runs_latest_checkpoint(tmp_path, capsys):
         os.path.join(storage, "checkpoints")
     ).restore_metadata()
     assert [h["step"] for h in meta["metrics_history"]] == [1, 2, 3]
+
+
+def test_report_streams_metrics_jsonl(tmp_path):
+    """Each report appends one JSON line to <storage>/metrics.jsonl on
+    process 0 (the tail-able observability stream)."""
+    import json
+
+    from tpuflow.train import RunConfig
+
+    storage = str(tmp_path / "run")
+
+    def loop(config):
+        ctx = get_context()
+        ctx.report({"val_loss": 1.0})
+        ctx.report({"val_loss": 0.5, "accuracy": 0.9})
+
+    Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=storage),
+    ).fit()
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(storage, "metrics.jsonl"))
+    ]
+    assert [line["step"] for line in lines] == [1, 2]
+    assert lines[1]["accuracy"] == 0.9
+    assert all("time" in line for line in lines)

@@ -22,6 +22,7 @@ ray.train.report / Result as the reference exercises them
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import time
@@ -160,9 +161,20 @@ class TrainContext:
             for k, v in metrics.items()
         }
         self._reported.append(metrics)
+        save_step = step if step is not None else len(self._reported)
         if state is not None and self._manager is not None:
-            save_step = step if step is not None else len(self._reported)
             self._manager.save(save_step, state, metrics=metrics)
+        if self.run_config.storage_path and jax.process_index() == 0:
+            # Observability stream (SURVEY.md §5): one JSON line per report,
+            # aggregated on process 0, appendable/tail-able during the run.
+            with open(
+                os.path.join(self.run_config.storage_path, "metrics.jsonl"),
+                "a",
+            ) as f:
+                f.write(
+                    json.dumps({"step": save_step, "time": time.time(), **metrics})
+                    + "\n"
+                )
         if self.run_config.verbose:
             logger.info("report[%d]: %s", len(self._reported), metrics)
         dist.barrier("report")
