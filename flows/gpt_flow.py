@@ -62,10 +62,17 @@ class TpuGptTrain(FlowSpec):
     def _config(self):
         from tpuflow.models.gpt2 import GPT2Config
 
+        # Full-size presets scan the layer stack (compile time independent
+        # of depth) and rematerialize blocks (activation memory independent
+        # of depth) — the TPU-first defaults for real training.
         if self.preset == "medium":
-            return GPT2Config.medium(attn_impl=self.attn_impl)
+            return GPT2Config.medium(
+                attn_impl=self.attn_impl, scan_layers=True, remat=True
+            )
         if self.preset == "gpt2":
-            return GPT2Config(attn_impl=self.attn_impl)
+            return GPT2Config(
+                attn_impl=self.attn_impl, scan_layers=True, remat=True
+            )
         return GPT2Config.small_test(
             attn_impl=self.attn_impl, n_ctx=max(128, self.seq_len)
         )

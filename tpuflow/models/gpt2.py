@@ -33,6 +33,12 @@ class GPT2Config:
     # intermediates, the standard HBM-for-FLOPs trade for long-context /
     # large-model training on TPU.
     remat: bool = False
+    # Roll the layer stack into one nn.scan'd block: the transformer block is
+    # traced/compiled ONCE instead of n_layer times (compile time stops
+    # scaling with depth) and params stack along a leading layer axis, which
+    # the path+shape sharding rules handle transparently. Checkpoints are not
+    # interchangeable between scan and non-scan layouts.
+    scan_layers: bool = False
 
     @classmethod
     def small_test(cls, **kw) -> "GPT2Config":
@@ -85,6 +91,16 @@ class Block(nn.Module):
         return x + h
 
 
+class _ScanBlock(nn.Module):
+    """Scan-body adapter: (carry, broadcast train) → (carry, no ys)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        return Block(self.config, name="block")(x, train), None
+
+
 class GPT2(nn.Module):
     """Token ids (B, T) int32 → logits (B, T, vocab). LM head tied to wte."""
 
@@ -108,11 +124,26 @@ class GPT2(nn.Module):
         )
         x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
-        block_cls = (
-            nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
-        )
-        for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h{i}")(x, train)
+        if cfg.scan_layers:
+            body = (
+                nn.remat(_ScanBlock, static_argnums=(2,))
+                if cfg.remat
+                else _ScanBlock
+            )
+            blocks = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+                in_axes=nn.broadcast,
+            )
+            x, _ = blocks(cfg, name="h")(x, train)
+        else:
+            block_cls = (
+                nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+            )
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, name=f"h{i}")(x, train)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Weight-tied LM head; logits in float32 for a stable softmax/CE.
         return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(

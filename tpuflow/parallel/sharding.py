@@ -56,11 +56,14 @@ def gpt2_tensor_rules(names: tuple[str, ...], shape: tuple[int, ...]):
         return None
     leaf = names[-1]
     parent = names[-2] if len(names) >= 2 else ""
-    if leaf == "kernel" and len(shape) == 2:
+    # Dense kernels are (in, out) — or (layers, in, out) when the layer stack
+    # is nn.scan'd (GPT2Config.scan_layers): the split dim shifts right.
+    if leaf == "kernel" and len(shape) in (2, 3):
+        col, row = len(shape) - 1, len(shape) - 2
         if parent in ("c_attn", "mlp_fc"):
-            return {1: AXIS_TENSOR}  # column parallel
+            return {col: AXIS_TENSOR}  # column parallel
         if parent in ("c_proj", "mlp_proj"):
-            return {0: AXIS_TENSOR}  # row parallel
+            return {row: AXIS_TENSOR}  # row parallel
     if leaf in ("wte", "wpe") and len(shape) == 2:
         return {0: AXIS_TENSOR}
     return None
