@@ -10,6 +10,7 @@ there is no user-visible collective API, same encapsulation as the reference.
 
 from tpuflow.dist.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQ,
     AXIS_TENSOR,
@@ -29,6 +30,7 @@ from tpuflow.dist.mesh import (
 
 __all__ = [
     "AXIS_DATA",
+    "AXIS_EXPERT",
     "AXIS_FSDP",
     "AXIS_SEQ",
     "AXIS_TENSOR",

@@ -39,6 +39,12 @@ class GPT2Config:
     # the path+shape sharding rules handle transparently. Checkpoints are not
     # interchangeable between scan and non-scan layouts.
     scan_layers: bool = False
+    # Mixture-of-Experts: n_experts > 0 replaces every block's MLP with a
+    # Switch-routed expert MLP (tpuflow.models.moe) whose weights shard over
+    # the 'expert' mesh axis (expert parallelism).
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2  # load-balance loss coefficient
 
     @classmethod
     def small_test(cls, **kw) -> "GPT2Config":
@@ -84,9 +90,22 @@ class Block(nn.Module):
         x = x + a
 
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
-        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="mlp_fc")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="mlp_proj")(h)
+        if cfg.n_experts > 0:
+            from tpuflow.models.moe import MoEMLP
+
+            h = MoEMLP(
+                d_model=cfg.n_embd,
+                d_ff=4 * cfg.n_embd,
+                n_experts=cfg.n_experts,
+                capacity_factor=cfg.capacity_factor,
+                aux_weight=cfg.moe_aux_weight,
+                dtype=cfg.dtype,
+                name="moe",
+            )(h, train)
+        else:
+            h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="mlp_fc")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="mlp_proj")(h)
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
@@ -132,7 +151,9 @@ class GPT2(nn.Module):
             )
             blocks = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                # 'losses' must be declared or nn.scan silently DROPS the
+                # per-layer sown values (the MoE load-balance aux loss).
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,

@@ -29,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpuflow.dist import AXIS_DATA, AXIS_FSDP, AXIS_TENSOR
+from tpuflow.dist import AXIS_DATA, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR
 
 
 def _path_names(path) -> tuple[str, ...]:
@@ -66,6 +66,16 @@ def gpt2_tensor_rules(names: tuple[str, ...], shape: tuple[int, ...]):
             return {row: AXIS_TENSOR}  # row parallel
     if leaf in ("wte", "wpe") and len(shape) == 2:
         return {0: AXIS_TENSOR}
+    # MoE expert stacks: w1 (E, C, F) / w2 (E, F, C) — or with a scanned
+    # layer stack (L, E, ...). Experts shard over 'expert'; the FFN dim also
+    # splits over 'tensor' (column for w1, row for w2), composing EP × TP.
+    if parent == "moe" and leaf in ("w1", "w2") and len(shape) in (3, 4):
+        expert_dim = len(shape) - 3
+        placed = {expert_dim: AXIS_EXPERT}
+        placed[len(shape) - 1 if leaf == "w1" else len(shape) - 2] = AXIS_TENSOR
+        return placed
+    if parent == "moe" and leaf in ("b1", "b2") and len(shape) in (2, 3):
+        return {len(shape) - 2: AXIS_EXPERT}
     return None
 
 
@@ -87,7 +97,10 @@ def make_shardings(
     """
     fsdp_axes = tuple(a for a in (AXIS_FSDP, AXIS_DATA) if mesh.shape.get(a, 1) > 1)
     fsdp_size = int(np.prod([mesh.shape[a] for a in fsdp_axes])) if fsdp_axes else 1
-    tensor_size = mesh.shape.get(AXIS_TENSOR, 1)
+
+    def _axis_size(axis) -> int:
+        names = axis if isinstance(axis, tuple) else (axis,)
+        return int(np.prod([mesh.shape.get(a, 1) for a in names]))
 
     def one(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()) or ())
@@ -95,9 +108,12 @@ def make_shardings(
         if shape and int(np.prod(shape)) >= min_shard_elems:
             names = _path_names(path)
             placed = tensor_rules(names, shape) if tensor_rules else None
-            if placed and tensor_size > 1:
+            if placed:
+                # Each rule names its own mesh axis ('tensor', 'expert', …);
+                # apply it when that axis exists non-trivially and divides.
                 for dim, axis in placed.items():
-                    if shape[dim] % tensor_size == 0:
+                    size = _axis_size(axis)
+                    if size > 1 and shape[dim] % size == 0:
                         spec[dim] = axis
             if fsdp and fsdp_size > 1:
                 # Largest free dim divisible by the fsdp world.

@@ -82,15 +82,22 @@ def make_train_step(
         has_stats = bool(state.batch_stats)
 
         def compute_loss(params):
-            out = state.apply_fn(
+            # 'losses' collects auxiliary objectives the model sows (e.g. the
+            # MoE load-balance loss); models without any sow leave it empty.
+            mutable = ["losses"] + (["batch_stats"] if has_stats else [])
+            logits, updates = state.apply_fn(
                 _variables(state, params),
                 batch["x"],
                 train=True,
                 rngs={"dropout": dropout_rng},
-                mutable=["batch_stats"] if has_stats else False,
+                mutable=mutable,
             )
-            logits, updates = out if has_stats else (out, {})
-            return loss_fn(logits, batch["y"]), (logits, updates)
+            loss = loss_fn(logits, batch["y"])
+            for leaf in jax.tree_util.tree_leaves(updates.get("losses", {})):
+                # A scanned layer stack sows a (n_layer,)-stacked leaf; sum
+                # keeps the loss scalar either way.
+                loss = loss + jnp.sum(leaf)
+            return loss, (logits, updates)
 
         (loss, (logits, updates)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
