@@ -42,12 +42,11 @@ def main() -> None:
     n_shards = int(os.environ.get("TPUFLOW_BENCH_DEVICES", "8"))
     payload_gib = float(os.environ.get("TPUFLOW_BENCH_GB", "1.0"))
 
-    import jax
-
     if not use_device:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n_shards)
+        from tpuflow.dist import force_cpu_platform
 
+        force_cpu_platform(n_shards)
+    import jax
     import numpy as np
 
     from tpuflow import dist

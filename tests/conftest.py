@@ -11,20 +11,18 @@ import os
 
 # Force CPU even when the environment preselects a TPU platform plugin
 # (tests never touch real chips; bench.py is what runs on hardware). The
-# platform plugin's sitecustomize overrides JAX_PLATFORMS via jax.config, so
-# the config must be re-updated after import, before any backend initializes.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA_FLAGS export also reaches subprocesses spawned by gang tests.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+from tpuflow.dist import force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-if jax.config.jax_num_cpu_devices < 8:
-    jax.config.update("jax_num_cpu_devices", 8)
+force_cpu_platform(8)
+
+import jax  # noqa: E402
 
 import pytest  # noqa: E402
 

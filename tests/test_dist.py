@@ -51,3 +51,103 @@ def test_initialize_single_process_noop():
     dist.initialize()  # no coordinator → no-op, must not raise
     assert not dist.is_initialized()
     dist.barrier()  # single-process barrier is a no-op
+
+
+def test_shard_batch_small_batch_replicates(mesh8):
+    """Batches smaller than (or not divisible by) the data-shard count take
+    the documented replicate fallback instead of raising (VERDICT r1 #2)."""
+    batch = {"x": np.zeros((2, 16), np.float32), "y": np.zeros((2,), np.int32)}
+    placed = dist.shard_batch(batch, mesh8)
+    assert placed["x"].sharding.is_fully_replicated
+    assert placed["y"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(placed["x"]), batch["x"])
+
+
+def test_dp8_numerics_match_single_device(mesh8):
+    """SURVEY §4: the allreduced gradients of an 8-shard data-parallel step
+    must equal the single-device gradients on identical data — the property
+    DDP guarantees in the reference (my_ray_module.py:135,159)."""
+    import optax
+
+    from tpuflow.models.mlp import NeuralNetwork
+    from tpuflow.train import create_train_state, make_train_step
+
+    model = NeuralNetwork(dropout_rate=0.0)
+    rng = jax.random.PRNGKey(0)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (16, 28, 28)), np.float32
+    )
+    y = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10))
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    def run(mesh):
+        state = create_train_state(model, rng, x[:1], tx)
+        with mesh:
+            batch = dist.shard_batch({"x": x, "y": y}, mesh)
+            state = state.replace(params=dist.replicate(state.params, mesh))
+            new_state, metrics = make_train_step(donate=False)(
+                state, batch, jax.random.PRNGKey(3)
+            )
+        return float(metrics["loss"]), jax.device_get(new_state.params)
+
+    mesh1 = dist.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    loss1, params1 = run(mesh1)
+    loss8, params8 = run(mesh8)
+    assert abs(loss1 - loss8) < 1e-5
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        params1,
+        params8,
+    )
+
+
+def test_topology_change_restore_identical_forward(tmp_path, mesh8):
+    """SURVEY §4: a state FSDP-sharded over K=8 devices, checkpointed, then
+    restored onto a K'=4 mesh must produce bit-identical forward outputs."""
+    import jax.numpy as jnp
+    import optax
+
+    from tpuflow.ckpt import CheckpointManager
+    from tpuflow.models.mlp import NeuralNetwork
+    from tpuflow.parallel import create_sharded_state, make_shardings
+    from tpuflow.train import create_train_state
+
+    model = NeuralNetwork(dropout_rate=0.0)
+    rng = jax.random.PRNGKey(0)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28)), np.float32
+    )
+    tx = optax.sgd(0.1)
+
+    state, _ = create_sharded_state(
+        lambda: create_train_state(model, rng, x[:1], tx),
+        mesh8,
+        fsdp=True,
+    )
+    # Forward on host-materialized params: sharded eager execution reorders
+    # reductions (~1e-7 noise), so bit-exactness is asserted on identical
+    # (host) layouts on both sides of the round-trip.
+    ref_out = np.asarray(
+        model.apply({"params": jax.device_get(state.params)}, x)
+    )
+
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, {"params": state.params}, metrics={"val_loss": 1.0})
+    mgr.close()
+
+    mesh4 = dist.make_mesh({"data": 2, "fsdp": 2}, devices=jax.devices()[:4])
+    abstract = jax.eval_shape(lambda t: t, state.params)
+    shardings4 = make_shardings(abstract, mesh4, fsdp=True)
+    target = jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract,
+        shardings4,
+    )
+    mgr2 = CheckpointManager(str(tmp_path), async_save=False)
+    restored = mgr2.restore(1, abstract_state={"params": target})
+    mgr2.close()
+    assert restored["params"]["dense1"]["kernel"].sharding.mesh.shape["fsdp"] == 2
+    out4 = np.asarray(
+        model.apply({"params": jax.device_get(restored["params"])}, x)
+    )
+    np.testing.assert_array_equal(ref_out, out4)

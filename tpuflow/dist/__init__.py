@@ -17,6 +17,8 @@ from tpuflow.dist.mesh import (
     barrier,
     batch_sharding,
     data_axis_size,
+    ensure_healthy_platform,
+    force_cpu_platform,
     initialize,
     is_initialized,
     make_mesh,
@@ -37,6 +39,8 @@ __all__ = [
     "barrier",
     "batch_sharding",
     "data_axis_size",
+    "ensure_healthy_platform",
+    "force_cpu_platform",
     "initialize",
     "is_initialized",
     "make_mesh",

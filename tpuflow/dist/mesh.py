@@ -46,6 +46,128 @@ _DEFAULT_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ, AXIS_EXPERT)
 _initialized_multihost = False
 
 
+def force_cpu_platform(n_devices: int = 8, *, exact: bool = False) -> None:
+    """Select an n-device host-CPU JAX platform, if backends aren't up yet.
+
+    Shared bootstrap for every entry point that must not touch real chips
+    (tests, dryruns, CPU benches, gang subprocesses): sets the platform env
+    var for child processes, then applies the config updates that take
+    effect before backend initialization. ``exact`` pins the device count
+    even when the inherited config asks for more (gang subprocesses own a
+    fixed per-process slice of the virtual world). If a backend is already
+    initialized the updates are skipped silently — callers that need a
+    device-count guarantee should assert on ``len(jax.devices())``.
+    """
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if exact or jax.config.jax_num_cpu_devices < n_devices:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError:
+        # Backends already initialized: leave the parent's platform AND the
+        # env untouched so subprocesses don't silently diverge from it.
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def ensure_healthy_platform(
+    n_cpu_devices: int = 8, *, probe_timeout_s: float = 90.0
+) -> str:
+    """Make sure first device use won't hang; fall back to CPU if it would.
+
+    Accelerator platforms behind a network tunnel can hang indefinitely at
+    backend initialization (observed: ``jax.devices()`` never returning on an
+    unreachable single-chip TPU proxy). Flow CLIs and benches call this before
+    any JAX device use: it probes ``jax.devices()`` in a short-lived
+    subprocess with a timeout, and selects the host-CPU platform (with
+    ``n_cpu_devices`` virtual devices) when the probe fails or times out —
+    the failure-detection counterpart of the reference's cluster-formation
+    timeout (reference train_flow.py:42 all_nodes_started_timeout).
+
+    Returns the platform chosen: 'default' (healthy) or 'cpu' (fallback).
+    The verdict is cached in TPUFLOW_PLATFORM_PROBED (inherited by gang
+    subprocesses) and in a short-TTL file under TPUFLOW_HOME so repeated CLI
+    invocations don't re-pay the probe (a dead tunnel would otherwise stall
+    every command by the full timeout).
+    """
+    import subprocess
+    import sys
+
+    if os.environ.get("TPUFLOW_FORCE_CPU") == "1":
+        force_cpu_platform(n_cpu_devices)
+        return "cpu"
+    cached = os.environ.get("TPUFLOW_PLATFORM_PROBED") or _probe_cache_read()
+    if cached == "cpu":
+        force_cpu_platform(n_cpu_devices)
+        return "cpu"
+    if cached == "default":
+        return "default"
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(len(jax.devices()), jax.default_backend())",
+            ],
+            timeout=probe_timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        healthy = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        healthy = False
+    verdict = "default" if healthy else "cpu"
+    os.environ["TPUFLOW_PLATFORM_PROBED"] = verdict
+    _probe_cache_write(verdict)
+    if not healthy:
+        logger.warning(
+            "default JAX platform failed its %ds health probe; falling back "
+            "to the host-CPU platform with %d virtual devices",
+            int(probe_timeout_s),
+            n_cpu_devices,
+        )
+        force_cpu_platform(n_cpu_devices)
+    return verdict
+
+
+_PROBE_CACHE_TTL_S = 600.0
+
+
+def _probe_cache_path() -> str:
+    home = os.environ.get(
+        "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
+    )
+    return os.path.join(home, "platform_probe.json")
+
+
+def _probe_cache_read() -> str | None:
+    import json
+    import time
+
+    try:
+        with open(_probe_cache_path()) as f:
+            rec = json.load(f)
+        if time.time() - float(rec["time"]) < _PROBE_CACHE_TTL_S:
+            return rec["verdict"]
+    except (OSError, ValueError, KeyError):
+        pass
+    return None
+
+
+def _probe_cache_write(verdict: str) -> None:
+    import json
+    import time
+
+    path = _probe_cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"verdict": verdict, "time": time.time()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def is_initialized() -> bool:
     """True if multi-host ``jax.distributed`` was initialized by us."""
     return _initialized_multihost
@@ -222,17 +344,62 @@ def shard_batch(batch, mesh: Mesh):
     each process contributes its local shard
     (``jax.make_array_from_process_local_data``), the TPU-native analogue of
     per-rank DataLoader shards (reference my_ray_module.py:128-129).
+
+    Batches whose leading dim does not divide by the data-shard count (e.g.
+    a 2-row debug batch on an 8-way mesh — a case the reference's per-worker
+    batch math ``global//num_workers``, my_ray_module.py:230, never produces)
+    are REPLICATED instead: every device computes the full batch, the
+    data-axis grad reduction averages identical values, so the numerics are
+    unchanged and only the parallel speedup is lost. Multi-host raises,
+    since a replicated global array cannot be assembled from distinct
+    per-host shards.
     """
+    nshard = data_axis_size(mesh)
+    nproc = jax.process_count()
+    # Multi-host: each process feeds its local slice, which must divide by
+    # the shards this process contributes (global shards / processes).
+    if nproc > 1 and nshard % nproc != 0:
+        raise ValueError(
+            f"{nshard}-way data sharding cannot be fed evenly by {nproc} "
+            "processes; make the mesh data axes a multiple of the process "
+            "count"
+        )
+    local_shards = nshard // nproc if nproc > 1 else nshard
 
     def _put(x):
         x = np.asarray(x)
-        # Scalar leaves (loss weights, epoch ids) have no batch dim: replicate.
-        sharding = replicated(mesh) if x.ndim == 0 else batch_sharding(mesh, x.ndim)
-        if jax.process_count() > 1:
+        if x.ndim == 0:
+            # Scalar leaves (loss weights, epoch ids) have no batch dim.
+            sharding = replicated(mesh)
+        elif nproc > 1:
+            if x.shape[0] % local_shards != 0:
+                raise ValueError(
+                    f"local batch dim {x.shape[0]} is not divisible by the "
+                    f"{local_shards} data shards this process contributes "
+                    f"({nshard}-way sharding over {nproc} processes); pad "
+                    "the batch (see data.ShardedLoader) or shrink the mesh"
+                )
+            sharding = batch_sharding(mesh, x.ndim)
+        elif x.shape[0] % nshard != 0:
+            if (x.shape[0], nshard) not in _warned_replicate:
+                _warned_replicate.add((x.shape[0], nshard))
+                logger.warning(
+                    "batch dim %d not divisible by %d-way data sharding; "
+                    "replicating (correct but unparallelized)",
+                    x.shape[0],
+                    nshard,
+                )
+            sharding = replicated(mesh)
+        else:
+            sharding = batch_sharding(mesh, x.ndim)
+        if nproc > 1:
             return jax.make_array_from_process_local_data(sharding, x)
         return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(_put, batch)
+
+
+_warned_replicate: set = set()
 
 
 def replicate(tree, mesh: Mesh):

@@ -26,9 +26,10 @@ def _bootstrap_jax() -> None:
     import jax
 
     if os.environ.get("TPUFLOW_FORCE_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")
+        from tpuflow.dist import force_cpu_platform
+
         local = int(os.environ.get("TPUFLOW_GANG_LOCAL_DEVICES", "1"))
-        jax.config.update("jax_num_cpu_devices", local)
+        force_cpu_platform(local, exact=True)
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 

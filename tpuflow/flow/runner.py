@@ -448,6 +448,12 @@ def main(flow_cls: type[FlowSpec], argv: list[str] | None = None):
         _show(flow_cls)
         return None
     cmd, rest = argv[0], argv[1:]
+    if cmd in ("run", "trigger"):
+        # Don't let a hung accelerator tunnel stall the whole run: probe the
+        # default platform and fall back to virtual CPU devices if needed.
+        from tpuflow.dist import ensure_healthy_platform
+
+        ensure_healthy_platform()
     if cmd == "run":
         params, triggered = _parse_params(flow_cls, rest)
         return runner.run(params, triggered=triggered)
