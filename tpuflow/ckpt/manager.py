@@ -290,14 +290,21 @@ class CheckpointManager:
                 # on the MAIN thread — it happens in wait_until_finished(),
                 # which the next save()/restore()/query drains through.
                 self._raw_saver.save(state_dir, state, pool=self._pool)
-                self._pending_commit = _commit
+                self._pending_commit = lambda: _commit(merge=True)
             else:
                 self._raw_saver.save(
                     state_dir, state, pool=self._pool, on_commit=_commit
                 )
         else:
+            # StandardCheckpointer.save is async: the commit marker must not
+            # appear before the payload is durable, or a crash mid-write
+            # leaves a visible-but-incomplete step that in-run resume would
+            # pick and fail on. Defer the commit to the drain point (whose
+            # first act is draining the async checkpointer) so async saves
+            # still overlap with training, and multi-host commits get the
+            # same success-exchange + visibility barriers as the raw path.
             self._ckptr.save(state_dir, state)
-            _commit()
+            self._pending_commit = lambda: _commit(merge=False)
         if not self._async:
             self.wait_until_finished()
         return Checkpoint(path=step_dir, metadata=meta)
@@ -324,31 +331,51 @@ class CheckpointManager:
                 shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def wait_until_finished(self) -> None:
-        self._ckptr.wait_until_finished()
-        try:
-            self._raw_saver.wait()
-        except BaseException:
-            # Never publish a step whose shard writes failed: discard the
-            # commit. Peers block in the commit barrier until the collective
-            # times out and the coordination service propagates the failure —
-            # a loud step failure handled by the retry layer.
-            self._pending_commit = None
-            raise
         pending = self._pending_commit
+        self._pending_commit = None
+        err: BaseException | None = None
+        try:
+            self._ckptr.wait_until_finished()
+            self._raw_saver.wait()
+        except BaseException as e:
+            # Never publish a step whose writes failed.
+            err = e
         if pending is not None:
-            self._pending_commit = None
-            # All hosts' local writes are done; barrier so the merged
-            # manifest covers every host's shards. SPMD contract: every
-            # process drains saves at the same program points (report/
-            # restore/queries), exactly like any other collective.
-            from jax.experimental import multihost_utils
+            if jax.process_count() > 1:
+                # Deferred multi-host commit. Before the commit barrier,
+                # exchange a per-host success bit so ONE host's failed write
+                # aborts the commit promptly and uniformly on ALL hosts —
+                # instead of peers hanging in the barrier until the
+                # collective timeout. (A fully dead peer still costs the
+                # collective timeout; nothing shorter exists.) SPMD contract:
+                # every process drains saves at the same program points
+                # (report/restore/queries).
+                import numpy as _np
 
-            multihost_utils.sync_global_devices("tpuflow_ckpt_commit")
-            pending(merge=True)
-            # Second barrier: no host may read the step (restore right after
-            # a drain) until process 0 has written the merged manifest and
-            # the metadata marker.
-            multihost_utils.sync_global_devices("tpuflow_ckpt_committed")
+                from jax.experimental import multihost_utils
+
+                ok = multihost_utils.process_allgather(
+                    _np.asarray(1 if err is None else 0, _np.int32)
+                )
+                if int(_np.min(ok)) == 0:
+                    if err is not None:
+                        raise err
+                    raise RuntimeError(
+                        "checkpoint shard write failed on a peer host; "
+                        "commit aborted on all hosts"
+                    )
+                # All hosts' local writes succeeded; barrier so the merged
+                # manifest covers every host's shards.
+                multihost_utils.sync_global_devices("tpuflow_ckpt_commit")
+                pending()
+                # Second barrier: no host may read the step (restore right
+                # after a drain) until process 0 has written the merged
+                # manifest and the metadata marker.
+                multihost_utils.sync_global_devices("tpuflow_ckpt_committed")
+            elif err is None:
+                pending()
+        if err is not None:
+            raise err
 
     def close(self) -> None:
         self.wait_until_finished()
@@ -431,6 +458,17 @@ def restore_from_handle(
     from tpuflow.ckpt import raw as raw_fmt
 
     with checkpoint.as_directory() as path:
+        if not os.path.exists(os.path.join(path, _META_FILE)):
+            # A handle returned by save() is valid only after the owning
+            # manager's wait_until_finished() has committed the step (async
+            # save / deferred multi-host commit). Fail fast with the real
+            # reason instead of a confusing missing-manifest error deeper in.
+            raise FileNotFoundError(
+                f"checkpoint at {path} is not committed (no {_META_FILE}): "
+                "the save that produced this handle has not finished — drain "
+                "the CheckpointManager (wait_until_finished/close) before "
+                "consuming the handle"
+            )
         state_dir = os.path.join(path, _STATE_DIR)
         if raw_fmt.is_raw(state_dir):
             if weights_only:

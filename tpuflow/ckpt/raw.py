@@ -204,7 +204,11 @@ def _gather_host(tree):
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
             shape, dtype = list(leaf.shape), np.dtype(leaf.dtype).str
         else:
-            shape, dtype = list(shards[0][1].shape), shards[0][1].dtype.str
+            # Pure-Python scalar/list leaves: derive shape/dtype the same way
+            # _leaf_shards does, so processes that own no shard of the leaf
+            # (every rank but 0) still emit a valid manifest entry.
+            arr = np.asarray(leaf)
+            shape, dtype = list(arr.shape), arr.dtype.str
         out.append((_path_names(path), shape, dtype, shards))
     return out
 
@@ -231,7 +235,11 @@ def _write_entries(
     merges fragments at commit time (``merge_manifests``) after the
     cross-process barrier, so the unified manifest — and hence step
     visibility — appears only once every host's shards are on storage."""
-    manifest = {"format": FORMAT_NAME, "leaves": []}
+    manifest = {
+        "format": FORMAT_NAME,
+        "process_count": jax.process_count(),
+        "leaves": [],
+    }
     for i, (names, shape, dtype, shards) in enumerate(host_leaves):
         entry = {"path": names, "shape": shape, "dtype": dtype, "shards": []}
         for starts, arr in shards:
@@ -254,16 +262,40 @@ def _write_entries(
         json.dump(manifest, f)
 
 
-def merge_manifests(directory: str) -> None:
+def merge_manifests(directory: str, *, visibility_timeout_s: float = 10.0) -> None:
     """Union all manifest fragments into the unified manifest (process 0,
     after the all-hosts barrier). Fragments agree on leaf order/shape/dtype
-    (the pytree is global); shard lists are disjoint unions."""
-    names = sorted(
-        n for n in os.listdir(directory)
-        if n.startswith("manifest.p") and n.endswith(".json")
-    )
-    if not names:
-        raise FileNotFoundError(f"no manifest fragments in {directory}")
+    (the pytree is global); shard lists are disjoint unions.
+
+    Merging FEWER fragments than the save's ``process_count`` would leave
+    uncovered regions of restored arrays filled with uninitialized memory —
+    but at the call site every writer has already reported success, so a
+    shortfall is a transient visibility lag on eventually-consistent shared
+    storage: poll briefly for the full set before failing loudly."""
+    import time as _time
+
+    deadline = _time.monotonic() + visibility_timeout_s
+    while True:
+        names = sorted(
+            n for n in os.listdir(directory)
+            if n.startswith("manifest.p") and n.endswith(".json")
+        )
+        expected = None
+        if names:
+            with open(os.path.join(directory, names[0])) as f:
+                first = json.load(f)
+            expected = int(first.get("process_count", len(names)))
+            if len(names) >= expected:
+                break
+        if _time.monotonic() >= deadline:
+            if not names:
+                raise FileNotFoundError(f"no manifest fragments in {directory}")
+            raise FileNotFoundError(
+                f"{directory} has {len(names)} manifest fragments but the "
+                f"save ran on {expected} processes; the step is incomplete "
+                "on this storage (lagging sync or failed writer)"
+            )
+        _time.sleep(0.05)
     merged: dict | None = None
     for name in names:
         with open(os.path.join(directory, name)) as f:
