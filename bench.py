@@ -22,6 +22,14 @@ TMPDIR); override with TPUFLOW_BENCH_DIR.
 
 Payload size: TPUFLOW_BENCH_GB (default 1.0 GiB). Devices:
 TPUFLOW_BENCH_DEVICES (default 8 virtual shards, mirroring a v5e-8 host).
+
+Cold-save note: on this dev box the hypervisor backs new guest memory
+lazily at ~0.2 GB/s (measured: first-touch of growing anon footprint),
+so the first two saves — which must allocate the 2×payload steady-state
+tmpfs footprint — are bounded by host page backing, not by the write
+path (the same fresh-file write hits >3 GB/s once pages exist). Restore
+reads into page-aligned buffers that XLA's CPU client aliases zero-copy,
+so restored bytes are moved exactly once.
 """
 
 from __future__ import annotations

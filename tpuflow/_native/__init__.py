@@ -130,6 +130,24 @@ def default_threads() -> int:
     )
 
 
+_XLA_ALIGN = 4096
+
+
+def aligned_empty(nbytes: int, align: int = _XLA_ALIGN) -> np.ndarray:
+    """Uninitialized u8 buffer whose data pointer is ``align``-aligned.
+
+    XLA's CPU client zero-copy *aliases* host buffers that are at least
+    64-byte aligned instead of copying them into fresh device memory —
+    restore hands freshly-read shard buffers straight to ``jax.device_put``,
+    so alignment here removes an entire memcpy (and an entire fresh-page
+    allocation) from the restore path. glibc's malloc returns big blocks at
+    a 16-byte offset, hence the explicit over-allocate-and-slice.
+    """
+    base = np.empty(nbytes + align, np.uint8)
+    off = (-base.ctypes.data) % align
+    return base[off : off + nbytes]
+
+
 # ------------------------------------------------------------ typed wrappers
 def write_bytes(
     path: str,
@@ -165,8 +183,11 @@ def write_bytes(
 
 
 def read_bytes(path: str, nbytes: int, *, threads: int | None = None) -> np.ndarray:
-    """Striped threaded read of ``nbytes`` from ``path`` into a u8 array."""
-    out = np.empty(nbytes, np.uint8)
+    """Striped threaded read of ``nbytes`` from ``path`` into a u8 array.
+
+    The buffer is page-aligned so downstream ``jax.device_put`` on CPU
+    aliases it zero-copy (see ``aligned_empty``)."""
+    out = aligned_empty(nbytes)
     L = lib()
     if L is None:
         with open(path, "rb", buffering=0) as f:

@@ -411,10 +411,17 @@ def _place(arr: np.ndarray, sharding) -> Any:
     shape = arr.shape
     try:
         index_map = sharding.addressable_devices_indices_map(shape)
-        shards = [
-            jax.device_put(np.ascontiguousarray(arr[index]), device)
-            for device, index in index_map.items()
-        ]
+        shards = []
+        for device, index in index_map.items():
+            piece = arr[index]
+            if not (
+                piece.flags["C_CONTIGUOUS"] and piece.ctypes.data % 64 == 0
+            ):
+                # Copy into an aligned buffer so device_put stays zero-copy.
+                buf = _aligned_like(piece.shape, piece.dtype)
+                buf[...] = piece
+                piece = buf
+            shards.append(jax.device_put(piece, device))
         return jax.make_array_from_single_device_arrays(shape, sharding, shards)
     except (TypeError, AttributeError, ValueError):
         return jax.device_put(arr, sharding)
@@ -458,7 +465,20 @@ def _plan_entry(entry: dict, tmpl) -> list | None:
 
 def _cast(arr: np.ndarray, tmpl) -> np.ndarray:
     dtype = getattr(tmpl, "dtype", None)
-    return arr if dtype is None or arr.dtype == dtype else arr.astype(dtype)
+    if dtype is None or arr.dtype == dtype:
+        return arr
+    # Casting into an aligned destination keeps the result eligible for the
+    # zero-copy device_put path (see _native.aligned_empty).
+    out = _aligned_like(arr.shape, np.dtype(dtype))
+    out[...] = arr
+    return out
+
+
+def _aligned_like(shape, dtype: np.dtype) -> np.ndarray:
+    # Scalars (shape ()) need one element; zero-size shapes need 0 bytes and
+    # reshape fine from a 0-length view.
+    nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+    return _native.aligned_empty(nbytes).view(dtype).reshape(shape)
 
 
 def _read_leaf(
@@ -468,7 +488,7 @@ def _read_leaf(
     shards = entry["shards"]
     if len(shards) == 1 and shards[0]["shape"] == entry["shape"]:
         return _read_shard(directory, shards[0], dtype, threads=threads)
-    full = np.empty(entry["shape"], dtype)
+    full = _aligned_like(tuple(entry["shape"]), dtype)
     for shard in shards:
         idx = tuple(
             slice(start, start + dim)
