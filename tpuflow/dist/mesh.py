@@ -101,23 +101,29 @@ def ensure_healthy_platform(
         return "cpu"
     if cached == "default":
         return "default"
+    backend = ""
     try:
         proc = subprocess.run(
             [
                 sys.executable,
                 "-c",
-                "import jax; print(len(jax.devices()), jax.default_backend())",
+                "import jax; print(jax.default_backend())",
             ],
             timeout=probe_timeout_s,
             capture_output=True,
             text=True,
         )
         healthy = proc.returncode == 0
+        if healthy:
+            backend = proc.stdout.strip().splitlines()[-1]
     except subprocess.TimeoutExpired:
         healthy = False
     verdict = "default" if healthy else "cpu"
     os.environ["TPUFLOW_PLATFORM_PROBED"] = verdict
-    _probe_cache_write(verdict)
+    # The probed backend name ('tpu'/'cpu'/...) lets callers decide whether
+    # the healthy default is actually an accelerator (bench train leg).
+    os.environ["TPUFLOW_PLATFORM_BACKEND"] = backend
+    _probe_cache_write(verdict, backend)
     if not healthy:
         logger.warning(
             "default JAX platform failed its %ds health probe; falling back "
@@ -147,13 +153,16 @@ def _probe_cache_read() -> str | None:
         with open(_probe_cache_path()) as f:
             rec = json.load(f)
         if time.time() - float(rec["time"]) < _PROBE_CACHE_TTL_S:
+            os.environ.setdefault(
+                "TPUFLOW_PLATFORM_BACKEND", rec.get("backend", "")
+            )
             return rec["verdict"]
     except (OSError, ValueError, KeyError):
         pass
     return None
 
 
-def _probe_cache_write(verdict: str) -> None:
+def _probe_cache_write(verdict: str, backend: str = "") -> None:
     import json
     import time
 
@@ -162,7 +171,10 @@ def _probe_cache_write(verdict: str) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump({"verdict": verdict, "time": time.time()}, f)
+            json.dump(
+                {"verdict": verdict, "backend": backend, "time": time.time()},
+                f,
+            )
         os.replace(tmp, path)
     except OSError:
         pass

@@ -5,10 +5,11 @@ Two tiers with identical numerics:
 - ``blockwise_attention`` — pure-JAX online-softmax attention via ``lax.scan``
   over KV chunks. O(block) memory instead of O(T^2), differentiable, runs on
   any backend; the building block of ring attention.
-- ``flash_attention`` — Pallas TPU kernel (MXU matmuls in the q/k blocks,
-  float32 online-softmax state in VMEM scratch). Forward is the kernel;
-  backward (custom VJP) recomputes through ``blockwise_attention`` —
-  the flash-style compute-for-memory trade.
+- ``flash_attention`` — Pallas TPU kernels (MXU matmuls in the q/k blocks,
+  float32 online-softmax state in VMEM scratch). Forward saves only
+  (O, logsumexp); backward recomputes P inside two Pallas kernels
+  (dq; dk/dv) — the flash-style compute-for-memory trade.
+  ``TPUFLOW_FLASH_BWD=blockwise`` selects the pure-JAX recompute VJP.
 
 The reference has no attention anywhere (its model is an image MLP,
 my_ray_module.py:94-112); these exist for the GPT-2 acceptance config and
@@ -18,6 +19,7 @@ first-class long-context support (SURVEY.md §5).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -81,8 +83,31 @@ def _reference_attention(q, k, v, *, causal: bool):
 
 
 # ----------------------------------------------------------- pallas kernel
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
-                causal, block_q, block_k):
+def _masked_scores(q_ref, k_ref, iq, ik, *, scale, causal, block_q, block_k):
+    """Scaled (block_q, block_k) f32 score tile with the causal mask applied.
+
+    Shared by the forward and both backward kernels so the mask/scale
+    semantics cannot diverge between them. MXU feeds stay in the input dtype
+    (bf16 multiplies at full MXU rate); accumulation is f32 via
+    preferred_element_type.
+    """
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -94,22 +119,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        # MXU feeds stay in the input dtype (bf16 multiplies at full MXU
-        # rate); accumulation is f32 via preferred_element_type. Only the
-        # softmax statistics run in f32 on the VPU.
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (block_q, block_k) f32
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-
+        # Only the softmax statistics run in f32 on the VPU.
+        s = _masked_scores(
+            q_ref, k_ref, iq, ik,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
         m_old = m_scr[:, 0]
         m_new = jnp.maximum(m_old, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -136,10 +150,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
         ).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
-               interpret: bool):
+               interpret: bool, *, with_lse: bool = False):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
@@ -151,7 +166,7 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -159,8 +174,16 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            # logsumexp per row — the softmax residual the backward kernels
+            # need to recompute P without re-running the online softmax.
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0)
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom (col 0)
@@ -173,7 +196,176 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         ),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    out = out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    if with_lse:
+        return out, lse
+    return out
+
+
+# -------------------------------------------------- pallas backward kernels
+# FlashAttention-2-style backward: P is recomputed inside the kernels from
+# (q, k, lse) — the compute-for-memory trade — and split into two kernels so
+# each accumulates over its own sequential axis without atomics:
+#   dq kernel : grid (BH, nq, nk), k innermost — dq_i += dS_ij K_j
+#   dkv kernel: grid (BH, nk, nq), q innermost — dK_j += dS_ij^T Q_i,
+#                                                dV_j += P_ij^T dO_i
+# with dS = P ∘ (dP − D), dP = dO V^T, D = rowsum(dO ∘ O) precomputed in XLA.
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        s = _masked_scores(
+            q_ref, k_ref, iq, ik,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _maybe():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
+                    block_k):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        s = _masked_scores(
+            q_ref, k_ref, iq, ik,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        p = jnp.exp(s - lse_ref[0][:, None])  # (block_q, block_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # A q block entirely before this k block contributes nothing.
+        @pl.when(iq * block_q + block_q - 1 >= ik * block_k)
+        def _maybe():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    BH = B * H
+
+    def flat(x, T):
+        return x.transpose(0, 2, 1, 3).reshape(BH, T, D)
+
+    qf, kf, vf = flat(q, Tq), flat(k, Tk), flat(v, Tk)
+    of, gf = flat(o, Tq), flat(g, Tq)
+    # D_i = rowsum(dO ∘ O): cheap elementwise+reduce, stays in XLA.
+    delta = jnp.sum(
+        of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1
+    )
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(BH, Tq // block_q, Tk // block_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    qi_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    rowi_spec = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(BH, Tk // block_k, Tq // block_q),
+        in_specs=[qi_spec, k_spec, k_spec, qi_spec, rowi_spec, rowi_spec],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    def unflat(x, T):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return unflat(dq, Tq), unflat(dk, Tk), unflat(dv, Tk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -183,16 +375,26 @@ def _flash(q, k, v, causal, block_q, block_k):
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
-    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    o, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+    )
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    # Flash-style backward: recompute through the O(T)-memory blockwise path.
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(q, k, v, causal=causal), q, k, v
+    q, k, v, o, lse = res
+    if os.environ.get("TPUFLOW_FLASH_BWD") == "blockwise":
+        # Fallback: recompute through the O(T)-memory blockwise path.
+        _, vjp = jax.vjp(
+            lambda q, k, v: blockwise_attention(q, k, v, causal=causal),
+            q, k, v,
+        )
+        return vjp(g)
+    interpret = jax.default_backend() != "tpu"
+    return _flash_bwd(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
