@@ -183,3 +183,21 @@ def test_flash_pallas_backward_matches_blockwise_fallback(monkeypatch):
     g_fallback = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_kernel, g_fallback):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ring_attention_ragged_T_falls_back():
+    """T not divisible by the ring size takes the documented blockwise
+    fallback instead of a shard_map error, and under jax.set_mesh (the
+    supported mesh context) the ring still matches the reference."""
+    mesh = dist.make_mesh({"seq": 8})
+    q, k, v = _qkv(B=1, T=36, H=1, D=8)  # 36 % 8 != 0
+    with mesh:
+        out = ring_attention(q, k, v, causal=True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    q, k, v = _qkv(B=1, T=64, H=1, D=8)
+    with jax.set_mesh(mesh):
+        out = ring_attention(q, k, v, causal=True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -112,3 +112,54 @@ def test_per_worker_batch_math():
     assert per_worker_batch_size(33, 2) == 16  # floor division, as reference
     with pytest.raises(ValueError):
         per_worker_batch_size(2, 4)
+
+
+def test_batchnorm_stats_are_global():
+    """BatchNorm contract under GSPMD (VERDICT r1 #10): the batch-mean
+    reduction is over the GLOBAL batch, so running stats are (a) identical
+    on every replica and (b) equal to the single-device stats on the same
+    data — the checkpoint stores the one true statistic, with no DDP-style
+    per-replica divergence to reconcile."""
+    import flax.linen as nn
+    import optax
+
+    from tpuflow import dist
+    from tpuflow.train import create_train_state, make_train_step
+
+    class BNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train=False):
+            x = nn.Dense(16)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(10)(x.reshape((x.shape[0], -1)))
+
+    model = BNet()
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (16, 8)), np.float32)
+    y = np.zeros((16,), np.int64)
+
+    def run(mesh):
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), x[:1], optax.sgd(0.1)
+        )
+        with mesh:
+            state = state.replace(
+                params=dist.replicate(state.params, mesh),
+                batch_stats=dist.replicate(state.batch_stats, mesh),
+            )
+            batch = dist.shard_batch({"x": x, "y": y}, mesh)
+            new_state, _ = make_train_step(donate=False)(
+                state, batch, jax.random.PRNGKey(2)
+            )
+        return new_state
+
+    mesh8 = dist.make_mesh({"data": 8})
+    mesh1 = dist.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    s8, s1 = run(mesh8), run(mesh1)
+    mean8 = s8.batch_stats["BatchNorm_0"]["mean"]
+    shards = [np.asarray(sh.data) for sh in mean8.addressable_shards]
+    assert all(np.array_equal(shards[0], s) for s in shards[1:])
+    np.testing.assert_allclose(
+        np.asarray(mean8),
+        np.asarray(s1.batch_stats["BatchNorm_0"]["mean"]),
+        atol=1e-6,
+    )

@@ -70,13 +70,36 @@ def _ring_shard_fn(q, k, v, *, causal: bool, axis_name: str):
 
 
 def _current_mesh():
-    from jax._src.mesh import thread_resources
+    # Supported context first: jax.set_mesh(mesh) / jax.sharding.get_mesh().
+    # Under an active jit trace get_mesh() refuses to run; the abstract mesh
+    # carries the axis structure and shard_map accepts it (devices are bound
+    # at lowering from the set_mesh context).
+    try:
+        mesh = jax.sharding.get_mesh()
+        if not getattr(mesh, "empty", True):
+            return mesh
+    except ValueError:
+        mesh = jax.sharding.get_abstract_mesh()
+        if not getattr(mesh, "empty", True):
+            return mesh
+    # Legacy `with mesh:` context: thread_resources via its public
+    # deprecation-path alias (not jax._src). Tolerate removal in a future
+    # JAX: the helpful error below still fires.
+    try:
+        import warnings
 
-    mesh = thread_resources.env.physical_mesh
-    if mesh.empty:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters.pxla import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        mesh = None
+    if mesh is None or mesh.empty:
         raise RuntimeError(
-            "ring_attention needs an active mesh: run under `with mesh:` "
-            "(Trainer.fit does this automatically)"
+            "ring_attention needs an active mesh: run under `with mesh:` or "
+            "`jax.set_mesh(mesh)` (Trainer.fit does this automatically), or "
+            "pass mesh= explicitly"
         )
     return mesh
 
@@ -89,6 +112,14 @@ def ring_attention(q, k, v, *, causal: bool = True, axis_name: str = AXIS_SEQ,
     in one shard — same math, no communication.
     """
     mesh = mesh if mesh is not None else _current_mesh()
+    seq_shards = mesh.shape.get(axis_name, 1)
+    if seq_shards == 1 or q.shape[1] % seq_shards:
+        # Trivial ring, or T not divisible by the ring size: same math with
+        # no rotation — blockwise attention (GSPMD lays it out from the
+        # ambient shardings). Defined behavior instead of a shard_map error.
+        from tpuflow.ops.flash_attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, causal=causal)
     batch_axes = tuple(
         a for a in (AXIS_DATA, AXIS_FSDP) if mesh.shape.get(a, 1) > 1
     )

@@ -26,9 +26,14 @@ class TrainState(train_state.TrainState):
 
     The pytree leaves are exactly the checkpoint payload of the reference
     ({epoch, model_state_dict, optimizer_state_dict}, my_ray_module.py:183-185)
-    plus the step counter. ``batch_stats`` carries BatchNorm running statistics
-    for models that have them (ResNets); it is an empty dict otherwise, and
-    like torch DDP the statistics are per-replica (not cross-replica synced).
+    plus the step counter. ``batch_stats`` carries BatchNorm running
+    statistics for models that have them (ResNets); it is an empty dict
+    otherwise. Under pjit/GSPMD the batch-mean reduction is over the GLOBAL
+    (logically unsharded) batch, so the running statistics are identical on
+    every replica by construction — stronger than torch DDP's per-replica
+    stats (reference my_ray_module.py:135), where replicas silently diverge.
+    The checkpoint therefore stores the one true global statistic
+    (pinned by tests/test_train_step.py::test_batchnorm_stats_are_global).
     """
 
     batch_stats: Any = flax.struct.field(default_factory=dict)

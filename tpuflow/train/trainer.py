@@ -220,6 +220,17 @@ class Trainer:
             n = ndev
         if n > ndev:
             raise ValueError(f"num_workers={n} but only {ndev} devices present")
+        if jax.process_count() > 1 and n != ndev:
+            # A device subset on a multi-host gang would exclude some hosts'
+            # devices: every process still enters the collectives (SPMD), so
+            # the program would deadlock or crash inside XLA. Scale the gang
+            # itself (fewer hosts / smaller slice) instead of slicing here.
+            raise ValueError(
+                f"num_workers={n} selects a subset of the {ndev} global "
+                f"devices across {jax.process_count()} processes; device "
+                "subsets are single-host only — use every gang device "
+                "(num_workers=-1) or shrink the gang"
+            )
         return dist.make_mesh({"data": n}, devices=jax.devices()[:n])
 
     def fit(self) -> Result:
