@@ -90,7 +90,7 @@ def bench_train() -> dict | None:
             dropout=0.0, dtype=jnp.bfloat16,
         )
         batch = 8
-        n_timed = 10
+        n_timed = 20
     else:  # CPU smoke: prove the path; the number is not an MFU claim
         cfg = GPT2Config(
             vocab_size=2048, n_ctx=128, n_embd=128, n_layer=2, n_head=4,
@@ -113,17 +113,23 @@ def bench_train() -> dict | None:
         data = dist.shard_batch({"x": tokens[:, :-1], "y": tokens[:, 1:]}, mesh)
         step = make_train_step()
         rng = jax.random.PRNGKey(1)
+        # Timing is closed by a device→host scalar fetch, NOT
+        # block_until_ready: on the tunneled TPU platform used on dev boxes
+        # block_until_ready acknowledges dispatch without waiting for
+        # execution (measured: 10 steps "complete" in 14 ms), which round 1
+        # turned into a >100% MFU claim. float(loss) transitively forces the
+        # whole step chain to finish on any platform.
         t0 = _time.monotonic()
         state, metrics = step(state, data, rng)
-        jax.block_until_ready(metrics["loss"])
+        float(metrics["loss"])
         compile_s = _time.monotonic() - t0
         for _ in range(2):  # warmup post-compile
             state, metrics = step(state, data, rng)
-        jax.block_until_ready(metrics["loss"])
+        float(metrics["loss"])
         t0 = _time.monotonic()
         for _ in range(n_timed):
             state, metrics = step(state, data, rng)
-        jax.block_until_ready(metrics["loss"])
+        float(metrics["loss"])  # completion of step N implies 1..N-1 done
         dt = (_time.monotonic() - t0) / n_timed
     tokens_per_s = batch * cfg.n_ctx / dt
     flops_per_s = 6.0 * n_params * tokens_per_s
@@ -178,14 +184,51 @@ def bench_flash() -> dict:
             out[f"T{T}"] = {"max_err": round(err, 5), "numerics_ok": False}
             continue
 
-        def timed(fn, *args):
-            jitted = jax.jit(fn)
-            jax.block_until_ready(jitted(*args))  # compile
-            t0 = _time.monotonic()
-            for _ in range(10):
-                r = jitted(*args)
-            jax.block_until_ready(r)
-            return (_time.monotonic() - t0) / 10
+        def timed(fn, q0, *rest, n=20):
+            # Device-side timing loop: chain n applications inside one
+            # lax.scan (output feeds the next q) so neither per-call host
+            # dispatch nor the tunnel fetch round trip pollutes the number;
+            # then difference 1× vs 2× scan executions to cancel the fixed
+            # fetch cost. (block_until_ready does not wait on the tunneled
+            # platform — a scalar fetch is the only true completion point.)
+            def body(q, _):
+                leaf = jax.tree_util.tree_leaves(fn(q, *rest))[0]
+                return leaf.astype(q0.dtype), None
+
+            fetch = jax.jit(lambda q: jnp.sum(q.astype(jnp.float32)))
+
+            def measure(length):
+                step_n = jax.jit(
+                    lambda q: jax.lax.scan(body, q, None, length=length)[0]
+                )
+                float(fetch(step_n(q0)))  # compile + warm
+
+                def run(reps):
+                    q = q0
+                    t0 = _time.monotonic()
+                    for _ in range(reps):
+                        q = step_n(q)
+                    float(fetch(q))
+                    return _time.monotonic() - t0
+
+                t1, t2 = run(1), run(2)
+                return t2 - t1
+
+            # Size the scan so the differenced device time sits well above
+            # tunnel-RTT jitter (~ms): one pilot measurement, then jump
+            # straight to the needed length (at most one recompile). A
+            # still-non-positive difference means jitter swamped the signal
+            # — report None rather than an absurd clamped number (the same
+            # honesty rule as the MFU fetch fix above).
+            delta = measure(n)
+            if delta > 0.08:
+                return delta / n
+            per_call = max(delta / n, 20e-6)
+            n2 = min(int(0.15 / per_call), 4096)
+            delta2 = measure(n2)
+            if delta2 <= 0:
+                return None
+            return delta2 / n2
 
         fwd_flash = timed(lambda a, b, c: flash_attention(a, b, c), q, k, v)
         fwd_xla = timed(lambda a, b, c: xla_attention(a, b, c), q, k, v)
@@ -198,13 +241,20 @@ def bench_flash() -> dict:
             jax.grad(gb(lambda a, b, c: xla_attention(a, b, c)), argnums=(0, 1, 2)),
             q, k, v,
         )
+
+        def ms(t):
+            return round(t * 1e3, 3) if t is not None else None
+
+        def ratio(a, b):
+            return round(a / b, 2) if a is not None and b is not None else None
+
         out[f"T{T}"] = {
             "max_err": round(err, 5),
             "numerics_ok": True,
-            "fwd_ms": {"flash": round(fwd_flash * 1e3, 3), "xla": round(fwd_xla * 1e3, 3)},
-            "fwdbwd_ms": {"flash": round(bwd_flash * 1e3, 3), "xla": round(bwd_xla * 1e3, 3)},
-            "fwd_speedup": round(fwd_xla / fwd_flash, 2),
-            "fwdbwd_speedup": round(bwd_xla / bwd_flash, 2),
+            "fwd_ms": {"flash": ms(fwd_flash), "xla": ms(fwd_xla)},
+            "fwdbwd_ms": {"flash": ms(bwd_flash), "xla": ms(bwd_xla)},
+            "fwd_speedup": ratio(fwd_xla, fwd_flash),
+            "fwdbwd_speedup": ratio(bwd_xla, bwd_flash),
         }
         _log(f"[bench] flash T={T}: {out[f'T{T}']}")
     return out
@@ -302,13 +352,23 @@ def main() -> None:
 
     # Production cadence: per-epoch saves under retention, so steps ≥ 2
     # overwrite recycled shard files (see ckpt.raw.RecyclePool) exactly as a
-    # real training run does. The cold first save pays fresh page allocation
-    # once per run; steady-state per-epoch throughput is what training sees
-    # every epoch and is what we report.
+    # real training run does. The one-time page-backing cost of the pool
+    # (on this hypervisor, first-touch of new guest memory runs ~0.2 GB/s)
+    # is paid by the background prewarm the trainer starts alongside
+    # epoch-1 compute (TrainContext.prewarm_checkpoints); here nothing
+    # overlaps it, so its wall time is logged separately as the honest
+    # once-per-process cost.
     mgr = CheckpointManager(bench_dir, max_to_keep=1, async_save=True)
+    t0 = time.monotonic()
+    mgr.prewarm(state)
+    mgr.prewarm_wait()
+    _log(
+        f"[bench] pool prewarm (once per process, overlapped with compute "
+        f"in production): {time.monotonic() - t0:.2f}s"
+    )
     times = []
-    n_steps = 4  # recycling reaches steady state at step 3 (retention lags
-    # one commit); steps 1-2 pay fresh page allocation once per run.
+    n_steps = 4  # retention lags one commit: step 1 draws on the prewarmed
+    # pool, steps >= 3 on recycled step files.
     for step in range(1, n_steps + 1):
         t0 = time.monotonic()
         # Improving val_loss: best tracks latest, so retention retires the
@@ -318,8 +378,7 @@ def main() -> None:
         dt = time.monotonic() - t0
         times.append(dt)
         _log(
-            f"[bench] save step {step}{' (cold)' if step <= 2 else ''}: "
-            f"{dt:.2f}s = {nbytes / dt / 1e9:.3f} GB/s"
+            f"[bench] save step {step}: {dt:.2f}s = {nbytes / dt / 1e9:.3f} GB/s"
         )
     t_save = sum(times[2:]) / len(times[2:])
 

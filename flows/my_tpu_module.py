@@ -167,6 +167,8 @@ def train_func_per_worker(config: dict) -> None:
         opt_state=dist.replicate(state.opt_state, ctx.mesh),
         batch_stats=dist.replicate(state.batch_stats, ctx.mesh),
     )
+    # Background page-backing for the first save overlaps epoch-1 compute.
+    ctx.prewarm_checkpoints(state)
 
     train_step = make_train_step()
     eval_step = make_eval_step()

@@ -190,6 +190,46 @@ def test_recycle_pool_reuses_files_without_corrupting_restores(tmp_path, mesh8):
     mgr.close()
 
 
+def test_prewarm_backs_pool_pages_and_first_save_recycles(tmp_path, mesh8):
+    """Manager.prewarm pre-creates pool files sized to the retention
+    footprint so even the FIRST save of a process writes onto recycled
+    pages (the cold-save fix: first-touch page backing runs ~15x slower
+    than steady-state writes on ballooning hypervisors), without
+    corrupting the saved payload."""
+    sharding = dist.batch_sharding(mesh8)
+    payload = np.arange(32 * 1024 * 16, dtype=np.float32).reshape(32, 1024, 16)
+    state = {"params": {"w": jax.device_put(payload, sharding)}}
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1, async_save=False)
+    mgr.prewarm(state)
+    mgr.prewarm_wait()
+    pool_dir = os.path.join(str(tmp_path), ".recycle")
+    warmed = sorted(os.listdir(pool_dir))
+    # 8 shards of 256 KiB each x (max_to_keep + pinned best + 1 in flight).
+    assert len(warmed) == 24, warmed
+    # Idempotent top-up: a repeat prewarm of the same state adds nothing.
+    mgr.prewarm(state)
+    mgr.prewarm_wait()
+    assert sorted(os.listdir(pool_dir)) == warmed
+
+    mgr.save(1, state, metrics={"val_loss": 1.0})
+    mgr.wait_until_finished()
+    restored = mgr.restore(
+        1,
+        abstract_state={
+            "params": {
+                "w": jax.ShapeDtypeStruct(
+                    payload.shape, np.float32, sharding=sharding
+                )
+            }
+        },
+    )
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), payload)
+    # The first save consumed warm files (pool shrank or files were renamed
+    # into the step dir).
+    assert len(os.listdir(pool_dir)) < len(warmed)
+    mgr.close()
+
+
 def test_deferred_commit_makes_steps_visible_only_when_complete(
     tmp_path, mesh8, monkeypatch
 ):

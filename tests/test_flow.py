@@ -186,6 +186,29 @@ def test_checkpoint_artifact_is_reference_not_pickle(isolated_home, tmp_path):
     assert isinstance(restored, Checkpoint) and restored.metadata["step"] == 3
 
 
+def test_device_array_artifact_rejected(isolated_home):
+    """A jax.Array artifact fails loudly instead of silently pickling device
+    tensors (the never-pickled-tensors contract, SURVEY.md §7 hard-part 3,
+    now enforced on the store AND the gang-launch pickle paths)."""
+    import jax.numpy as jnp
+
+    class BadFlow(FlowSpec):
+        @step
+        def start(self):
+            self.weights = {"w": jnp.ones((4, 4))}
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    with pytest.raises(Exception) as ei:
+        FlowRunner(BadFlow).run({})
+    assert "jax.Array" in str(ei.value) and "Checkpoint" in str(ei.value)
+    # Host numpy arrays remain fine (stored as .npy blobs).
+    store.reject_device_arrays("ok", {"w": np.ones(3)})
+
+
 def test_deploy_and_params_cli(isolated_home, capsys):
     from tpuflow.flow.runner import main
 

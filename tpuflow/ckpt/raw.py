@@ -73,6 +73,9 @@ class RecyclePool:
         self._lock = threading.Lock()
         self._files: dict[int, list[str]] = {}  # size -> paths
         self._counter = 0
+        self._warm_promised: dict[int, int] = {}
+        self._warm_threads: list[threading.Thread] = []
+        self._warm_cancel = threading.Event()
         if os.path.isdir(directory):
             for name in os.listdir(directory):
                 path = os.path.join(directory, name)
@@ -124,7 +127,17 @@ class RecyclePool:
         shutil.rmtree(step_dir, ignore_errors=True)
 
     def take(self, nbytes: int) -> str | None:
-        """Pop a pooled file (exact-size match preferred) or None."""
+        """Pop a pooled file (exact-size match preferred) or None.
+
+        Tiny requests (< 64 KiB, below the prewarm threshold) never draw
+        from the pool: the in-place overwrite truncates the recycled file,
+        so a small leaf would destroy a large warm file's pages for a
+        fresh-write saving that is noise. The size-mismatch fallback
+        likewise only hands out files at least as large as the request —
+        their page prefix is reused and nothing warm is freed.
+        """
+        if nbytes < 64 * 1024:
+            return None
         with self._lock:
             bucket = self._files.get(nbytes)
             if bucket:
@@ -132,9 +145,11 @@ class RecyclePool:
                 if not bucket:
                     del self._files[nbytes]
                 return path
-            # Any file still beats a fresh one: overlapping pages are reused,
-            # the tail (if growing) faults like a fresh write.
-            for size in list(self._files):
+            # A larger file still beats a fresh write: the overlapping page
+            # prefix is reused; the truncated tail was surplus anyway.
+            candidates = [s for s in self._files if s >= nbytes]
+            if candidates:
+                size = min(candidates)
                 bucket = self._files[size]
                 path = bucket.pop()
                 if not bucket:
@@ -142,11 +157,113 @@ class RecyclePool:
                 return path
         return None
 
+    def prewarm(self, sizes: list[int]) -> None:
+        """Back pool pages for files of exactly ``sizes`` in the background.
+
+        The first saves of a process's lifetime otherwise pay for growing
+        the host's memory footprint (on ballooning hypervisors, first-touch
+        of new guest pages runs ~15x slower than a steady-state write, and
+        pages freed back to the host are reclaimed — so truncation waste
+        re-pays the cost). Prewarming creates pool files of zeroed,
+        *touched* pages at the exact shard sizes a save will request, while
+        the caller does real work (epoch-1 compute in the trainer), so even
+        the first checkpoint saves land on recycled pages at memcpy speed.
+        Files enter the pool one by one — a save racing the prewarm simply
+        consumes whatever is warm so far. Idempotent top-up: a repeated
+        request only creates files not already pooled or being created by
+        an in-flight prewarm (``_warm_promised`` tracks in-flight files
+        only; fulfilled or failed promises are released, so a pool drained
+        by saves can be topped up again). Sizes under 64 KiB are skipped
+        (their fresh-write cost is noise).
+        """
+        sizes = sorted((s for s in sizes if s >= 64 * 1024), reverse=True)
+        with self._lock:
+            have: dict[int, int] = {
+                s: len(v) for s, v in self._files.items()
+            }
+            for s, n in self._warm_promised.items():
+                have[s] = have.get(s, 0) + n
+            todo = []
+            for s in sizes:
+                if have.get(s, 0) > 0:
+                    have[s] -= 1
+                else:
+                    todo.append(s)
+                    self._warm_promised[s] = self._warm_promised.get(s, 0) + 1
+            if not todo:
+                return
+            t = threading.Thread(
+                target=self._prewarm_run, args=(todo,), daemon=True
+            )
+            self._warm_threads.append(t)
+        t.start()
+
+    def _release_promise(self, size: int) -> None:
+        n = self._warm_promised.get(size, 0)
+        if n <= 1:
+            self._warm_promised.pop(size, None)
+        else:
+            self._warm_promised[size] = n - 1
+
+    def _prewarm_run(self, sizes: list[int]) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        # One small reused source buffer: its own pages get backed once,
+        # while every written file page is a fresh first-touch (the cost
+        # this thread exists to absorb off the save path).
+        chunk = 32 * 2**20
+        buf = b"\0" * chunk
+
+        def abort(from_i: int, partial: str | None) -> None:
+            # Drop the partial file and release every unfulfilled promise
+            # so a later prewarm may retry (ENOSPC, cancel at close, ...).
+            if partial is not None:
+                try:
+                    os.unlink(partial)
+                except OSError:
+                    pass
+            with self._lock:
+                for s in sizes[from_i:]:
+                    self._release_promise(s)
+
+        for i, size in enumerate(sizes):
+            if self._warm_cancel.is_set():
+                return abort(i, None)
+            with self._lock:
+                self._counter += 1
+                path = os.path.join(self.directory, f"r{self._counter:08d}.bin")
+            try:
+                with open(path, "wb", buffering=0) as f:
+                    written = 0
+                    while written < size:
+                        if self._warm_cancel.is_set():
+                            return abort(i, path)
+                        f.write(buf[: min(chunk, size - written)])
+                        written += min(chunk, size - written)
+            except OSError:
+                return abort(i, path)
+            with self._lock:
+                self._files.setdefault(size, []).append(path)
+                self._release_promise(size)
+
+    def prewarm_wait(self, timeout: float | None = None) -> None:
+        with self._lock:
+            threads = list(self._warm_threads)
+        for t in threads:
+            t.join(timeout)
+
+    def cancel_prewarm(self) -> None:
+        """Stop in-flight prewarm promptly and join its threads (close())."""
+        self._warm_cancel.set()
+        self.prewarm_wait()
+        self._warm_cancel.clear()
+
     def clear(self) -> None:
         import shutil
 
+        self.cancel_prewarm()
         with self._lock:
             self._files.clear()
+            self._warm_promised.clear()
             shutil.rmtree(self.directory, ignore_errors=True)
 
 

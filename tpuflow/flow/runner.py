@@ -324,6 +324,11 @@ class FlowRunner:
         tdir = store.task_dir(self.flow_name, run_id, step_name, task_id)
         os.makedirs(tdir, exist_ok=True)
         state_path = os.path.join(tdir, "gang_state.pkl")
+        for name, value in flow._artifacts.items():
+            # Same contract as the datastore: device tensors never ship by
+            # pickle into the gang subprocesses — only Checkpoint handles.
+            if not isinstance(value, store.Checkpoint):
+                store.reject_device_arrays(name, value)
         with open(state_path, "wb") as f:
             pickle.dump(
                 {"artifacts": flow._artifacts, "module": self._flow_module()}, f

@@ -88,6 +88,32 @@ def read_run_meta(flow: str, run_id) -> dict:
 
 
 # ----------------------------------------------------------------- artifacts
+def reject_device_arrays(name: str, value: Any) -> None:
+    """Enforce the never-pickled-tensors artifact contract for jax.Arrays.
+
+    A ``jax.Array`` inside an artifact would silently ship device tensors
+    through pickle (cross-process in the gang launcher, cross-run in the
+    datastore). Device state travels as ``Checkpoint`` handles — path +
+    metadata — the way the reference moves it (train_flow.py:77 →
+    eval_flow.py:45-49), so reject the tensor loudly instead.
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:  # no jax imported → no jax.Arrays can exist
+        return
+    for leaf in jax.tree_util.tree_leaves(value):
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, np.ndarray):
+            raise TypeError(
+                f"artifact {name!r} contains a jax.Array "
+                f"({getattr(leaf, 'shape', ())}, "
+                f"{getattr(leaf, 'dtype', '?')}): device tensors are never "
+                "pickled into artifacts — save them through the "
+                "CheckpointManager and store the Checkpoint handle, or "
+                "convert to numpy explicitly if the value is small host data"
+            )
+
+
 def _encode(name: str, value: Any, blob_dir: str) -> dict:
     from tpuflow.train.trainer import Result
 
@@ -95,6 +121,7 @@ def _encode(name: str, value: Any, blob_dir: str) -> dict:
         return {"__type__": "checkpoint", **value.to_json()}
     if isinstance(value, Result):
         return {"__type__": "result", "value": value.to_json()}
+    reject_device_arrays(name, value)
     if isinstance(value, np.ndarray):
         fname = f"{name}.npy"
         np.save(os.path.join(blob_dir, fname), value)

@@ -106,6 +106,11 @@ def _masked_scores(q_ref, k_ref, iq, ik, *, scale, causal, block_q, block_k):
     return s
 
 
+def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                      **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr, **kw)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, block_q, block_k):
     iq = pl.program_id(1)
@@ -150,7 +155,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
         ).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        if lse_ref is not None:
+            # Mosaic requires ≥(8,128)-tileable outputs: lse rides a full
+            # 128-lane minor dim (value broadcast across lanes), the same
+            # layout the reference TPU flash kernels use for their softmax
+            # residuals. Only the VJP forward emits it — the primal path
+            # skips the output entirely (pallas outputs are opaque to XLA
+            # DCE, so an unused lse would still be written to HBM).
+            lse = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+            lse_ref[0] = jax.lax.broadcast_in_dim(
+                lse, lse_ref.shape[1:], (0,)
+            )
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -162,11 +177,35 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
     grid = (B * H, Tq // block_q, Tk // block_k)
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
-    )
-    out, lse = pl.pallas_call(
+    o_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    o_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
+    if with_lse:
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
+        out_specs = [
+            o_spec,
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ]
+        out_shape = [
+            o_shape,
+            # logsumexp per row — the softmax residual the backward kernels
+            # need to recompute P without re-running the online softmax.
+            # Broadcast over a 128-lane minor dim for TPU tiling.
+            jax.ShapeDtypeStruct((B * H, Tq, 128), jnp.float32),
+        ]
+    else:
+        # Primal/inference path: no lse output at all — pallas outputs are
+        # written unconditionally, so emitting-then-dropping it would cost
+        # a full (BH, Tq, 128) f32 HBM write per call.
+        kernel = functools.partial(
+            _fwd_kernel_nolse, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
+        out_specs = o_spec
+        out_shape = o_shape
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -174,16 +213,8 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            # logsumexp per row — the softmax residual the backward kernels
-            # need to recompute P without re-running the online softmax.
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0)
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom (col 0)
@@ -196,6 +227,7 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         ),
         interpret=interpret,
     )(qf, kf, vf)
+    out, lse = res if with_lse else (res, None)
     out = out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     if with_lse:
         return out, lse
@@ -209,10 +241,20 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
 #   dq kernel : grid (BH, nq, nk), k innermost — dq_i += dS_ij K_j
 #   dkv kernel: grid (BH, nk, nq), q innermost — dK_j += dS_ij^T Q_i,
 #                                                dV_j += P_ij^T dO_i
-# with dS = P ∘ (dP − D), dP = dO V^T, D = rowsum(dO ∘ O) precomputed in XLA.
+# with dS = P ∘ (dP − D), dP = dO V^T, D = rowsum(dO ∘ O) computed per q
+# block inside the kernels (cheap VPU reduce; avoids a second row-shaped
+# operand that Mosaic's (8,128) tiling can't express).
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _row_delta(o_ref, do_ref):
+    """D_i = rowsum(dO ∘ O) for the current q block → (block_q, 1) f32."""
+    return jnp.sum(
+        do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                    dq_scr, *, scale, causal, block_q, block_k):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -227,12 +269,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_ref, k_ref, iq, ik,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         )
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - _row_delta(o_ref, do_ref)) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -250,7 +292,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
                     dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
                     block_k):
     ik = pl.program_id(1)
@@ -267,7 +309,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             q_ref, k_ref, iq, ik,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         )
-        p = jnp.exp(s - lse_ref[0][:, None])  # (block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # (block_q, block_k)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -276,7 +318,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - _row_delta(o_ref, do_ref)) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -307,13 +349,12 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 
     qf, kf, vf = flat(q, Tq), flat(k, Tk), flat(v, Tk)
     of, gf = flat(o, Tq), flat(g, Tq)
-    # D_i = rowsum(dO ∘ O): cheap elementwise+reduce, stays in XLA.
-    delta = jnp.sum(
-        of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1
-    )
+    # Re-expand the (BH, Tq) residual to the 128-lane layout the kernels'
+    # block specs need; transient for the two backward calls only.
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
@@ -325,8 +366,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             q_spec,
-            row_spec,
-            row_spec,
+            q_spec,
+            lse_spec,
         ],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
@@ -335,18 +376,18 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, of, gf, lse)
 
     k_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
     qi_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
-    rowi_spec = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    lsei_spec = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
         grid=(BH, Tk // block_k, Tq // block_q),
-        in_specs=[qi_spec, k_spec, k_spec, qi_spec, rowi_spec, rowi_spec],
+        in_specs=[qi_spec, k_spec, k_spec, qi_spec, qi_spec, lsei_spec],
         out_specs=[k_spec, k_spec],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
@@ -360,7 +401,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, of, gf, lse)
 
     def unflat(x, T):
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
@@ -379,7 +420,10 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
     o, lse = _flash_fwd(
         q, k, v, causal, block_q, block_k, interpret, with_lse=True
     )
-    return o, (q, k, v, o, lse)
+    # The kernel emits lse broadcast over a 128-lane minor dim (Mosaic
+    # tiling); keep only lane 0 in the residual so the value held alive
+    # from forward to backward is (BH, Tq) f32, not 128x that.
+    return o, (q, k, v, o, lse[..., 0])
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):

@@ -145,6 +145,17 @@ class TrainContext:
     def checkpoint_manager(self) -> CheckpointManager | None:
         return self._manager
 
+    def prewarm_checkpoints(self, state) -> None:
+        """Start background page-backing for this state's checkpoint files.
+
+        Call right after building the train state: the pool warmup overlaps
+        epoch-1 compute so even the run's FIRST ``report(state=...)`` save
+        writes onto recycled pages (see RecyclePool.prewarm). Only this
+        process's addressable shard bytes are counted.
+        """
+        if self._manager is not None:
+            self._manager.prewarm(state)
+
     def report(
         self,
         metrics: dict[str, Any],
