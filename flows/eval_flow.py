@@ -61,15 +61,27 @@ class TpuEval(FlowSpec):
 
     def _get_checkpoint(self):
         """↔ eval_flow.py:40-54: trigger run first, then explicit pathspecs,
-        else raise."""
+        else raise.
+
+        Returns ``(checkpoint, producer_finished)`` — when the producing run
+        has succeeded, no process can still be writing/recycling its
+        checkpoint directory, which licenses the zero-copy (mmap) weight
+        load in the predictor.
+        """
         if current.trigger is not None and current.trigger.run is not None:
-            return current.trigger.run.data.result.best_checkpoint
+            run = current.trigger.run
+            return run.data.result.best_checkpoint, run.successful
         if self.eval_namespace:
             namespace(self.eval_namespace)  # ↔ eval_flow.py:32-36
         if self.checkpoint_task_pathspec:
-            return Task(self.checkpoint_task_pathspec).data.result.best_checkpoint
+            task = Task(self.checkpoint_task_pathspec)
+            return (
+                task.data.result.best_checkpoint,
+                Run(f"{task.flow}/{task.run_id}").successful,
+            )
         if self.checkpoint_run_pathspec:
-            return Run(self.checkpoint_run_pathspec).data.result.best_checkpoint
+            run = Run(self.checkpoint_run_pathspec)
+            return run.data.result.best_checkpoint, run.successful
         raise ValueError(
             "no checkpoint source: run with --triggered after a TpuTrain run, "
             "or pass --checkpoint-run-pathspec / --checkpoint-task-pathspec"
@@ -85,7 +97,7 @@ class TpuEval(FlowSpec):
 
         import my_tpu_module
 
-        checkpoint = self._get_checkpoint()
+        checkpoint, producer_finished = self._get_checkpoint()
         print(f"[eval_flow] evaluating checkpoint {checkpoint.path}")
 
         # Test set as rows (↔ get_dataloaders(val_only=True, as_ray_ds=True),
@@ -94,7 +106,11 @@ class TpuEval(FlowSpec):
         rows = my_tpu_module.get_dataloaders(
             self.batch_size, dataset=self.dataset, as_rows=True
         )
-        predictor = my_tpu_module.TpuPredictor(checkpoint)
+        # zero_copy weight load is sound only once the producing run is
+        # finished (no writer can recycle its checkpoint files anymore).
+        predictor = my_tpu_module.TpuPredictor(
+            checkpoint, zero_copy=producer_finished
+        )
         outputs = my_tpu_module.map_batches(
             rows, predictor, batch_size=self.batch_size
         )

@@ -287,6 +287,7 @@ class TpuPredictor:
         *,
         model=None,
         sample_shape: tuple = (28, 28),
+        zero_copy: bool = False,
     ):
         if isinstance(checkpoint, dict):
             checkpoint = Checkpoint.from_json(checkpoint)
@@ -295,6 +296,7 @@ class TpuPredictor:
             checkpoint,
             model if model is not None else NeuralNetwork(),
             sample_input=np.zeros((1, *sample_shape), np.float32),
+            zero_copy=zero_copy,
         )
 
     def __call__(self, batch: dict) -> dict:

@@ -190,6 +190,44 @@ def test_recycle_pool_reuses_files_without_corrupting_restores(tmp_path, mesh8):
     mgr.close()
 
 
+def test_zero_copy_restore_is_correct_and_recycle_safe(tmp_path, mesh8):
+    """zero_copy=True restores by mapping shard files (no read copy). The
+    restored arrays alias file pages, so the step's files must be excluded
+    from in-place recycling: later saves + retention must NOT mutate a
+    previously zero-copy-restored state."""
+    sharding = dist.batch_sharding(mesh8)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1, async_save=False)
+    states = [
+        {"params": {"w": jax.device_put(np.full((16, 8), float(i), np.float32), sharding)}}
+        for i in range(1, 4)
+    ]
+    abstract = {
+        "params": {
+            "w": jax.ShapeDtypeStruct((16, 8), np.float32, sharding=sharding)
+        }
+    }
+    mgr.save(1, states[0], metrics={"val_loss": 1.0})
+    restored = mgr.restore(1, abstract_state=abstract, zero_copy=True)
+    assert (np.asarray(restored["params"]["w"]) == 1.0).all()
+    # Saves 2 and 3 retire step 1 (and 2) through retention; with the step
+    # aliased, adopt_dir must unlink instead of pooling, so the restored
+    # array's pages are never overwritten in place.
+    for step in (2, 3):
+        mgr.save(step, states[step - 1], metrics={"val_loss": 1.0 / step})
+    mgr.wait_until_finished()
+    assert (np.asarray(restored["params"]["w"]) == 1.0).all(), (
+        "zero-copy restored state was mutated by recycled saves"
+    )
+    # Weights-only handle restore takes the same fast path.
+    from tpuflow.ckpt import restore_from_handle
+
+    params = restore_from_handle(
+        mgr.checkpoint(3), weights_only=True, zero_copy=True
+    )
+    assert (np.asarray(params["w"]) == 3.0).all()
+    mgr.close()
+
+
 def test_prewarm_backs_pool_pages_and_first_save_recycles(tmp_path, mesh8):
     """Manager.prewarm pre-creates pool files sized to the retention
     footprint so even the FIRST save of a process writes onto recycled

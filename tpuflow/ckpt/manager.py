@@ -446,6 +446,7 @@ class CheckpointManager:
         *,
         abstract_state=None,
         best: bool = False,
+        zero_copy: bool = False,
     ):
         """Restore the full pytree for ``step`` (default: latest; ``best=True``
         picks by metric — the reference restores *best*, my_ray_module.py:255).
@@ -454,6 +455,10 @@ class CheckpointManager:
         shardings) or a template pytree of arrays. With shardings attached,
         Orbax places/reshards shards directly onto the current mesh — this is
         how a v5e-32-written checkpoint restores on v5e-16.
+
+        ``zero_copy``: raw format only — restored arrays alias the mapped
+        shard files (no read copy); see raw.restore_raw for the safety
+        contract (read-only consumers of finished/owned runs).
         """
         from tpuflow.ckpt import raw as raw_fmt
 
@@ -463,6 +468,7 @@ class CheckpointManager:
             return raw_fmt.restore_raw(
                 state_dir,
                 _abstractify(abstract_state) if abstract_state is not None else None,
+                zero_copy=zero_copy,
             )
         if abstract_state is not None:
             return self._ckptr.restore(state_dir, _abstractify(abstract_state))
@@ -488,6 +494,7 @@ def restore_from_handle(
     *,
     abstract_state=None,
     weights_only: bool = False,
+    zero_copy: bool = False,
 ):
     """Restore state from a flow-level ``Checkpoint`` handle.
 
@@ -517,7 +524,9 @@ def restore_from_handle(
         state_dir = os.path.join(path, _STATE_DIR)
         if raw_fmt.is_raw(state_dir):
             if weights_only:
-                params = raw_fmt.restore_raw(state_dir, subtree=("params",))
+                params = raw_fmt.restore_raw(
+                    state_dir, subtree=("params",), zero_copy=zero_copy
+                )
                 if abstract_state is not None:
                     abstract = _abstractify(abstract_state)
                     params = jax.tree_util.tree_map(
@@ -536,6 +545,7 @@ def restore_from_handle(
             return raw_fmt.restore_raw(
                 state_dir,
                 _abstractify(abstract_state) if abstract_state is not None else None,
+                zero_copy=zero_copy,
             )
         if weights_only and abstract_state is not None:
             item = {"params": _abstractify(abstract_state)}

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import weakref
 from typing import Any
 
 import jax
@@ -39,6 +40,45 @@ from tpuflow import _native
 
 MANIFEST = "manifest.json"
 FORMAT_NAME = "tpuflow-raw-v2"
+
+# (st_dev, st_ino) -> live-mapping refcount for shard files whose mapped
+# pages escaped to a caller via zero_copy restore in this process: live
+# restored arrays alias those pages, so the recycle pool must never
+# overwrite the inodes in place (adopt_dir/take unlink them instead — the
+# pages outlive the unlink). Inode identity is immune to cwd changes and
+# symlinked path spellings; refcounts are released by a finalizer when the
+# mapping is garbage-collected, so a reused inode number is not excluded
+# forever. The cross-PROCESS hazard (another process recycling the same
+# checkpoint directory while this one holds mappings) is documented on
+# restore_raw.
+_ALIASED_INODES: dict[tuple[int, int], int] = {}
+_ALIASED_LOCK = threading.Lock()
+
+
+def _register_alias_fd(fd: int) -> tuple[int, int]:
+    st = os.fstat(fd)
+    key = (st.st_dev, st.st_ino)
+    with _ALIASED_LOCK:
+        _ALIASED_INODES[key] = _ALIASED_INODES.get(key, 0) + 1
+    return key
+
+
+def _unregister_alias(key: tuple[int, int]) -> None:
+    with _ALIASED_LOCK:
+        n = _ALIASED_INODES.get(key, 0)
+        if n <= 1:
+            _ALIASED_INODES.pop(key, None)
+        else:
+            _ALIASED_INODES[key] = n - 1
+
+
+def _is_aliased(path: str) -> bool:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    with _ALIASED_LOCK:
+        return (st.st_dev, st.st_ino) in _ALIASED_INODES
 
 
 def _mmap_enabled() -> bool:
@@ -115,6 +155,12 @@ class RecyclePool:
                 if not name.endswith(".bin"):
                     continue
                 src = os.path.join(root, name)
+                if _is_aliased(src):
+                    # A live zero-copy restore maps this inode's pages:
+                    # pooling it would let a later in-place overwrite mutate
+                    # the restored arrays. rmtree below unlinks it instead
+                    # (mapped pages outlive the unlink).
+                    continue
                 with self._lock:
                     self._counter += 1
                     dst = os.path.join(self.directory, f"r{self._counter:08d}.bin")
@@ -139,22 +185,29 @@ class RecyclePool:
         if nbytes < 64 * 1024:
             return None
         with self._lock:
-            bucket = self._files.get(nbytes)
-            if bucket:
-                path = bucket.pop()
-                if not bucket:
-                    del self._files[nbytes]
-                return path
-            # A larger file still beats a fresh write: the overlapping page
-            # prefix is reused; the truncated tail was surplus anyway.
-            candidates = [s for s in self._files if s >= nbytes]
-            if candidates:
-                size = min(candidates)
-                bucket = self._files[size]
-                path = bucket.pop()
-                if not bucket:
-                    del self._files[size]
-                return path
+            # Exact size first, then the smallest larger file (its page
+            # prefix is reused; the truncated tail was surplus anyway).
+            candidates = [nbytes] if nbytes in self._files else []
+            candidates += sorted(
+                s for s in self._files if s > nbytes
+            )
+            for size in candidates:
+                bucket = self._files.get(size, [])
+                while bucket:
+                    path = bucket.pop()
+                    if not bucket:
+                        self._files.pop(size, None)
+                    if _is_aliased(path):
+                        # A live zero-copy mapping aliases this inode (it
+                        # won the adopt/registration race): overwriting it
+                        # in place would mutate restored arrays — unlink
+                        # instead and keep looking.
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                        continue
+                    return path
         return None
 
     def prewarm(self, sizes: list[int]) -> None:
@@ -501,19 +554,58 @@ def _read_shard(
     *,
     allow_mmap: bool | None = None,
     threads: int | None = None,
+    escapes: bool = True,
 ) -> np.ndarray:
+    """Read (or map) one shard file.
+
+    ``escapes=False`` promises the caller copies the returned array before
+    it reaches user code (e.g. assembling a full leaf), so a mapping does
+    not need the recycle-pool alias guard.
+    """
     nbytes = int(np.prod(shard["shape"]) * dtype.itemsize) if shard["shape"] else dtype.itemsize
     path = os.path.join(directory, shard["file"])
     if _mmap_enabled() if allow_mmap is None else allow_mmap:
         # Zero-copy: map the file's pages instead of reading into a fresh
         # buffer (copy-on-write so callers get a writable array without
         # touching the checkpoint). Consumers that place onto devices copy
-        # exactly once, from the mapped pages.
+        # exactly once, from the mapped pages — or alias them outright on
+        # the CPU backend, hence the escape registration. The inode is
+        # registered from OUR open fd before the mapping escapes, and the
+        # path is re-checked afterwards: if the recycle pool adopted the
+        # file in the registration window, the mapping is discarded and we
+        # fall back to a plain copy (a freshly re-read one — the mapped
+        # bytes could already be mid-overwrite).
+        flat = None
+        key = None
         try:
-            flat = np.memmap(path, dtype=np.uint8, mode="c", shape=(nbytes,))
+            f = open(path, "rb")
+        except OSError:
+            f = None
+        if f is not None:
+            try:
+                if escapes:
+                    key = _register_alias_fd(f.fileno())
+                try:
+                    flat = np.memmap(f, dtype=np.uint8, mode="c", shape=(nbytes,))
+                except (OSError, ValueError):
+                    flat = None  # zero-length/unmappable: fall through
+            finally:
+                f.close()
+        if flat is not None and escapes:
+            try:
+                st = os.stat(path)
+                same = (st.st_dev, st.st_ino) == key
+            except OSError:
+                same = False
+            if not same:
+                flat = None
+        if flat is None:
+            if key is not None:
+                _unregister_alias(key)
+        else:
+            if key is not None:
+                weakref.finalize(flat, _unregister_alias, key)
             return flat.view(dtype).reshape(shard["shape"])
-        except (OSError, ValueError):
-            pass  # zero-length or unmappable file: fall through
     buf = _native.read_bytes(path, nbytes, threads=threads)
     return buf.view(dtype).reshape(shard["shape"])
 
@@ -599,12 +691,22 @@ def _aligned_like(shape, dtype: np.dtype) -> np.ndarray:
 
 
 def _read_leaf(
-    directory: str, entry: dict, *, threads: int | None = None
+    directory: str,
+    entry: dict,
+    *,
+    threads: int | None = None,
+    zero_copy: bool = False,
 ) -> np.ndarray:
     dtype = np.dtype(entry["dtype"])
     shards = entry["shards"]
     if len(shards) == 1 and shards[0]["shape"] == entry["shape"]:
-        return _read_shard(directory, shards[0], dtype, threads=threads)
+        return _read_shard(
+            directory,
+            shards[0],
+            dtype,
+            threads=threads,
+            allow_mmap=True if zero_copy else None,
+        )
     full = _aligned_like(tuple(entry["shape"]), dtype)
     for shard in shards:
         idx = tuple(
@@ -612,8 +714,10 @@ def _read_leaf(
             for start, dim in zip(shard["start"], shard["shape"])
         )
         # The copy into `full` makes the data private, so mapping the shard
-        # file here is always safe (no alias escapes).
-        full[idx] = _read_shard(directory, shard, dtype, allow_mmap=True)
+        # file here is always safe (no alias escapes → no registration).
+        full[idx] = _read_shard(
+            directory, shard, dtype, allow_mmap=True, escapes=False
+        )
     return full
 
 
@@ -622,6 +726,7 @@ def restore_raw(
     abstract_state: Any | None = None,
     *,
     subtree: tuple[str, ...] | None = None,
+    zero_copy: bool = False,
 ):
     """Restore a raw checkpoint.
 
@@ -632,6 +737,15 @@ def restore_raw(
       for dict-shaped trees like ``{"params": ...}``).
     - ``subtree``: restore only leaves whose path starts with this prefix,
       returned as the corresponding nested structure (partial restore).
+    - ``zero_copy``: map shard files instead of reading them — restored
+      arrays alias the files' page-cache pages (no buffer allocation, no
+      copy; XLA's CPU client aliases page-aligned host memory), and data
+      is paged in on first use. Sound in-process: every file whose mapping
+      escapes is registered by inode, and RecyclePool.adopt_dir unlinks
+      registered inodes instead of recycling them in place. NOT safe if a
+      *different* process may recycle the same checkpoint directory while
+      this one holds the arrays — use only for read-only consumers of runs
+      this process owns or that are finished (batch eval, benches).
     """
     manifest = _read_manifest(directory)
     entries = manifest["leaves"]
@@ -682,14 +796,23 @@ def restore_raw(
         def read_group(entry, tmpl, shard, devices):
             arr = _cast(
                 _read_shard(
-                    directory, shard, np.dtype(entry["dtype"]), threads=read_threads
+                    directory,
+                    shard,
+                    np.dtype(entry["dtype"]),
+                    threads=read_threads,
+                    allow_mmap=True if zero_copy else None,
                 ),
                 tmpl,
             )
             return [jax.device_put(arr, dev) for dev in devices]
 
         def assemble_fallback(entry, tmpl):
-            arr = _cast(_read_leaf(directory, entry, threads=read_threads), tmpl)
+            arr = _cast(
+                _read_leaf(
+                    directory, entry, threads=read_threads, zero_copy=zero_copy
+                ),
+                tmpl,
+            )
             sharding = getattr(tmpl, "sharding", None)
             return _place(arr, sharding) if sharding is not None else arr
 
@@ -728,7 +851,7 @@ def restore_raw(
     root: dict = {}
     for entry in entries:
         names = entry["path"][len(subtree) :] if subtree else entry["path"]
-        arr = _read_leaf(directory, entry)
+        arr = _read_leaf(directory, entry, zero_copy=zero_copy)
         if not names:
             return arr  # the subtree was a single leaf
         node = root

@@ -45,7 +45,13 @@ class BatchPredictor:
 
     @classmethod
     def from_checkpoint(
-        cls, checkpoint: Checkpoint, model, *, sample_input=None, mesh=None
+        cls,
+        checkpoint: Checkpoint,
+        model,
+        *,
+        sample_input=None,
+        mesh=None,
+        zero_copy: bool = False,
     ) -> "BatchPredictor":
         """Load weights once at construction (↔ my_ray_module.py:268-273,
         which restores best_model.pt in TorchPredictor.__init__).
@@ -54,6 +60,13 @@ class BatchPredictor:
         abstract tree derived from the model (replicated on the current
         mesh), so a checkpoint written on any training topology loads on the
         inference topology.
+
+        ``zero_copy=True`` makes the weights alias the mapped shard files
+        (predictor startup skips the full read copy; pages stream in on
+        first use). Only safe when no other process may still be writing or
+        recycling the producing run's checkpoint directory — i.e. the run
+        is finished (see raw.restore_raw); the eval flow enables it after
+        checking the producing run succeeded.
         """
         mesh = mesh if mesh is not None else dist.make_mesh()
         abstract = None
@@ -67,7 +80,8 @@ class BatchPredictor:
                 shapes,
             )
         params = restore_from_handle(
-            checkpoint, weights_only=True, abstract_state=abstract
+            checkpoint, weights_only=True, abstract_state=abstract,
+            zero_copy=zero_copy,
         )
         return cls(model, params, mesh=mesh)
 
