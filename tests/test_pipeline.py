@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the 'stage' axis.
+
+Completes the parallelism matrix (SURVEY.md §2c: PP absent from the
+reference; the mesh design must not preclude it). Equivalence oracle: the
+non-pipelined scan-layers GPT-2 forward on identical params."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuflow import dist
+from tpuflow.models.gpt2 import GPT2, GPT2Config
+from tpuflow.parallel.pipeline import (
+    gpt2_pipeline_loss,
+    gpt2_pipeline_shardings,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = GPT2Config.small_test(scan_layers=True, n_layer=4, dropout=0.0)
+    mesh = dist.make_mesh({"data": 2, "stage": 4})
+    model = GPT2(cfg)
+    rng = np.random.default_rng(0)
+    B, T = 8, cfg.n_ctx
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32
+    )
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+    return cfg, mesh, model, params, x, y
+
+
+def _reference_loss(model, params, x, y):
+    logits = model.apply({"params": params}, x, train=False)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def test_pipeline_loss_matches_single_device(setup):
+    cfg, mesh, model, params, x, y = setup
+    ref = float(_reference_loss(model, params, x, y))
+    loss_fn = gpt2_pipeline_loss(cfg, mesh=mesh, n_microbatches=2)
+    with mesh:
+        placed = jax.device_put(params, gpt2_pipeline_shardings(mesh, params))
+        got = float(jax.jit(loss_fn)(placed, x, y))
+    assert got == pytest.approx(ref, rel=1e-5), (got, ref)
+
+
+def test_pipeline_grads_match_single_device(setup):
+    cfg, mesh, model, params, x, y = setup
+    ref_grads = jax.grad(lambda p: _reference_loss(model, p, x, y))(params)
+    loss_fn = gpt2_pipeline_loss(cfg, mesh=mesh, n_microbatches=2)
+    with mesh:
+        placed = jax.device_put(params, gpt2_pipeline_shardings(mesh, params))
+        pp_grads = jax.jit(jax.grad(loss_fn))(placed, x, y)
+    flat_ref = jax.tree_util.tree_leaves(ref_grads)
+    flat_pp = jax.tree_util.tree_leaves(pp_grads)
+    assert len(flat_ref) == len(flat_pp)
+    for a, b in zip(flat_ref, flat_pp):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32),
+            np.asarray(b, np.float32),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+
+def test_pipeline_block_params_sharded_over_stage(setup):
+    cfg, mesh, model, params, x, y = setup
+    with mesh:
+        placed = jax.device_put(params, gpt2_pipeline_shardings(mesh, params))
+    leaf = jax.tree_util.tree_leaves(placed["h"]["block"])[0]
+    # 4 stages x 1 layer each: every stage holds a distinct layer slice.
+    owned = {
+        s.index[0] for s in leaf.addressable_shards
+    }
+    assert len(owned) == 4
+    # Non-block params replicated: every shard spans the full array.
+    wte = placed["wte"]
+    assert wte.sharding.is_fully_replicated
+    assert all(
+        s.data.shape == wte.shape for s in wte.addressable_shards
+    )
+
+
+def test_pipeline_rejects_bad_config(setup):
+    cfg, mesh, model, params, x, y = setup
+    with pytest.raises(ValueError):
+        gpt2_pipeline_loss(
+            GPT2Config.small_test(scan_layers=True, n_layer=3),
+            mesh=mesh,
+            n_microbatches=2,
+        )
+    with pytest.raises(ValueError):
+        gpt2_pipeline_loss(
+            GPT2Config.small_test(scan_layers=False),
+            mesh=mesh,
+            n_microbatches=2,
+        )
+    # MoE aux loss is not collected by the pipeline yet — must refuse
+    # rather than silently train without the load-balance term.
+    with pytest.raises(NotImplementedError):
+        gpt2_pipeline_loss(
+            GPT2Config.small_test(scan_layers=True, n_layer=4, n_experts=4),
+            mesh=mesh,
+            n_microbatches=2,
+        )

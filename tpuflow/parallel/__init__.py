@@ -1,9 +1,22 @@
-"""Parallelism layouts over the named mesh: FSDP, tensor, sequence (ring)."""
+"""Parallelism layouts over the named mesh: FSDP, tensor, sequence (ring),
+pipeline (GPipe over 'stage')."""
 
+from tpuflow.parallel.pipeline import (
+    gpt2_pipeline_loss,
+    gpt2_pipeline_shardings,
+    make_pipeline_loss,
+)
 from tpuflow.parallel.sharding import (
     create_sharded_state,
     gpt2_tensor_rules,
     make_shardings,
 )
 
-__all__ = ["create_sharded_state", "gpt2_tensor_rules", "make_shardings"]
+__all__ = [
+    "create_sharded_state",
+    "gpt2_tensor_rules",
+    "make_shardings",
+    "make_pipeline_loss",
+    "gpt2_pipeline_loss",
+    "gpt2_pipeline_shardings",
+]

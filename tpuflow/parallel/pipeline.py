@@ -1,0 +1,216 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a 'stage' mesh
+axis, TPU-idiomatic (shard_map + lax.ppermute over ICI neighbors).
+
+The reference exercises no pipeline parallelism (its model is a 3-layer MLP,
+my_ray_module.py:94-112; SURVEY.md §2c marks PP absent) — this exists so the
+framework's parallelism matrix (dp/fsdp/tp/sp/ep/**pp**) is complete and the
+mesh design demonstrably does not preclude it.
+
+Design (SPMD, compiler-friendly):
+
+- The model's repeated blocks are stacked along a leading layer axis (the
+  ``scan_layers=True`` parameter layout of ``tpuflow.models.gpt2.GPT2``) and
+  sharded over ``stage``: each stage owns ``n_layer / n_stages`` contiguous
+  layers. Nothing is "sent" at schedule time except activations.
+- The batch is split into M microbatches. One ``lax.scan`` runs
+  ``M + S - 1`` ticks; at each tick every stage applies its layer slice to
+  its current activation and passes the result to the next stage with a
+  single ``lax.ppermute`` — nearest-neighbor ICI traffic, no host logic, no
+  dynamic shapes. The first/last ticks are the classic GPipe bubble; their
+  garbage activations are masked out of the loss.
+- The embedding runs where stage 0 ingests a microbatch and the loss head
+  where the last stage emits one; under SPMD every device executes both and
+  a ``where(stage_id == ...)`` selects the real value (the textbook
+  single-program pipeline; the redundant compute is bubble-shaped and small
+  next to the block stack for deep models).
+- ``jax.grad`` differentiates straight through: the transpose of
+  ``ppermute`` is the reverse permute, so the backward schedule is the
+  mirrored pipeline — no hand-written backward pass.
+
+Composes with data parallelism: run on a ``{'data': D, 'stage': S}`` mesh —
+the batch shards over 'data', losses psum over both axes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_STAGE = "stage"
+
+
+def make_pipeline_loss(
+    block_apply: Callable[[Any, jax.Array], jax.Array],
+    embed: Callable[[Any, jax.Array], jax.Array],
+    head_loss: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    data_axis: str = "data",
+) -> Callable[[Any, Any, jax.Array, jax.Array], jax.Array]:
+    """Build ``loss(stacked_block_params, other_params, tokens, targets)``.
+
+    - ``block_apply(block_params, x) -> x``: one repeated block, given one
+      layer's params (a slice of the stacked tree along its leading axis).
+    - ``embed(other_params, tokens) -> x``: the stage-0 ingress computation.
+    - ``head_loss(other_params, x, targets) -> scalar``: the last-stage
+      egress computation (mean loss over the microbatch's tokens).
+
+    The returned callable is jit-compatible and differentiable; its result
+    is the mean loss over all microbatches, replicated on every device.
+    """
+    S = mesh.shape[AXIS_STAGE]
+    D = mesh.shape.get(data_axis, 1)
+    M = n_microbatches
+    if S < 2:
+        raise ValueError(f"pipeline needs >=2 stages, mesh has {S}")
+
+    def spmd(blocks_local, other, tokens, targets):
+        # blocks_local: this stage's (n_layer/S, ...) slice of every leaf.
+        # tokens/targets: this data-shard's (B/D, T) slice.
+        sid = jax.lax.axis_index(AXIS_STAGE)
+        Bd, T = tokens.shape
+        if Bd % M:
+            raise ValueError(f"per-data-shard batch {Bd} not divisible by M={M}")
+        x_mb = tokens.reshape(M, Bd // M, T)
+        y_mb = targets.reshape(M, Bd // M, T)
+
+        def apply_stage(x):
+            def body(h, layer_params):
+                return block_apply(layer_params, h), None
+
+            out, _ = jax.lax.scan(body, x, blocks_local)
+            return out
+
+        # Shape/dtype of the inter-stage activation buffer.
+        probe = jax.eval_shape(lambda t: embed(other, t), x_mb[0])
+        state0 = jnp.zeros(probe.shape, probe.dtype)
+
+        right = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            state, loss_acc = carry
+            # Stage 0 ingests microbatch t while ingress ticks remain.
+            ingress = embed(other, x_mb[jnp.clip(t, 0, M - 1)])
+            x = jnp.where(sid == 0, ingress, state)
+            y = apply_stage(x)
+            # Last stage emits microbatch t-(S-1) once the pipe has filled.
+            emit_t = jnp.clip(t - (S - 1), 0, M - 1)
+            mb_loss = head_loss(other, y, y_mb[emit_t])
+            valid = (sid == S - 1) & (t >= S - 1)
+            loss_acc = loss_acc + jnp.where(valid, mb_loss, 0.0)
+            # Hand activations to the right neighbor (ICI nearest-neighbor);
+            # stage S-1's output leaves the pipe (no wraparound edge).
+            state = jax.lax.ppermute(y, AXIS_STAGE, right)
+            return (state, loss_acc), None
+
+        (_, loss_acc), _ = jax.lax.scan(
+            tick, (state0, jnp.float32(0.0)), jnp.arange(M + S - 1)
+        )
+        # Only the last stage accumulated real losses; psum replicates the
+        # total everywhere. Mean over microbatches and data shards.
+        total = jax.lax.psum(loss_acc, AXIS_STAGE)
+        if D > 1:
+            total = jax.lax.psum(total, data_axis) / D
+        return total / M
+
+    def loss_fn(stacked_blocks, other, tokens, targets):
+        # check_vma=False: the scan carries (activation buffer, loss
+        # accumulator) start as replicated zeros and become device-varying
+        # on the first tick — intended here, the masking/psum make the
+        # final output replicated again.
+        f = shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(AXIS_STAGE), P(), P(data_axis), P(data_axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return f(stacked_blocks, other, tokens, targets)
+
+    return loss_fn
+
+
+# --------------------------------------------------------- GPT-2 adapter
+def gpt2_pipeline_loss(
+    config,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> Callable[[Any, jax.Array, jax.Array], jax.Array]:
+    """Pipeline-parallel LM loss for ``GPT2(config, scan_layers=True)``
+    params (the stacked-block layout), split as embed | blocks | ln_f+head.
+
+    Dropout is off (inference-mode blocks): pipeline training runs the
+    deterministic path, matching ``train=False`` semantics. Params keep the
+    exact GPT2 pytree, so checkpoints interchange with the non-pipelined
+    scan model. Cites the non-pipelined loss (train/step.py:89-105) as the
+    numerical reference; ``tests/test_pipeline.py`` asserts equivalence.
+    """
+    from tpuflow.models.gpt2 import Block
+    from tpuflow.models.losses import cross_entropy_loss
+
+    cfg = config
+    if not cfg.scan_layers:
+        raise ValueError("pipeline params require GPT2Config(scan_layers=True)")
+    if cfg.n_layer % mesh.shape[AXIS_STAGE]:
+        raise ValueError(
+            f"n_layer={cfg.n_layer} not divisible by "
+            f"stage={mesh.shape[AXIS_STAGE]}"
+        )
+    if cfg.n_experts > 0:
+        # block.apply here runs without mutable=['losses'], so the sown MoE
+        # load-balance aux loss would be silently DROPPED — diverging from
+        # the non-pipelined step (train/step.py:101-103) with no error.
+        # Reject until the pipeline collects sown losses.
+        raise NotImplementedError(
+            "pipeline parallelism does not yet collect the MoE aux loss; "
+            "use n_experts=0 or the non-pipelined step for MoE models"
+        )
+    block = Block(cfg)
+
+    def block_apply(layer_params, x):
+        return block.apply({"params": layer_params}, x, False)
+
+    def embed(other, tokens):
+        T = tokens.shape[1]
+        x = other["wte"][tokens].astype(cfg.dtype)
+        return x + other["wpe"][:T].astype(cfg.dtype)
+
+    def head_loss(other, x, targets):
+        import flax.linen as nn
+
+        x = nn.LayerNorm(dtype=cfg.dtype).apply({"params": other["ln_f"]}, x)
+        logits = jnp.einsum(
+            "btc,vc->btv", x, other["wte"].astype(cfg.dtype)
+        ).astype(jnp.float32)
+        # The canonical LM loss — same helper as the non-pipelined step.
+        return cross_entropy_loss(logits, targets)
+
+    pipe = make_pipeline_loss(
+        block_apply, embed, head_loss, mesh=mesh, n_microbatches=n_microbatches
+    )
+
+    def loss_fn(params, tokens, targets):
+        other = {k: v for k, v in params.items() if k != "h"}
+        return pipe(params["h"]["block"], other, tokens, targets)
+
+    return loss_fn
+
+
+def gpt2_pipeline_shardings(mesh: Mesh, params):
+    """NamedShardings for a GPT2 scan-layout param tree under pipeline
+    parallelism: the stacked blocks split over 'stage', the rest replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(
+            mesh,
+            P(AXIS_STAGE)
+            if any(getattr(k, "key", None) == "h" for k in path)
+            else P(),
+        ),
+        params,
+    )
