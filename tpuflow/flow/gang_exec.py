@@ -33,6 +33,35 @@ def _bootstrap_jax() -> None:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
+def _store_artifacts(flow_name: str, run_id: str, step_name: str) -> dict:
+    """Artifacts of the most recently completed upstream task in the run's
+    datastore — the k8s-pod replacement for the local launcher's pickled
+    gang state (each step runs as its own Job against shared storage, the
+    Metaflow execution model the deployer's manifests assume)."""
+    from tpuflow.flow import store
+
+    rd = store.run_dir(flow_name, run_id)
+    if not os.path.isdir(rd):
+        os.makedirs(rd, exist_ok=True)
+        store.write_run_meta(
+            flow_name, run_id, {"run_id": run_id, "status": "running"}
+        )
+        return {}
+    best = None
+    for root, _dirs, files in os.walk(rd):
+        if "artifacts.json" not in files:
+            continue
+        parts = root.rstrip(os.sep).split(os.sep)
+        if len(parts) < 2 or parts[-2] == step_name:
+            continue  # not a task dir / the step being (re)run
+        mtime = os.path.getmtime(os.path.join(root, "artifacts.json"))
+        if best is None or mtime > best[0]:
+            best = (mtime, parts[-2], parts[-1])
+    if best is None:
+        return {}
+    return store.load_artifacts(flow_name, run_id, best[1], int(best[2]))
+
+
 def main(argv: list[str]) -> None:
     flow_file, class_name, step_name, run_id, task_id, state_path = argv
     _bootstrap_jax()
@@ -43,8 +72,13 @@ def main(argv: list[str]) -> None:
     spec.loader.exec_module(module)
     flow_cls = getattr(module, class_name)
 
-    with open(state_path, "rb") as f:
-        state = pickle.load(f)
+    if state_path == "--from-store":
+        state = {
+            "artifacts": _store_artifacts(flow_cls.__name__, run_id, step_name)
+        }
+    else:
+        with open(state_path, "rb") as f:
+            state = pickle.load(f)
 
     from tpuflow import dist
     from tpuflow.flow import store

@@ -463,14 +463,33 @@ def main(flow_cls: type[FlowSpec], argv: list[str] | None = None):
         params, triggered = _parse_params(flow_cls, rest)
         return runner.run(params, triggered=triggered)
     if cmd == "deploy":
+        # Materialize the decorator records (@kubernetes/@pypi/@tpu/
+        # @schedule) into runnable k8s manifests — the deployer step the
+        # reference delegates to `argo-workflows create` (README.md:27-45).
+        from tpuflow.flow.deploy import materialize
+
+        out_dir = None
+        if "--manifest-dir" in rest:
+            i = rest.index("--manifest-dir")
+            if i + 1 >= len(rest):
+                raise SystemExit("--manifest-dir requires a directory argument")
+            out_dir = rest[i + 1]
+        if out_dir is None:
+            out_dir = os.path.join(
+                store.home(), "deployments", flow_cls.__name__
+            )
+        manifests = materialize(flow_cls, out_dir)
         record = {
             "flow": flow_cls.__name__,
             "schedule": getattr(flow_cls, "__schedule__", None),
             "trigger_on_finish": getattr(flow_cls, "__trigger_on_finish__", None),
+            "manifests": manifests,
             "deployed": time.time(),
         }
         path = store.write_deployment(flow_cls.__name__, record)
         print(f"[tpuflow] deployed {flow_cls.__name__}: {record} → {path}")
+        for m in manifests:
+            print(f"[tpuflow]   manifest: {m}")
         return path
     if cmd == "trigger":
         params, _ = _parse_params(flow_cls, rest)
