@@ -222,3 +222,98 @@ def test_gang_multihost_raw_checkpoint_roundtrip(tmp_path):
         assert run.data.n_bins == 4
     finally:
         os.environ.pop("TPUFLOW_GANG_LOCAL_DEVICES", None)
+
+
+@pytest.mark.slow
+def test_gang_hard_kill_then_retry_resumes_from_checkpoint(tmp_path):
+    """Fault injection, gang edition (SURVEY.md §4: 'kill a step and assert
+    the retry-equivalent rerun resumes from the latest retained
+    checkpoint'): every gang member hard-exits (os._exit) right after the
+    epoch-1 checkpoint commits; the flow-level @retry reruns the gang step
+    against the SAME storage path, which resumes at epoch 2 — at most one
+    epoch of work lost, and the run still succeeds."""
+    sentinel = tmp_path / "crashed"
+    os.environ["TPUFLOW_CRASH_SENTINEL"] = str(sentinel)
+    try:
+        flow_path = _write_flow(
+            tmp_path,
+            """
+            from tpuflow.flow import retry
+
+            class KR(FlowSpec):
+                @step
+                def start(self):
+                    self.next(self.train, num_parallel=2)
+
+                @retry(times=1)
+                @tpu(all_hosts_started_timeout=120)
+                @step
+                def train(self):
+                    import os
+                    import numpy as np
+                    import jax
+                    from jax.sharding import (
+                        Mesh, NamedSharding, PartitionSpec as P,
+                    )
+                    from tpuflow.ckpt import CheckpointManager
+
+                    mgr = CheckpointManager(
+                        os.path.join(current.tpu_storage_path, "ck"),
+                        async_save=False,
+                    )
+                    steps = mgr.all_steps()
+                    resumed_from = steps[-1] if steps else 0
+                    # A GLOBAL sharded array (each host owns its shard) —
+                    # per-host SingleDeviceSharding arrays would make both
+                    # hosts claim the same shard file.
+                    mesh = Mesh(np.asarray(jax.devices()), ("i",))
+                    sh = NamedSharding(mesh, P("i"))
+                    for ep in range(resumed_from + 1, 4):
+                        local = np.full((4,), float(ep), np.float32)
+                        w = jax.make_array_from_process_local_data(sh, local)
+                        mgr.save(
+                            ep, {"w": w}, metrics={"val_loss": 1.0 / ep}
+                        )
+                        marker = (
+                            os.environ["TPUFLOW_CRASH_SENTINEL"]
+                            + f".p{jax.process_index()}"
+                        )
+                        if ep == 1 and not os.path.exists(marker):
+                            open(marker, "w").write("x")
+                            # Hard death mid-step, AFTER the commit landed.
+                            os._exit(1)
+                    self.resumed_from = resumed_from
+                    self.final_steps = mgr.all_steps()
+                    mgr.close()
+                    self.next(self.done)
+
+                @step
+                def done(self, inputs):
+                    for inp in inputs:
+                        try:
+                            self.resumed_from = inp.resumed_from
+                            self.final_steps = inp.final_steps
+                            break
+                        except AttributeError:
+                            continue
+                    self.next(self.end)
+
+                @step
+                def end(self):
+                    pass
+            """,
+        )
+        KR = _load_flow(flow_path, "KR")
+        pathspec = FlowRunner(KR).run({})
+        from tpuflow.flow import Run
+
+        run = Run(pathspec)
+        assert run.successful
+        # Both members crashed once (per-process markers exist)...
+        assert os.path.exists(str(sentinel) + ".p0")
+        assert os.path.exists(str(sentinel) + ".p1")
+        # ...and the retry attempt found epoch 1's checkpoint and resumed.
+        assert run.data.resumed_from == 1
+        assert run.data.final_steps[-1] == 3
+    finally:
+        os.environ.pop("TPUFLOW_CRASH_SENTINEL", None)
