@@ -11,6 +11,7 @@ Run:    python flows/gpt_flow.py run --preset test --steps-per-epoch 8
 Medium: python flows/gpt_flow.py run --preset medium --data-axis 4 --fsdp-axis 8
 """
 
+import functools
 import os
 import sys
 
@@ -40,6 +41,21 @@ def _synth_tokens(n_docs: int, seq_len: int, vocab: int, seed: int = 0):
     )
 
 
+def _epoch_batches(docs, batch_size: int, steps: int, epoch: int):
+    """Deterministic per-epoch shuffle (seeded by epoch ↔ set_epoch,
+    my_ray_module.py:149-151) yielding `steps` full batches, wrapping the
+    tail back to the epoch's head. Shared by the FSDP and pipeline loops."""
+    import numpy as np
+
+    order = np.random.default_rng((0, epoch)).permutation(len(docs))
+    for s in range(steps):
+        lo = (s * batch_size) % len(docs)
+        idx = order[lo : lo + batch_size]
+        if len(idx) < batch_size:
+            idx = order[:batch_size]
+        yield docs[idx]
+
+
 class TpuGptTrain(FlowSpec):
     """Train GPT-2 with FSDP (+ optional tensor/sequence parallelism) on
     synthetic LM data, checkpointing the fully-sharded state."""
@@ -54,6 +70,12 @@ class TpuGptTrain(FlowSpec):
     fsdp_axis = Parameter("fsdp_axis", default=2, help="mesh 'fsdp' size")
     tensor_axis = Parameter("tensor_axis", default=1, help="mesh 'tensor' size")
     seq_axis = Parameter("seq_axis", default=1, help="mesh 'seq' size")
+    stage_axis = Parameter(
+        "stage_axis", default=1, help="mesh 'stage' size (GPipe pipeline)"
+    )
+    microbatches = Parameter(
+        "microbatches", default=2, help="pipeline microbatches per step"
+    )
     attn_impl = Parameter("attn_impl", default="xla", help="xla|flash|ring")
     from_run = Parameter(
         "from_run", default="", help="run pathspec to resume full state from"
@@ -74,7 +96,12 @@ class TpuGptTrain(FlowSpec):
                 attn_impl=self.attn_impl, scan_layers=True, remat=True
             )
         return GPT2Config.small_test(
-            attn_impl=self.attn_impl, n_ctx=max(128, self.seq_len)
+            attn_impl=self.attn_impl,
+            n_ctx=max(128, self.seq_len),
+            # Pipeline parallelism requires the scan-stacked block layout
+            # (one leading layer axis to shard over 'stage').
+            scan_layers=self.stage_axis > 1,
+            n_layer=max(2, self.stage_axis),
         )
 
     @step
@@ -101,6 +128,21 @@ class TpuGptTrain(FlowSpec):
         from tpuflow.train import TrainState, make_train_step
 
         cfg = self._config()
+        if self.stage_axis > 1:
+            # Pipeline composes with data parallelism only; the other axis
+            # parameters (fsdp defaults to 2) don't apply to this mesh.
+            if self.tensor_axis > 1 or self.seq_axis > 1:
+                raise ValueError(
+                    "pipeline (--stage-axis) composes with --data-axis only"
+                )
+            if self.fsdp_axis > 1:
+                print(
+                    "[gpt_flow] note: --fsdp-axis does not apply in pipeline "
+                    "mode; params shard by layer slice over 'stage' instead"
+                )
+            self._train_pipeline(cfg)
+            self.next(self.end)
+            return
         mesh = dist.make_mesh(
             {
                 "data": self.data_axis,
@@ -165,17 +207,10 @@ class TpuGptTrain(FlowSpec):
             rng = jax.random.PRNGKey(1)
             history = []
             for epoch in range(self.epochs):
-                order = np.random.default_rng((0, epoch)).permutation(len(docs))
                 losses = []
-                for s in range(self.steps_per_epoch):
-                    idx = order[
-                        (s * self.batch_size) % len(docs) : (s * self.batch_size)
-                        % len(docs)
-                        + self.batch_size
-                    ]
-                    if len(idx) < self.batch_size:
-                        idx = order[: self.batch_size]
-                    toks = docs[idx]
+                for toks in _epoch_batches(
+                    docs, self.batch_size, self.steps_per_epoch, epoch
+                ):
                     batch = {
                         "x": jax.device_put(toks[:, :-1], batch_sharding),
                         "y": jax.device_put(toks[:, 1:], batch_sharding),
@@ -200,6 +235,143 @@ class TpuGptTrain(FlowSpec):
             self.loss_history = history
             mgr.close()
         self.next(self.end)
+
+    def _train_pipeline(self, cfg):
+        """GPipe pipeline-parallel training over a ('data','stage') mesh:
+        scan-stacked blocks shard by layer slice (tpuflow.parallel.pipeline),
+        grads flow through the microbatch schedule, checkpoints carry the
+        pipeline-sharded state (the raw format's shard-ownership rule covers
+        any sharding, so resume works unchanged)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from tpuflow import dist
+        from tpuflow.ckpt import CheckpointManager, restore_from_handle
+        from tpuflow.models.gpt2 import GPT2
+        from tpuflow.parallel import (
+            gpt2_pipeline_loss,
+            gpt2_pipeline_shardings,
+        )
+
+        mesh = dist.make_mesh(
+            {"data": self.data_axis, "stage": self.stage_axis}
+        )
+        print(
+            f"[gpt_flow] pipeline mesh {dict(mesh.shape)}, "
+            f"microbatches={self.microbatches}"
+        )
+        model = GPT2(cfg)
+        tx = optax.adamw(self.learning_rate)
+        loss_fn = gpt2_pipeline_loss(
+            cfg, mesh=mesh, n_microbatches=self.microbatches
+        )
+
+        def init_params(rng):
+            return model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+
+        with mesh:
+            p_shapes = jax.eval_shape(init_params, jax.random.PRNGKey(0))
+            shardings = gpt2_pipeline_shardings(mesh, p_shapes)
+            # Params born sharded: init is jitted with the pipeline
+            # shardings as out_shardings, so no host ever materializes the
+            # full replicated tree.
+            params = jax.jit(init_params, out_shardings=shardings)(
+                jax.random.PRNGKey(0)
+            )
+            # Optimizer state mirrors the params tree (mu/nu under the same
+            # 'h' paths → 'stage'-sharded; counts are scalars → replicated),
+            # so the same path rule shards it.
+            opt_shape = jax.eval_shape(tx.init, p_shapes)
+            opt_shardings = gpt2_pipeline_shardings(mesh, opt_shape)
+            opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
+            start_step = 0
+
+            mgr = CheckpointManager(
+                os.path.join(current.tpu_storage_path, "checkpoints"),
+                max_to_keep=2,
+            )
+            if self.resume_checkpoint is not None:
+                abstract = {
+                    "step": jax.ShapeDtypeStruct((), jnp.int32),
+                    "params": jax.tree_util.tree_map(
+                        lambda s, sh: jax.ShapeDtypeStruct(
+                            s.shape, s.dtype, sharding=sh
+                        ),
+                        p_shapes,
+                        shardings,
+                    ),
+                    "opt_state": jax.tree_util.tree_map(
+                        lambda s, sh: jax.ShapeDtypeStruct(
+                            s.shape, s.dtype, sharding=sh
+                        ),
+                        opt_shape,
+                        opt_shardings,
+                    ),
+                }
+                restored = restore_from_handle(
+                    self.resume_checkpoint, abstract_state=abstract
+                )
+                # Normalize placement: scalar/replicated leaves may come
+                # back single-device; device_put onto the target shardings
+                # is idempotent for already-placed shards.
+                params = jax.device_put(restored["params"], shardings)
+                opt_state = jax.device_put(restored["opt_state"], opt_shardings)
+                start_step = int(restored["step"])
+                print("[gpt_flow] pipeline-sharded state restored")
+            mgr.prewarm({"params": params, "opt_state": opt_state})
+
+            # Donated params/opt_state: old and new state never coexist in
+            # HBM (matches make_train_step's donate pattern; safe because
+            # mgr.save snapshots device buffers synchronously before its
+            # async writer starts, and the loop rebinds both every step).
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def pp_step(params, opt_state, x, y):
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state, loss
+
+            docs = _synth_tokens(
+                max(self.batch_size * self.steps_per_epoch, self.batch_size),
+                self.seq_len,
+                cfg.vocab_size,
+            )
+            data_sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data")
+            )
+            history = []
+            global_step = start_step
+            for epoch in range(self.epochs):
+                losses = []
+                for toks in _epoch_batches(
+                    docs, self.batch_size, self.steps_per_epoch, epoch
+                ):
+                    params, opt_state, loss = pp_step(
+                        params,
+                        opt_state,
+                        jax.device_put(toks[:, :-1], data_sharding),
+                        jax.device_put(toks[:, 1:], data_sharding),
+                    )
+                    losses.append(loss)
+                    global_step += 1
+                jax.block_until_ready(params)
+                epoch_loss = float(jnp.stack(losses).mean())
+                history.append(epoch_loss)
+                print(f"[gpt_flow] pipeline epoch {epoch}: loss={epoch_loss:.4f}")
+                mgr.save(
+                    global_step,
+                    {
+                        "step": jnp.int32(global_step),
+                        "params": params,
+                        "opt_state": opt_state,
+                    },
+                    metrics={"val_loss": epoch_loss},
+                )
+            mgr.wait_until_finished()
+            self.result_checkpoint = mgr.checkpoint()
+            self.loss_history = history
+            mgr.close()
 
     @step
     def end(self):

@@ -102,3 +102,37 @@ def test_config5_gpt2_fsdp_checkpoint_resume(env):
     assert run2.successful
     # Resumed run starts from trained state: first epoch loss is lower.
     assert run2.data.loss_history[0] < first_loss
+
+
+@pytest.mark.slow
+def test_pipeline_parallel_flow_checkpoint_resume(env):
+    """Pipeline-parallel training through the flow CLI: GPipe over
+    ('data','stage'), pipeline-sharded checkpoint, full-state resume
+    continues the loss trajectory."""
+    gpt_flow = importlib.import_module("gpt_flow")
+    args = [
+        "run",
+        "--epochs",
+        "1",
+        "--steps-per-epoch",
+        "4",
+        "--batch-size",
+        "8",
+        "--data-axis",
+        "2",
+        "--stage-axis",
+        "4",
+    ]
+    pathspec = gpt_flow.TpuGptTrain.main(args)
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    assert run.successful
+    first_loss = run.data.loss_history[0]
+    ckpt = run.data.result_checkpoint
+    assert os.path.isdir(ckpt.path)
+
+    pathspec2 = gpt_flow.TpuGptTrain.main(args + ["--from-run", pathspec])
+    run2 = Run(pathspec2)
+    assert run2.successful
+    assert run2.data.loss_history[0] < first_loss
