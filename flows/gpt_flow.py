@@ -76,7 +76,7 @@ class TpuGptTrain(FlowSpec):
     microbatches = Parameter(
         "microbatches", default=2, help="pipeline microbatches per step"
     )
-    attn_impl = Parameter("attn_impl", default="xla", help="xla|flash|ring")
+    attn_impl = Parameter("attn_impl", default="xla", help="xla|flash|ring|ulysses")
     from_run = Parameter(
         "from_run", default="", help="run pathspec to resume full state from"
     )

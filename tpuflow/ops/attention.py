@@ -12,6 +12,11 @@ Implementations:
   around the ring via collective-permute while each shard computes blockwise
   attention — the TPU-native long-context strategy (absent from the reference,
   which has no attention at all; SURVEY.md §5 long-context).
+- ``"ulysses"`` — all-to-all sequence parallelism over 'seq'
+  (tpuflow.parallel.ulysses): all_to_alls swap q/k/v to full-sequence /
+  head-sharded layout, attention runs locally with plain causal masking,
+  one more all_to_all swaps the output back — 4 collectives per call vs
+  ring's s-step rotation.
 
 The reference has no attention op anywhere (its model is an image MLP,
 my_ray_module.py:94-112); these exist for the GPT-2 acceptance config and the
@@ -52,4 +57,10 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "xla"):
         from tpuflow.parallel.ring_attention import ring_attention
 
         return ring_attention(q, k, v, causal=causal)
-    raise KeyError(f"unknown attention impl {impl!r}; use xla|flash|ring")
+    if impl == "ulysses":
+        from tpuflow.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=causal)
+    raise KeyError(
+        f"unknown attention impl {impl!r}; use xla|flash|ring|ulysses"
+    )

@@ -11,6 +11,7 @@ from tpuflow.parallel.sharding import (
     gpt2_tensor_rules,
     make_shardings,
 )
+from tpuflow.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "create_sharded_state",
@@ -19,4 +20,5 @@ __all__ = [
     "make_pipeline_loss",
     "gpt2_pipeline_loss",
     "gpt2_pipeline_shardings",
+    "ulysses_attention",
 ]

@@ -120,18 +120,34 @@ def ring_attention(q, k, v, *, causal: bool = True, axis_name: str = AXIS_SEQ,
         from tpuflow.ops.flash_attention import blockwise_attention
 
         return blockwise_attention(q, k, v, causal=causal)
+    return seq_shard_map(
+        lambda q, k, v: _ring_shard_fn(
+            q, k, v, causal=causal, axis_name=axis_name
+        ),
+        mesh,
+        axis_name,
+        batch=q.shape[0],
+    )(q, k, v)
+
+
+def seq_shard_map(body, mesh, axis_name, *, batch: int):
+    """shard_map wrapper shared by the sequence-parallel attentions (ring and
+    ulysses): batch dim over the data-like axes when it divides, sequence dim
+    over ``axis_name``, heads/head_dim replicated. ``check_vma=False`` —
+    the bodies' carries/collectives manage their own device variance."""
     batch_axes = tuple(
         a for a in (AXIS_DATA, AXIS_FSDP) if mesh.shape.get(a, 1) > 1
     )
-    batch_size = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
-    if batch_axes and q.shape[0] % batch_size != 0:
+    batch_size = (
+        int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
+    )
+    if batch_axes and batch % batch_size != 0:
         batch_axes = ()  # e.g. model.init traces with batch 1: replicate it
     spec = P(batch_axes if batch_axes else None, axis_name, None, None)
-    fn = jax.shard_map(
-        lambda q, k, v: _ring_shard_fn(q, k, v, causal=causal, axis_name=axis_name),
+    return jax.shard_map(
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)

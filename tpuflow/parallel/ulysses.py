@@ -1,0 +1,89 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence swap.
+
+The second long-context strategy next to ring attention (SURVEY.md §5;
+the task mandates "ring attention or all-to-all sequence/context
+parallelism" — tpuflow ships both, selectable per model via
+``attn_impl='ring' | 'ulysses'``):
+
+- Activations arrive sequence-sharded over the 'seq' mesh axis:
+  each shard holds (B, T/s, H, D).
+- One ``lax.all_to_all`` swaps the sharded dimension: split the HEADS
+  across the axis and concatenate the sequence — every shard now holds
+  (B, T, H/s, D), i.e. the FULL sequence for a subset of heads.
+- Attention runs locally with ordinary causal masking (no cross-shard
+  softmax state at all — the advantage over ring for moderate contexts),
+  through any inner implementation (XLA einsum or the Pallas flash
+  kernel).
+- A second all-to-all swaps the output back to sequence sharding.
+
+Communication: 4 all-to-alls of one activation each per call (q, k, v in;
+output back) vs ring's s-step KV rotation; all ride ICI. Differentiable
+end-to-end (all_to_all transposes to all_to_all), so it drops into the
+training step as ``attn_impl='ulysses'`` on GPT2Config.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tpuflow.dist import AXIS_SEQ
+
+
+def _ulysses_shard_fn(q, k, v, *, causal: bool, axis_name: str,
+                      inner_impl: str):
+    """Per-shard body. q,k,v local: (B, T/s, H, D)."""
+    from tpuflow.ops.attention import attention
+
+    def to_heads(x):  # (B, T/s, H, D) -> (B, T, H/s, D)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def to_seq(x):  # (B, T, H/s, D) -> (B, T/s, H, D)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    out = attention(
+        to_heads(q), to_heads(k), to_heads(v), causal=causal, impl=inner_impl
+    )
+    return to_seq(out)
+
+
+def ulysses_attention(
+    q, k, v, *, causal: bool = True, axis_name: str = AXIS_SEQ, mesh=None,
+    inner_impl: str = "xla",
+):
+    """Sequence-parallel attention via head↔seq all-to-all. q,k,v:
+    (B, T, H, D) with T sharded over ``axis_name``; output sharded the same
+    way. Needs T and H divisible by the axis size; otherwise (or with a
+    trivial axis) falls back to blockwise attention — same math, no
+    communication, defined behavior instead of a shard_map error.
+
+    ``inner_impl`` selects the per-shard attention ('xla' or 'flash' — the
+    Pallas kernel composes, since each shard sees an ordinary full-sequence
+    attention over its head subset; the sequence-parallel impls would
+    re-enter shard_map and are rejected).
+    """
+    from tpuflow.parallel.ring_attention import _current_mesh, seq_shard_map
+
+    if inner_impl not in ("xla", "flash"):
+        raise ValueError(
+            f"inner_impl must be 'xla' or 'flash', got {inner_impl!r} "
+            "(sequence-parallel impls cannot nest inside ulysses)"
+        )
+    mesh = mesh if mesh is not None else _current_mesh()
+    s = mesh.shape.get(axis_name, 1)
+    B, T, H, D = q.shape
+    if s == 1 or T % s or H % s:
+        from tpuflow.ops.flash_attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, causal=causal)
+    return seq_shard_map(
+        lambda q, k, v: _ulysses_shard_fn(
+            q, k, v, causal=causal, axis_name=axis_name, inner_impl=inner_impl
+        ),
+        mesh,
+        axis_name,
+        batch=B,
+    )(q, k, v)
