@@ -27,33 +27,20 @@ from tpuflow.flow import (  # noqa: E402
     step,
 )
 
-def _synth_tokens(n_docs: int, seq_len: int, vocab: int, seed: int = 0):
-    """Deterministic learnable LM data: each document cycles an arithmetic
-    token pattern (next-token is predictable), with doc-dependent stride."""
-    import numpy as np
+def _lm_loader(batch_size: int, steps: int, seq_len: int, vocab: int):
+    """Sharded LM loader from the data subsystem (D4/D16 for the GPT
+    family): 'lm_synth' yields {'x': tokens[:, :-1], 'y': tokens[:, 1:]}
+    with the same seeded per-epoch reshuffle semantics as the image
+    loaders (set_epoch ↔ my_ray_module.py:149-151)."""
+    from tpuflow.data import ShardedLoader, load_dataset
 
-    rng = np.random.default_rng(seed)
-    starts = rng.integers(0, vocab, size=n_docs)
-    strides = rng.integers(1, 7, size=n_docs)
-    pos = np.arange(seq_len + 1)
-    return ((starts[:, None] + strides[:, None] * pos[None, :]) % vocab).astype(
-        np.int32
+    ds = load_dataset(
+        "lm_synth",
+        synthetic_size=max(batch_size * steps, batch_size),
+        seq_len=seq_len,
+        vocab_size=vocab,
     )
-
-
-def _epoch_batches(docs, batch_size: int, steps: int, epoch: int):
-    """Deterministic per-epoch shuffle (seeded by epoch ↔ set_epoch,
-    my_ray_module.py:149-151) yielding `steps` full batches, wrapping the
-    tail back to the epoch's head. Shared by the FSDP and pipeline loops."""
-    import numpy as np
-
-    order = np.random.default_rng((0, epoch)).permutation(len(docs))
-    for s in range(steps):
-        lo = (s * batch_size) % len(docs)
-        idx = order[lo : lo + batch_size]
-        if len(idx) < batch_size:
-            idx = order[:batch_size]
-        yield docs[idx]
+    return ShardedLoader(ds.train, batch_size=batch_size, shuffle=True)
 
 
 class TpuGptTrain(FlowSpec):
@@ -118,7 +105,6 @@ class TpuGptTrain(FlowSpec):
     def train(self):
         import jax
         import jax.numpy as jnp
-        import numpy as np
         import optax
 
         from tpuflow import dist
@@ -194,9 +180,8 @@ class TpuGptTrain(FlowSpec):
                 )
                 print("[gpt_flow] full sharded state restored")
 
-            docs = _synth_tokens(
-                max(self.batch_size * self.steps_per_epoch, self.batch_size),
-                self.seq_len,
+            loader = _lm_loader(
+                self.batch_size, self.steps_per_epoch, self.seq_len,
                 cfg.vocab_size,
             )
             seq_spec = "seq" if self.seq_axis > 1 else None
@@ -207,13 +192,12 @@ class TpuGptTrain(FlowSpec):
             rng = jax.random.PRNGKey(1)
             history = []
             for epoch in range(self.epochs):
+                loader.set_epoch(epoch)
                 losses = []
-                for toks in _epoch_batches(
-                    docs, self.batch_size, self.steps_per_epoch, epoch
-                ):
+                for b in loader:
                     batch = {
-                        "x": jax.device_put(toks[:, :-1], batch_sharding),
-                        "y": jax.device_put(toks[:, 1:], batch_sharding),
+                        "x": jax.device_put(b["x"], batch_sharding),
+                        "y": jax.device_put(b["y"], batch_sharding),
                     }
                     state, metrics = train_step(state, batch, rng)
                     losses.append(metrics["loss"])
@@ -244,7 +228,6 @@ class TpuGptTrain(FlowSpec):
         any sharding, so resume works unchanged)."""
         import jax
         import jax.numpy as jnp
-        import numpy as np
         import optax
 
         from tpuflow import dist
@@ -332,9 +315,8 @@ class TpuGptTrain(FlowSpec):
                 updates, opt_state = tx.update(grads, opt_state, params)
                 return optax.apply_updates(params, updates), opt_state, loss
 
-            docs = _synth_tokens(
-                max(self.batch_size * self.steps_per_epoch, self.batch_size),
-                self.seq_len,
+            loader = _lm_loader(
+                self.batch_size, self.steps_per_epoch, self.seq_len,
                 cfg.vocab_size,
             )
             data_sharding = jax.sharding.NamedSharding(
@@ -343,15 +325,14 @@ class TpuGptTrain(FlowSpec):
             history = []
             global_step = start_step
             for epoch in range(self.epochs):
+                loader.set_epoch(epoch)
                 losses = []
-                for toks in _epoch_batches(
-                    docs, self.batch_size, self.steps_per_epoch, epoch
-                ):
+                for b in loader:
                     params, opt_state, loss = pp_step(
                         params,
                         opt_state,
-                        jax.device_put(toks[:, :-1], data_sharding),
-                        jax.device_put(toks[:, 1:], data_sharding),
+                        jax.device_put(b["x"], data_sharding),
+                        jax.device_put(b["y"], data_sharding),
                     )
                     losses.append(loss)
                     global_step += 1

@@ -145,6 +145,39 @@ def _synth_classification(
     return make(n_train, seed + 1), make(n_test, seed + 2)
 
 
+def _load_synthetic_lm(
+    n_docs: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Dataset:
+    """Deterministic learnable LM data for the GPT family: each document
+    cycles an arithmetic token pattern (next token fully predictable from
+    the previous one), with a doc-dependent stride — loss decreases fast,
+    random guessing sits at log(vocab).
+
+    The Split reuses the image/label fields as numpy VIEWS of one token
+    buffer: ``images = tokens[:, :-1]`` (model input) and
+    ``labels = tokens[:, 1:]`` (next-token targets), so the sharded loader,
+    per-epoch reshuffle and device prefetcher work unchanged for language
+    models.
+    """
+    def make(n: int, split_seed: int) -> Split:
+        r = np.random.default_rng((seed, split_seed))
+        starts = r.integers(0, vocab_size, size=n)
+        strides = r.integers(1, 7, size=n)
+        pos = np.arange(seq_len + 1)
+        tokens = (
+            (starts[:, None] + strides[:, None] * pos[None, :]) % vocab_size
+        ).astype(np.int32)
+        return Split(tokens[:, :-1], tokens[:, 1:])
+
+    return Dataset(
+        "lm_synth",
+        make(n_docs, 1),
+        make(max(n_docs // 8, 1), 2),
+        num_classes=vocab_size,
+        synthetic=True,
+    )
+
+
 def _load_fashion_mnist(data_dir: str, name: str) -> Dataset:
     prefix = "" if name == "fashion_mnist" else ""
     files = {
@@ -217,14 +250,22 @@ def load_dataset(
     *,
     data_dir: str | None = None,
     synthetic_size: int = 2_000,
+    seq_len: int = 64,
+    vocab_size: int = 512,
 ) -> Dataset:
     """Load (or synthesize) a dataset by name, with npz caching under a
-    FileLock so only one process per host does the decode/generation."""
+    FileLock so only one process per host does the decode/generation.
+    ``seq_len``/``vocab_size`` apply to the 'lm_synth' language-model
+    dataset (its Split holds token ids, not images)."""
     data_dir = data_dir or _DEFAULT_DIR
     os.makedirs(data_dir, exist_ok=True)
     if name == "imagenet_synth":
         # Deterministic generation; too large to be worth an npz cache.
         return _load_synthetic_imagenet(synthetic_size)
+    if name == "lm_synth":
+        # Deterministic + parameterized by shape: cheap to regenerate, and
+        # an npz cache keyed only on the name would collide across shapes.
+        return _load_synthetic_lm(synthetic_size, seq_len, vocab_size)
     cache = os.path.join(data_dir, f"{name}_cache.npz")
     with FileLock(os.path.join(data_dir, f".{name}.lock")):
         if os.path.exists(cache):
@@ -245,7 +286,7 @@ def load_dataset(
         else:
             raise KeyError(
                 f"unknown dataset {name!r}; available: fashion_mnist, mnist, "
-                "cifar10, imagenet_synth"
+                "cifar10, imagenet_synth, lm_synth"
             )
         np.savez(
             cache,
