@@ -99,11 +99,46 @@ def test_pipeline_rejects_bad_config(setup):
             mesh=mesh,
             n_microbatches=2,
         )
-    # MoE aux loss is not collected by the pipeline yet — must refuse
-    # rather than silently train without the load-balance term.
-    with pytest.raises(NotImplementedError):
-        gpt2_pipeline_loss(
-            GPT2Config.small_test(scan_layers=True, n_layer=4, n_experts=4),
-            mesh=mesh,
-            n_microbatches=2,
-        )
+
+
+def test_pipeline_moe_collects_aux_loss(setup):
+    """Pipeline × expert blocks: the sown MoE load-balance aux is collected
+    per stage at valid ticks, so the pipeline loss includes it (close to
+    the non-pipelined loss up to microbatch routing covariance) and its
+    gradient reaches the router weights."""
+    _, mesh, _, _, x, y = setup
+    # aux_weight=1.0 makes the load-balance term a dominant loss component,
+    # so a pipeline that silently dropped it would land FAR from ref.
+    cfg = GPT2Config.small_test(
+        scan_layers=True, n_layer=4, dropout=0.0, n_experts=2,
+        moe_aux_weight=1.0,
+    )
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    from tpuflow.models.losses import sum_sown_losses
+
+    logits, updates = model.apply(
+        {"params": params}, x, train=False, mutable=["losses"]
+    )
+    ce_only = float(
+        optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    )
+    aux = float(sum_sown_losses(updates))
+    ref = ce_only + aux
+    assert aux > 0.5, "test setup: aux term must be a dominant component"
+
+    loss_fn = gpt2_pipeline_loss(cfg, mesh=mesh, n_microbatches=2)
+    with mesh:
+        placed = jax.device_put(params, gpt2_pipeline_shardings(mesh, params))
+        got = float(jax.jit(loss_fn)(placed, x, y))
+        grads = jax.jit(jax.grad(loss_fn))(placed, x, y)
+    # The pipeline loss must include the aux term: much closer to ce+aux
+    # than to ce alone (exact up to microbatch routing covariance).
+    assert abs(got - ref) < 0.1 * abs(got - ce_only), (got, ref, ce_only)
+    assert got == pytest.approx(ref, rel=5e-2), (got, ref)
+    router = grads["h"]["block"]["moe"]["gate"]
+    assert any(
+        float(jnp.max(jnp.abs(leaf))) > 0
+        for leaf in jax.tree_util.tree_leaves(router)
+    ), "no gradient reached the router weights"

@@ -17,3 +17,16 @@ def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Fraction of argmax predictions matching labels (reference
     my_ray_module.py:170: ``(pred.argmax(1) == y)``)."""
     return (jnp.argmax(logits, axis=-1) == labels).mean()
+
+
+def sum_sown_losses(updates: dict) -> jnp.ndarray:
+    """Scalar sum of every leaf sown into the 'losses' collection (e.g. the
+    MoE load-balance aux) — the single convention shared by the train step
+    and the pipeline schedule. A scanned layer stack sows (n_layer,)-stacked
+    leaves; summing keeps the result scalar either way."""
+    import jax
+
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(updates.get("losses", {})):
+        total = total + jnp.sum(leaf)
+    return total

@@ -44,7 +44,7 @@ AXIS_STAGE = "stage"
 
 
 def make_pipeline_loss(
-    block_apply: Callable[[Any, jax.Array], jax.Array],
+    block_apply: Callable[[Any, jax.Array], tuple[jax.Array, jax.Array]],
     embed: Callable[[Any, jax.Array], jax.Array],
     head_loss: Callable[[Any, jax.Array, jax.Array], jax.Array],
     *,
@@ -54,11 +54,19 @@ def make_pipeline_loss(
 ) -> Callable[[Any, Any, jax.Array, jax.Array], jax.Array]:
     """Build ``loss(stacked_block_params, other_params, tokens, targets)``.
 
-    - ``block_apply(block_params, x) -> x``: one repeated block, given one
-      layer's params (a slice of the stacked tree along its leading axis).
+    - ``block_apply(block_params, x) -> (x, aux)``: one repeated block,
+      given one layer's params (a slice of the stacked tree along its
+      leading axis), plus a scalar auxiliary loss (0.0 for plain blocks;
+      the sown MoE load-balance term for expert blocks).
     - ``embed(other_params, tokens) -> x``: the stage-0 ingress computation.
     - ``head_loss(other_params, x, targets) -> scalar``: the last-stage
       egress computation (mean loss over the microbatch's tokens).
+
+    Auxiliary losses are accumulated per stage only at VALID ticks (stage
+    ``s`` processes real microbatch data at ticks ``t in [s, s+M)``; bubble
+    and re-ingested activations are masked out), psum'd across stages, and
+    averaged over microbatches — the per-microbatch analogue of the
+    non-pipelined step's sown-loss sum (train/step.py:101-103).
 
     The returned callable is jit-compatible and differentiable; its result
     is the mean loss over all microbatches, replicated on every device.
@@ -80,11 +88,10 @@ def make_pipeline_loss(
         y_mb = targets.reshape(M, Bd // M, T)
 
         def apply_stage(x):
-            def body(h, layer_params):
-                return block_apply(layer_params, h), None
-
-            out, _ = jax.lax.scan(body, x, blocks_local)
-            return out
+            out, auxs = jax.lax.scan(
+                lambda h, lp: block_apply(lp, h), x, blocks_local
+            )
+            return out, jnp.sum(auxs)
 
         # Shape/dtype of the inter-stage activation buffer.
         probe = jax.eval_shape(lambda t: embed(other, t), x_mb[0])
@@ -93,11 +100,15 @@ def make_pipeline_loss(
         right = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
-            state, loss_acc = carry
+            state, loss_acc, aux_acc = carry
             # Stage 0 ingests microbatch t while ingress ticks remain.
             ingress = embed(other, x_mb[jnp.clip(t, 0, M - 1)])
             x = jnp.where(sid == 0, ingress, state)
-            y = apply_stage(x)
+            y, aux = apply_stage(x)
+            # This stage holds REAL data exactly at ticks [sid, sid+M):
+            # before that, bubble zeros; after, re-ingested/stale input.
+            stage_valid = (t >= sid) & (t < sid + M)
+            aux_acc = aux_acc + jnp.where(stage_valid, aux, 0.0)
             # Last stage emits microbatch t-(S-1) once the pipe has filled.
             emit_t = jnp.clip(t - (S - 1), 0, M - 1)
             mb_loss = head_loss(other, y, y_mb[emit_t])
@@ -106,14 +117,17 @@ def make_pipeline_loss(
             # Hand activations to the right neighbor (ICI nearest-neighbor);
             # stage S-1's output leaves the pipe (no wraparound edge).
             state = jax.lax.ppermute(y, AXIS_STAGE, right)
-            return (state, loss_acc), None
+            return (state, loss_acc, aux_acc), None
 
-        (_, loss_acc), _ = jax.lax.scan(
-            tick, (state0, jnp.float32(0.0)), jnp.arange(M + S - 1)
+        (_, loss_acc, aux_acc), _ = jax.lax.scan(
+            tick,
+            (state0, jnp.float32(0.0), jnp.float32(0.0)),
+            jnp.arange(M + S - 1),
         )
-        # Only the last stage accumulated real losses; psum replicates the
-        # total everywhere. Mean over microbatches and data shards.
-        total = jax.lax.psum(loss_acc, AXIS_STAGE)
+        # Only the last stage accumulated task losses; every stage holds its
+        # own layers' aux. psum replicates the totals everywhere. Mean over
+        # microbatches and data shards.
+        total = jax.lax.psum(loss_acc + aux_acc, AXIS_STAGE)
         if D > 1:
             total = jax.lax.psum(total, data_axis) / D
         return total / M
@@ -162,19 +176,25 @@ def gpt2_pipeline_loss(
             f"n_layer={cfg.n_layer} not divisible by "
             f"stage={mesh.shape[AXIS_STAGE]}"
         )
-    if cfg.n_experts > 0:
-        # block.apply here runs without mutable=['losses'], so the sown MoE
-        # load-balance aux loss would be silently DROPPED — diverging from
-        # the non-pipelined step (train/step.py:101-103) with no error.
-        # Reject until the pipeline collects sown losses.
-        raise NotImplementedError(
-            "pipeline parallelism does not yet collect the MoE aux loss; "
-            "use n_experts=0 or the non-pipelined step for MoE models"
-        )
     block = Block(cfg)
 
-    def block_apply(layer_params, x):
-        return block.apply({"params": layer_params}, x, False)
+    if cfg.n_experts > 0:
+        # MoE blocks sow their load-balance aux into 'losses'; collect it
+        # per layer so the schedule can mask/accumulate it (pipeline × EP
+        # composition). Note the per-microbatch aux is computed on B/M rows
+        # — the GPipe analogue of the full-batch statistic, equal up to
+        # microbatch routing covariance.
+        from tpuflow.models.losses import sum_sown_losses
+
+        def block_apply(layer_params, x):
+            out, updates = block.apply(
+                {"params": layer_params}, x, False, mutable=["losses"]
+            )
+            return out, sum_sown_losses(updates)
+    else:
+
+        def block_apply(layer_params, x):
+            return block.apply({"params": layer_params}, x, False), jnp.float32(0.0)
 
     def embed(other, tokens):
         T = tokens.shape[1]

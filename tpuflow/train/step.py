@@ -97,11 +97,9 @@ def make_train_step(
                 rngs={"dropout": dropout_rng},
                 mutable=mutable,
             )
-            loss = loss_fn(logits, batch["y"])
-            for leaf in jax.tree_util.tree_leaves(updates.get("losses", {})):
-                # A scanned layer stack sows a (n_layer,)-stacked leaf; sum
-                # keeps the loss scalar either way.
-                loss = loss + jnp.sum(leaf)
+            from tpuflow.models.losses import sum_sown_losses
+
+            loss = loss_fn(logits, batch["y"]) + sum_sown_losses(updates)
             return loss, (logits, updates)
 
         (loss, (logits, updates)), grads = jax.value_and_grad(
