@@ -114,6 +114,12 @@ class TpuGptTrain(FlowSpec):
         from tpuflow.train import TrainState, make_train_step
 
         cfg = self._config()
+        if self.resume_checkpoint is not None:
+            # Back the restore's destination pages on a background thread
+            # while the mesh/model/jit setup below runs (ckpt.RestoreArena).
+            from tpuflow.ckpt import prewarm_restore_handle
+
+            prewarm_restore_handle(self.resume_checkpoint)
         if self.stage_axis > 1:
             # Pipeline composes with data parallelism only; the other axis
             # parameters (fsdp defaults to 2) don't apply to this mesh.

@@ -115,6 +115,26 @@ def train_func_per_worker(config: dict) -> None:
     )
     _log(f"dataloaders ready (world={world}, rank={rank})")
 
+    # Resolve any resume source FIRST and start backing its restore
+    # destination pages in the background (ckpt.RestoreArena): the model
+    # build / state init below overlaps the page-backing instead of the
+    # restore paying it serially.
+    mgr = ctx.checkpoint_manager
+    in_run_step = mgr.latest_step() if mgr is not None else None
+    if in_run_step is not None:
+        mgr.prewarm_restore(in_run_step)
+    elif config.get("checkpoint") is not None:
+        from tpuflow.ckpt import prewarm_restore_handle
+
+        _ckpt = config["checkpoint"]
+        prewarm_restore_handle(
+            Checkpoint.from_json(_ckpt) if isinstance(_ckpt, dict) else _ckpt,
+            # Default warm starts read only the params subtree — prewarming
+            # opt-state buffers no restore will take would leak them until
+            # the (reclaiming) restore drops them unused.
+            weights_only=config.get("resume") != "full",
+        )
+
     model = _build_model(config)
     tx = optax.sgd(lr, momentum=0.9)  # parity: my_ray_module.py:142
     sample = np.zeros(
@@ -124,8 +144,6 @@ def train_func_per_worker(config: dict) -> None:
         model, jax.random.PRNGKey(config.get("seed", 0)), sample, tx
     )
     start_epoch = 0
-    mgr = ctx.checkpoint_manager
-    in_run_step = mgr.latest_step() if mgr is not None else None
     if in_run_step is not None:
         # In-run fault tolerance (SURVEY.md §5): a retried gang step resumes
         # FULL state from its own run's newest retained checkpoint before

@@ -182,12 +182,28 @@ def write_bytes(
         raise OSError(rc, os.strerror(rc), path)
 
 
-def read_bytes(path: str, nbytes: int, *, threads: int | None = None) -> np.ndarray:
+def read_bytes(
+    path: str,
+    nbytes: int,
+    *,
+    threads: int | None = None,
+    out: np.ndarray | None = None,
+) -> np.ndarray:
     """Striped threaded read of ``nbytes`` from ``path`` into a u8 array.
 
     The buffer is page-aligned so downstream ``jax.device_put`` on CPU
-    aliases it zero-copy (see ``aligned_empty``)."""
-    out = aligned_empty(nbytes)
+    aliases it zero-copy (see ``aligned_empty``). ``out`` supplies the
+    destination buffer instead (must be a contiguous u8 array of exactly
+    ``nbytes``) — the restore arena passes pre-backed buffers here so the
+    read is a single page-cache memcpy with no first-touch faulting."""
+    if out is not None:
+        assert out.dtype == np.uint8 and out.nbytes == nbytes, (
+            out.dtype,
+            out.nbytes,
+            nbytes,
+        )
+    else:
+        out = aligned_empty(nbytes)
     L = lib()
     if L is None:
         with open(path, "rb", buffering=0) as f:

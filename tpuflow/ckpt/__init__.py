@@ -6,6 +6,15 @@ checkpointing — see tpuflow.ckpt.manager for the full capability map.
 """
 
 from tpuflow.ckpt.handle import Checkpoint
-from tpuflow.ckpt.manager import CheckpointManager, restore_from_handle
+from tpuflow.ckpt.manager import (
+    CheckpointManager,
+    prewarm_restore_handle,
+    restore_from_handle,
+)
 
-__all__ = ["Checkpoint", "CheckpointManager", "restore_from_handle"]
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "prewarm_restore_handle",
+    "restore_from_handle",
+]

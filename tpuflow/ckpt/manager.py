@@ -173,6 +173,39 @@ class CheckpointManager:
         if self._pool is not None:
             self._pool.prewarm_wait()
 
+    def prewarm_restore(
+        self, step: int | None = None, *, best: bool = False,
+        background: bool = True,
+    ) -> None:
+        """Pre-back the destination buffers a ``restore`` of ``step`` will
+        fill (restore-side twin of ``prewarm``; see raw.RestoreArena).
+
+        Call as soon as the checkpoint to restore is known — before the
+        work that naturally precedes the restore (dataset decode, mesh
+        build, model compile) — and the first-touch page-backing cost of
+        the restored state overlaps it on a background thread instead of
+        serializing into the restore. No-op for Orbax-format steps.
+        """
+        from tpuflow.ckpt import raw as raw_fmt
+
+        if raw_fmt._mmap_enabled():
+            return  # mmap restores never fill arena buffers
+        try:
+            chosen = self._resolve_step(step, best)
+        except (ValueError, FileNotFoundError):
+            return
+        state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
+        if not raw_fmt.is_raw(state_dir):
+            return
+        raw_fmt._ARENA.prewarm(
+            raw_fmt.manifest_shard_sizes(state_dir), background=background
+        )
+
+    def prewarm_restore_wait(self) -> None:
+        from tpuflow.ckpt import raw as raw_fmt
+
+        raw_fmt._ARENA.prewarm_wait()
+
     def _sweep_orphans(self) -> None:
         """Reclaim step dirs whose save never committed (crash mid-write).
 
@@ -487,6 +520,34 @@ class CheckpointManager:
         return Checkpoint(
             path=self._step_dir(chosen), metadata=self._read_meta(chosen) or {}
         )
+
+
+def prewarm_restore_handle(
+    checkpoint: Checkpoint, *, weights_only: bool = False
+) -> None:
+    """Background-prewarm the restore arena for a flow-level handle.
+
+    Call as soon as a resume/eval checkpoint handle is known — the
+    page-backing of the restore's destination buffers (raw.RestoreArena)
+    then overlaps the mesh build / model init / compile that precedes the
+    actual ``restore_from_handle``. ``weights_only`` must mirror the
+    restore's flag so only the params subtree's buffers are backed.
+    Best-effort: non-raw, non-local, or mmap-mode handles are a no-op.
+    """
+    from tpuflow.ckpt import raw as raw_fmt
+
+    if raw_fmt._mmap_enabled():
+        return  # mmap restores never fill arena buffers
+    try:
+        state_dir = os.path.join(checkpoint.path, _STATE_DIR)
+        if raw_fmt.is_raw(state_dir):
+            raw_fmt._ARENA.prewarm(
+                raw_fmt.manifest_shard_sizes(
+                    state_dir, subtree=("params",) if weights_only else None
+                )
+            )
+    except (OSError, ValueError, KeyError, AttributeError):
+        pass
 
 
 def restore_from_handle(

@@ -320,6 +320,80 @@ class RecyclePool:
             shutil.rmtree(self.directory, ignore_errors=True)
 
 
+class RestoreArena:
+    """Pre-backed destination buffers for restore reads.
+
+    The restore-side mirror of the save-side ``RecyclePool``: on ballooning
+    hypervisors the dominant cost of a cold restore is not moving the bytes
+    but *backing the destination pages* (first-touch of fresh anonymous
+    memory runs ~10x slower than memcpy on the dev host). The arena
+    allocates and touches page-aligned buffers ahead of time — on a
+    background thread that overlaps real startup work (data pipeline build,
+    model compile) — and hands each out exactly once; ``jax.device_put`` on
+    CPU then aliases the buffer zero-copy, so the restore critical path is a
+    single page-cache memcpy into already-backed pages.
+
+    Ownership is transfer-only: a taken buffer never returns to the arena
+    (its pages belong to the restored array), so there is no reuse-while-
+    aliased hazard. Sizes must match exactly — shard sizes are deterministic
+    from the manifest, which is what ``prewarm`` is fed from. One restore
+    per prewarm: ``restore_raw`` drops any unconsumed buffers when it
+    finishes, so a prewarm whose restore took another shape (template
+    mismatch, partial subtree, mmap) costs its backing work but never pins
+    memory past the restore.
+    """
+
+    def __init__(self):
+        self._buffers: dict[int, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def prewarm(self, sizes: list[int], *, background: bool = True) -> None:
+        """Allocate + page-back one buffer per entry of ``sizes``."""
+        sizes = [int(s) for s in sizes if s > 0]
+        if not sizes:
+            return
+
+        def _run():
+            for s in sizes:
+                buf = _native.aligned_empty(s)
+                buf[::4096] = 0  # touch every page: back it now, not at read
+                if s % 4096:
+                    buf[-1] = 0
+                with self._lock:
+                    self._buffers.setdefault(s, []).append(buf)
+
+        if background:
+            self.prewarm_wait()  # one prewarm in flight at a time
+            self._thread = threading.Thread(
+                target=_run, name="tpuflow-restore-arena", daemon=True
+            )
+            self._thread.start()
+        else:
+            _run()
+
+    def prewarm_wait(self, timeout: float | None = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive():
+                self._thread = None
+
+    def take(self, nbytes: int) -> np.ndarray | None:
+        """Pop a pre-backed buffer of exactly ``nbytes``, else None."""
+        with self._lock:
+            stack = self._buffers.get(int(nbytes))
+            return stack.pop() if stack else None
+
+    def clear(self) -> None:
+        self.prewarm_wait()
+        with self._lock:
+            self._buffers.clear()
+
+
+_ARENA = RestoreArena()
+
+
 def _path_names(path) -> list[str]:
     names = []
     for entry in path:
@@ -535,6 +609,31 @@ class AsyncRawSaver:
             raise self._error.pop()
 
 
+def manifest_shard_sizes(
+    directory: str, subtree: tuple[str, ...] | None = None
+) -> list[int]:
+    """Byte size of every shard file a restore of ``directory`` will read —
+    the sizes ``RestoreArena.prewarm`` needs to pre-back the restore's
+    destination buffers. One entry per unique shard file per leaf (the
+    aligned restore path reads each file into exactly one buffer).
+    ``subtree`` limits the sizes to a partial restore's leaves (e.g.
+    ``('params',)`` for weights-only warm starts)."""
+    manifest = _read_manifest(directory)
+    sizes = []
+    for entry in manifest["leaves"]:
+        if subtree is not None and tuple(entry["path"][: len(subtree)]) != subtree:
+            continue
+        dtype = np.dtype(entry["dtype"])
+        seen = set()
+        for shard in entry["shards"]:
+            if shard["file"] in seen:
+                continue
+            seen.add(shard["file"])
+            n = int(np.prod(shard["shape"])) * dtype.itemsize
+            sizes.append(n if shard["shape"] else dtype.itemsize)
+    return sizes
+
+
 def is_raw(directory: str) -> bool:
     return os.path.exists(os.path.join(directory, MANIFEST))
 
@@ -606,7 +705,11 @@ def _read_shard(
             if key is not None:
                 weakref.finalize(flat, _unregister_alias, key)
             return flat.view(dtype).reshape(shard["shape"])
-    buf = _native.read_bytes(path, nbytes, threads=threads)
+    # Escaping reads draw their destination from the restore arena when a
+    # pre-backed buffer of this exact size is available (transient reads —
+    # escapes=False, copied into a full-leaf buffer — must not consume them).
+    out = _ARENA.take(nbytes) if escapes else None
+    buf = _native.read_bytes(path, nbytes, threads=threads, out=out)
     return buf.view(dtype).reshape(shard["shape"])
 
 
@@ -687,7 +790,10 @@ def _aligned_like(shape, dtype: np.dtype) -> np.ndarray:
     # Scalars (shape ()) need one element; zero-size shapes need 0 bytes and
     # reshape fine from a 0-length view.
     nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
-    return _native.aligned_empty(nbytes).view(dtype).reshape(shape)
+    buf = _ARENA.take(nbytes)
+    if buf is None:
+        buf = _native.aligned_empty(nbytes)
+    return buf.view(dtype).reshape(shape)
 
 
 def _read_leaf(
@@ -747,6 +853,26 @@ def restore_raw(
       this one holds the arrays — use only for read-only consumers of runs
       this process owns or that are finished (batch eval, benches).
     """
+    try:
+        return _restore_raw_inner(
+            directory, abstract_state, subtree=subtree, zero_copy=zero_copy
+        )
+    finally:
+        # Reclaim prewarmed-but-unconsumed arena buffers: a restore that
+        # took a different path than its prewarm anticipated (template
+        # mismatch → assemble fallback, partial-subtree read, mmap) must
+        # not pin pre-backed pages for the process lifetime. One restore
+        # per prewarm is the contract; leftovers die with the restore.
+        _ARENA.clear()
+
+
+def _restore_raw_inner(
+    directory: str,
+    abstract_state: Any | None = None,
+    *,
+    subtree: tuple[str, ...] | None = None,
+    zero_copy: bool = False,
+):
     manifest = _read_manifest(directory)
     entries = manifest["leaves"]
     if subtree is not None:
