@@ -67,6 +67,11 @@ class TpuGptTrain(FlowSpec):
     from_run = Parameter(
         "from_run", default="", help="run pathspec to resume full state from"
     )
+    sample_tokens = Parameter(
+        "sample_tokens",
+        default=0,
+        help="greedy-decode N tokens after training (FSDP mode)",
+    )
 
     def _config(self):
         from tpuflow.models.gpt2 import GPT2Config
@@ -224,6 +229,19 @@ class TpuGptTrain(FlowSpec):
             self.result_checkpoint = mgr.checkpoint()
             self.loss_history = history
             mgr.close()
+            if self.sample_tokens > 0:
+                # Demonstrate the LM inference surface on the trained model:
+                # greedy KV-cache decode (tpuflow.infer.generate), sharded
+                # params and all — GSPMD handles the gather under jit.
+                from tpuflow.infer import generate
+
+                prompt = jnp.zeros((1, 4), jnp.int32)
+                toks = generate(
+                    model, state.params, prompt,
+                    max_new_tokens=int(self.sample_tokens), temperature=0.0,
+                )
+                self.sample = [int(t) for t in toks[0]]
+                print(f"[gpt_flow] greedy sample: {self.sample}")
         self.next(self.end)
 
     def _train_pipeline(self, cfg):

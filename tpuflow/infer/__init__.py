@@ -1,5 +1,7 @@
-"""Batch inference engine (replaces Ray Data map_batches actor inference)."""
+"""Batch inference engine (replaces Ray Data map_batches actor inference)
+plus autoregressive KV-cache generation for the LM family."""
 
 from tpuflow.infer.engine import BatchPredictor, map_batches
+from tpuflow.infer.generate import generate
 
-__all__ = ["BatchPredictor", "map_batches"]
+__all__ = ["BatchPredictor", "generate", "map_batches"]

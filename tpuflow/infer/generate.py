@@ -1,0 +1,149 @@
+"""Autoregressive generation for the GPT-2 family: KV-cache decode under jit.
+
+The reference's inference story is one classifier forward per batch
+(my_ray_module.py:275-284); an LM family needs token-by-token sampling. This
+is the TPU-native shape of that loop:
+
+- **Prefill** runs the whole prompt through the model once in decode mode,
+  filling every block's fixed-size KV cache (one compile, MXU-batched).
+- **Decode** is a ``lax.scan`` over single-token steps — cache, current
+  token, rng, and done-mask ride the carry, so the entire generation is ONE
+  jitted XLA program: no per-token Python dispatch, no dynamic shapes, no
+  host↔device chatter until the final tokens come back.
+- Sampling is temperature / top-k categorical (greedy at temperature=0),
+  with an EOS done-mask that freezes finished rows to ``pad_id``.
+
+Works on any backend; on a sharded mesh the batch axis shards over 'data'
+and the cache inherits the activations' sharding through GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample(logits, rng, temperature, *, greedy: bool, top_k: int | None):
+    """(B, V) logits → (B,) sampled token ids.
+
+    ``greedy`` (the temperature == 0 case) and ``top_k`` change the program
+    shape and are static; ``temperature`` is a traced operand so sweeping it
+    does not recompile the generation program.
+    """
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("max_new_tokens", "greedy", "top_k", "eos_id", "pad_id"),
+)
+def _generate_jit(
+    model,
+    params,
+    prompt,
+    rng,
+    temperature,
+    *,
+    max_new_tokens: int,
+    greedy: bool,
+    top_k: int | None,
+    eos_id: int | None,
+    pad_id: int,
+):
+    B, T = prompt.shape
+
+    # Prefill: one pass over the prompt initializes + fills the caches.
+    logits, vars_out = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"]
+    )
+    cache = vars_out["cache"]
+    rng, sub = jax.random.split(rng)
+    tok = _sample(
+        logits[:, -1, :], sub, temperature, greedy=greedy, top_k=top_k
+    )
+    # EOS semantics: the eos token itself IS emitted (so callers can trim at
+    # it); only positions after it are frozen to pad_id.
+    done = (
+        tok == eos_id if eos_id is not None else jnp.zeros((B,), bool)
+    )
+
+    def step(carry, _):
+        cache, tok, rng, done = carry
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        sampled = _sample(
+            logits[:, -1, :], sub, temperature, greedy=greedy, top_k=top_k
+        )
+        nxt = jnp.where(done, pad_id, sampled)
+        if eos_id is not None:
+            done = done | (sampled == eos_id)
+        return (vars_out["cache"], nxt, rng, done), tok
+
+    if max_new_tokens == 1:
+        return tok[:, None]
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (cache, tok, rng, done), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+def generate(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    rng=None,
+):
+    """Sample ``max_new_tokens`` continuations of ``prompt`` (B, T) int32.
+
+    Returns (B, max_new_tokens) int32. The prompt must be dense (one length
+    per batch; left-align ragged prompts to their common prefix or pad+mask
+    upstream) and ``T + max_new_tokens`` must fit the model's ``n_ctx``
+    (the fixed cache size). ``temperature=0`` is greedy decoding; any other
+    temperature is a traced operand (sweeping it reuses the compiled
+    program). With ``eos_id`` set, the eos token itself is emitted and the
+    row's remaining positions are frozen to ``pad_id``.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    n_ctx = model.config.n_ctx
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if T + max_new_tokens > n_ctx:
+        raise ValueError(
+            f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
+            f"the model's n_ctx={n_ctx} (the KV cache size)"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_jit(
+        model,
+        params,
+        prompt,
+        rng,
+        jnp.asarray(temperature, jnp.float32),
+        max_new_tokens=max_new_tokens,
+        greedy=temperature == 0.0,
+        top_k=top_k,
+        eos_id=eos_id,
+        pad_id=pad_id,
+    )

@@ -67,12 +67,23 @@ class GPT2Config:
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block: LN → MHA → residual, LN → MLP → residual."""
+    """Pre-LN transformer block: LN → MHA → residual, LN → MLP → residual.
+
+    ``decode=True`` switches the attention to a fixed-size KV cache
+    (``cache`` collection: ``cached_key``/``cached_value`` (B, n_ctx, H, D)
+    + scalar ``cache_index``): the incoming T tokens are written at the
+    current index and q attends over the cache through a static-shape mask
+    (position ≤ query position) — one compilation for prefill (T=prompt)
+    and one for single-token decode (T=1), XLA-friendly throughout. The
+    reference has no generation path at all (its predictor is one
+    classifier forward, my_ray_module.py:275-284); this is the LM-family
+    completion of the batch-inference capability (SURVEY.md §2b D12).
+    """
 
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, decode: bool = False):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -83,7 +94,10 @@ class Block(nn.Module):
         q = q.reshape(B, T, cfg.n_head, head_dim)
         k = k.reshape(B, T, cfg.n_head, head_dim)
         v = v.reshape(B, T, cfg.n_head, head_dim)
-        a = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        if decode:
+            a = self._cached_attention(q, k, v)
+        else:
+            a = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         a = a.reshape(B, T, cfg.n_embd)
         a = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(a)
         a = nn.Dropout(cfg.dropout, deterministic=not train)(a)
@@ -109,15 +123,80 @@ class Block(nn.Module):
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
+    def _cached_attention(self, q, k, v):
+        """Fixed-size KV-cache attention (decode mode).
+
+        Writes the new k/v at ``cache_index`` and attends q over the whole
+        cache behind a mask — shapes stay static for jit, the cache updates
+        ride ``lax.dynamic_update_slice`` (no data-dependent shapes), and
+        the O(n_ctx) masked attention is the HBM-bandwidth-optimal form for
+        single-token decode on TPU (a (1, n_ctx) GEMV per head on the MXU).
+        """
+        import jax
+
+        cfg = self.config
+        B, T, H, D = q.shape
+        ck = self.variable(
+            "cache",
+            "cached_key",
+            jnp.zeros,
+            (B, cfg.n_ctx, H, D),
+            cfg.dtype,
+        )
+        cv = self.variable(
+            "cache",
+            "cached_value",
+            jnp.zeros,
+            (B, cfg.n_ctx, H, D),
+            cfg.dtype,
+        )
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        start = idx.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, start, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, start, 0, 0)
+        )
+        idx.value = start + T
+
+        if T > 1:
+            # Prefill: a multi-token decode call is by contract the FIRST
+            # call on a fresh cache (start == 0; tpuflow.infer.generate),
+            # so attention over just the incoming tokens with a plain causal
+            # mask is exact — and runs through the pluggable impl dispatch
+            # (T x T flash/xla) instead of softmaxing over n_ctx - T masked
+            # zero keys. Chunked prefill (multi-token calls at start > 0)
+            # is not supported.
+            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32),
+            ck.value.astype(jnp.float32),
+        ) * scale
+        # Key position k is visible to query position start+t iff k <= start+t.
+        q_pos = start + jnp.arange(T)[:, None]
+        k_pos = jnp.arange(cfg.n_ctx)[None, :]
+        s = jnp.where(k_pos <= q_pos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, cv.value.astype(jnp.float32)
+        )
+        return out.astype(q.dtype)
+
 
 class _ScanBlock(nn.Module):
-    """Scan-body adapter: (carry, broadcast train) → (carry, no ys)."""
+    """Scan-body adapter: (carry, broadcast train/decode) → (carry, no ys)."""
 
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, train: bool):
-        return Block(self.config, name="block")(x, train), None
+    def __call__(self, x, train: bool, decode: bool = False):
+        return Block(self.config, name="block")(x, train, decode), None
 
 
 class GPT2(nn.Module):
@@ -126,7 +205,7 @@ class GPT2(nn.Module):
     config: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
         cfg = self.config
         B, T = tokens.shape
         wte = self.param(
@@ -141,11 +220,27 @@ class GPT2(nn.Module):
             (cfg.n_ctx, cfg.n_embd),
             jnp.float32,
         )
-        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        if decode:
+            # Autoregressive mode: positions continue from the model-level
+            # cache index (the blocks keep their own KV indices in the same
+            # 'cache' collection; see Block._cached_attention).
+            import jax
+
+            pos = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = pos.value
+            pos.value = start + T
+            pe = jax.lax.dynamic_slice(
+                wpe, (start, jnp.int32(0)), (T, cfg.n_embd)
+            )
+        else:
+            pe = wpe[:T]
+        x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         if cfg.scan_layers:
             body = (
-                nn.remat(_ScanBlock, static_argnums=(2,))
+                nn.remat(_ScanBlock, static_argnums=(2, 3))
                 if cfg.remat
                 else _ScanBlock
             )
@@ -153,18 +248,18 @@ class GPT2(nn.Module):
                 body,
                 # 'losses' must be declared or nn.scan silently DROPS the
                 # per-layer sown values (the MoE load-balance aux loss).
-                variable_axes={"params": 0, "losses": 0},
+                variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,
             )
-            x, _ = blocks(cfg, name="h")(x, train)
+            x, _ = blocks(cfg, name="h")(x, train, decode)
         else:
             block_cls = (
-                nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+                nn.remat(Block, static_argnums=(2, 3)) if cfg.remat else Block
             )
             for i in range(cfg.n_layer):
-                x = block_cls(cfg, name=f"h{i}")(x, train)
+                x = block_cls(cfg, name=f"h{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Weight-tied LM head; logits in float32 for a stable softmax/CE.
         return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(
