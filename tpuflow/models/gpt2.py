@@ -26,6 +26,7 @@ class GPT2Config:
     n_layer: int = 12
     n_head: int = 12
     dropout: float = 0.1
+    ln_eps: float = 1e-5  # GPT-2's LayerNorm epsilon (HF-checkpoint parity)
     attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
     dtype: jnp.dtype = jnp.float32  # activation dtype; bfloat16 on TPU
     # Rematerialize each block on the backward pass (jax.checkpoint): peak
@@ -88,7 +89,7 @@ class Block(nn.Module):
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
 
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_1")(x)
         qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, cfg.n_head, head_dim)
@@ -103,7 +104,7 @@ class Block(nn.Module):
         a = nn.Dropout(cfg.dropout, deterministic=not train)(a)
         x = x + a
 
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_2")(x)
         if cfg.n_experts > 0:
             from tpuflow.models.moe import MoEMLP
 
@@ -260,7 +261,7 @@ class GPT2(nn.Module):
             )
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h{i}")(x, train, decode)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_f")(x)
         # Weight-tied LM head; logits in float32 for a stable softmax/CE.
         return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(
             jnp.float32

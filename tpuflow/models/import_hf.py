@@ -1,0 +1,148 @@
+"""Import HuggingFace GPT-2 checkpoints into tpuflow's Flax parameter tree.
+
+A user of the reference stack brings torch weights; this is the bridge: any
+``transformers`` GPT-2 model (or its raw ``state_dict``) converts into the
+exact pytree ``tpuflow.models.gpt2.GPT2`` trains, checkpoints, and decodes
+with — so pretrained weights drop into the FSDP trainer and the KV-cache
+generator unchanged. It is also the framework's external-correctness proof:
+``tests/test_hf_import.py`` asserts our logits match the canonical torch
+implementation on identical weights.
+
+Mapping notes (HF ``GPT2LMHeadModel`` → ours):
+
+- HF's ``Conv1D`` stores kernels as (in, out) — the same layout as flax
+  ``nn.Dense``; no transposes anywhere.
+- ``ln_*.weight/bias`` → LayerNorm ``scale``/``bias`` (our ``ln_eps``
+  default already matches GPT-2's 1e-5).
+- The LM head is weight-tied to ``wte`` in both.
+- With ``scan_layers=True`` the per-layer trees stack along a leading
+  layer axis (axis 0), matching ``nn.scan``'s parameter layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like → float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu()
+        if hasattr(t, "float"):
+            t = t.float()  # torch can't .numpy() a bfloat16 tensor
+        t = t.numpy()
+    return np.asarray(t, np.float32)
+
+
+def _block_params(sd: Mapping[str, Any], i: int) -> dict:
+    p = f"transformer.h.{i}."
+    return {
+        "ln_1": {"scale": _np(sd[p + "ln_1.weight"]), "bias": _np(sd[p + "ln_1.bias"])},
+        "c_attn": {
+            "kernel": _np(sd[p + "attn.c_attn.weight"]),
+            "bias": _np(sd[p + "attn.c_attn.bias"]),
+        },
+        "c_proj": {
+            "kernel": _np(sd[p + "attn.c_proj.weight"]),
+            "bias": _np(sd[p + "attn.c_proj.bias"]),
+        },
+        "ln_2": {"scale": _np(sd[p + "ln_2.weight"]), "bias": _np(sd[p + "ln_2.bias"])},
+        "mlp_fc": {
+            "kernel": _np(sd[p + "mlp.c_fc.weight"]),
+            "bias": _np(sd[p + "mlp.c_fc.bias"]),
+        },
+        "mlp_proj": {
+            "kernel": _np(sd[p + "mlp.c_proj.weight"]),
+            "bias": _np(sd[p + "mlp.c_proj.bias"]),
+        },
+    }
+
+
+def hf_gpt2_to_params(source, config) -> dict:
+    """HF GPT-2 (model instance or ``state_dict``) → tpuflow params pytree.
+
+    ``config`` is the matching ``tpuflow.models.gpt2.GPT2Config`` (use
+    :func:`config_from_hf` to derive it). MoE configs cannot be imported
+    (no HF equivalent).
+    """
+    if config.n_experts:
+        raise ValueError("HF GPT-2 has no MoE variant to import from")
+    sd = source.state_dict() if hasattr(source, "state_dict") else dict(source)
+    params: dict = {
+        "wte": _np(sd["transformer.wte.weight"]),
+        "wpe": _np(sd["transformer.wpe.weight"]),
+        "ln_f": {
+            "scale": _np(sd["transformer.ln_f.weight"]),
+            "bias": _np(sd["transformer.ln_f.bias"]),
+        },
+    }
+    for field, want, got in (
+        ("vocab_size", config.vocab_size, params["wte"].shape[0]),
+        ("n_ctx", config.n_ctx, params["wpe"].shape[0]),
+        ("n_embd", config.n_embd, params["wte"].shape[1]),
+    ):
+        if want != got:
+            raise ValueError(
+                f"config.{field}={want} does not match the checkpoint ({got})"
+            )
+    n_ckpt_layers = 0
+    while f"transformer.h.{n_ckpt_layers}.ln_1.weight" in sd:
+        n_ckpt_layers += 1
+    if config.n_layer != n_ckpt_layers:
+        raise ValueError(
+            f"config.n_layer={config.n_layer} does not match the checkpoint "
+            f"({n_ckpt_layers} layers)"
+        )
+    blocks = [_block_params(sd, i) for i in range(config.n_layer)]
+    if config.scan_layers:
+        import jax
+
+        params["h"] = {
+            "block": jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs, axis=0), *blocks
+            )
+        }
+    else:
+        for i, b in enumerate(blocks):
+            params[f"h{i}"] = b
+    return params
+
+
+def config_from_hf(hf_config, **overrides):
+    """``transformers.GPT2Config`` → ``GPT2Config`` (dropout 0 for eval).
+
+    Rejects GPT-2 variants whose forward pass our Block does not reproduce
+    (non-tanh-GELU activations, per-layer attention scaling) rather than
+    importing them into silently wrong logits.
+    """
+    from tpuflow.models.gpt2 import GPT2Config
+
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported activation_function={act!r}: the tpuflow GPT-2 "
+            "block uses tanh-approximate GELU (gelu_new)"
+        )
+    for flag in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise ValueError(
+                f"unsupported GPT-2 variant: {flag}=True changes the "
+                "attention math and cannot be imported"
+            )
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError(
+            "unsupported GPT-2 variant: scale_attn_weights=False"
+        )
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        n_ctx=hf_config.n_positions,
+        n_embd=hf_config.n_embd,
+        n_layer=hf_config.n_layer,
+        n_head=hf_config.n_head,
+        dropout=0.0,
+        ln_eps=float(hf_config.layer_norm_epsilon),
+    )
+    kw.update(overrides)
+    return GPT2Config(**kw)
