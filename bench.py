@@ -151,11 +151,54 @@ def bench_train() -> dict | None:
         "compile_s": round(compile_s, 1),
     }
     _log(f"[bench] train: {rec}")
+    try:
+        rec["decode"] = bench_decode(model, state.params, cfg, on_tpu)
+    except Exception as e:  # generation issues must not erase the train rec
+        rec["decode"] = {"error": repr(e)[:300]}
     if on_tpu:
         try:
             rec["flash_attention"] = bench_flash()
         except Exception as e:  # never let a kernel issue erase the train rec
             rec["flash_attention"] = {"error": repr(e)[:300]}
+    return rec
+
+
+def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
+    """KV-cache generation throughput (tokens/s/sequence and total):
+    tpuflow.infer.generate on the just-trained flagship model. Decode is
+    HBM-bandwidth-bound (every step streams all params + caches), so this
+    is the memory-side complement of the MFU number above.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from tpuflow.infer import generate
+
+    B = 8 if on_tpu else 2
+    T_prompt, n_new = (64, 128) if on_tpu else (8, 8)
+    prompt = (
+        np.arange(B * T_prompt, dtype=np.int32).reshape(B, T_prompt)
+        % cfg.vocab_size
+    )
+    t0 = _time.monotonic()
+    np.asarray(
+        generate(model, params, prompt, max_new_tokens=n_new, temperature=0.0)
+    )
+    compile_s = _time.monotonic() - t0
+    t0 = _time.monotonic()
+    np.asarray(
+        generate(model, params, prompt, max_new_tokens=n_new, temperature=0.0)
+    )
+    dt = _time.monotonic() - t0  # closed by the host fetch of the tokens
+    rec = {
+        "batch": B,
+        "new_tokens": n_new,
+        "tokens_per_s": round(B * n_new / dt, 1),
+        "tokens_per_s_per_seq": round(n_new / dt, 1),
+        "compile_s": round(compile_s, 1),
+    }
+    _log(f"[bench] decode: {rec}")
     return rec
 
 

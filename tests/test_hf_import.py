@@ -97,6 +97,17 @@ def test_unsupported_variants_rejected():
         config_from_hf(hf_cfg)
 
 
+def test_untied_lm_head_and_custom_mlp_width_rejected():
+    hf_model, hf_cfg = _tiny_hf()
+    sd = dict(hf_model.state_dict())
+    sd["lm_head.weight"] = sd["transformer.wte.weight"] + 1.0
+    with pytest.raises(ValueError, match="untied lm_head"):
+        hf_gpt2_to_params(sd, config_from_hf(hf_cfg))
+    hf_cfg.n_inner = 3 * hf_cfg.n_embd
+    with pytest.raises(ValueError, match="n_inner"):
+        config_from_hf(hf_cfg)
+
+
 def test_bf16_checkpoint_imports():
     hf_model, hf_cfg = _tiny_hf()
     sd = {k: v.bfloat16() for k, v in hf_model.state_dict().items()}

@@ -70,6 +70,17 @@ def hf_gpt2_to_params(source, config) -> dict:
     if config.n_experts:
         raise ValueError("HF GPT-2 has no MoE variant to import from")
     sd = source.state_dict() if hasattr(source, "state_dict") else dict(source)
+    if "lm_head.weight" in sd:
+        # Our LM head is weight-tied to wte; an untied fine-tune would
+        # import into silently wrong logits.
+        if not np.array_equal(
+            _np(sd["lm_head.weight"]), _np(sd["transformer.wte.weight"])
+        ):
+            raise ValueError(
+                "checkpoint has an untied lm_head (lm_head.weight != "
+                "wte.weight); the tpuflow GPT-2 ties the LM head to the "
+                "token embedding and cannot represent it"
+            )
     params: dict = {
         "wte": _np(sd["transformer.wte.weight"]),
         "wpe": _np(sd["transformer.wpe.weight"]),
@@ -134,6 +145,12 @@ def config_from_hf(hf_config, **overrides):
     if not getattr(hf_config, "scale_attn_weights", True):
         raise ValueError(
             "unsupported GPT-2 variant: scale_attn_weights=False"
+        )
+    n_inner = getattr(hf_config, "n_inner", None)
+    if n_inner not in (None, 4 * hf_config.n_embd):
+        raise ValueError(
+            f"unsupported GPT-2 variant: n_inner={n_inner} (the tpuflow "
+            f"block uses the standard 4*n_embd={4 * hf_config.n_embd} MLP)"
         )
     kw = dict(
         vocab_size=hf_config.vocab_size,
