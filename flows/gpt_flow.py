@@ -72,6 +72,11 @@ class TpuGptTrain(FlowSpec):
         default=0,
         help="greedy-decode N tokens after training (FSDP mode)",
     )
+    accum_steps = Parameter(
+        "accum_steps",
+        default=1,
+        help="gradient-accumulation microbatches per optimizer step",
+    )
 
     def _config(self):
         from tpuflow.models.gpt2 import GPT2Config
@@ -137,6 +142,12 @@ class TpuGptTrain(FlowSpec):
                     "[gpt_flow] note: --fsdp-axis does not apply in pipeline "
                     "mode; params shard by layer slice over 'stage' instead"
                 )
+            if int(self.accum_steps) > 1:
+                raise ValueError(
+                    "--accum-steps applies to the FSDP/DP step only; the "
+                    "pipeline schedule already microbatches via "
+                    "--microbatches"
+                )
             self._train_pipeline(cfg)
             self.next(self.end)
             return
@@ -199,7 +210,7 @@ class TpuGptTrain(FlowSpec):
             batch_sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(("data", "fsdp"), seq_spec)
             )
-            train_step = make_train_step()
+            train_step = make_train_step(accum_steps=int(self.accum_steps))
             rng = jax.random.PRNGKey(1)
             history = []
             for epoch in range(self.epochs):

@@ -12,7 +12,12 @@ import pytest
 
 from tpuflow import dist
 from tpuflow.models import NeuralNetwork
-from tpuflow.train import create_train_state, make_eval_step, make_train_step
+from tpuflow.train import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
 
 
 def _make_state(rng_seed=0, final_relu=True, lr=1e-3):
@@ -163,3 +168,81 @@ def test_batchnorm_stats_are_global():
         np.asarray(s1.batch_stats["BatchNorm_0"]["mean"]),
         atol=1e-6,
     )
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=K with the same batch must produce the same update as the
+    plain step: equal microbatches make the mean-of-means exact (dropout off
+    so the only difference could be the accumulation math itself)."""
+    import optax
+
+    from tpuflow.models.gpt2 import GPT2, GPT2Config
+
+    cfg = GPT2Config.small_test(dropout=0.0)
+    model = GPT2(cfg)
+    tokens = np.arange(8 * 17, dtype=np.int32).reshape(8, 17) % cfg.vocab_size
+    batch = {"x": tokens[:, :-1], "y": tokens[:, 1:]}
+    rng = jax.random.PRNGKey(0)
+
+    def fresh():
+        # SGD: the update is linear in the gradient, so the comparison
+        # measures the accumulation math itself (adamw's 1/sqrt(v) would
+        # amplify float-summation-order noise in near-zero grads).
+        params = model.init(jax.random.PRNGKey(0), batch["x"][:1])["params"]
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+        )
+
+    full, m_full = make_train_step(donate=False)(fresh(), batch, rng)
+    acc, m_acc = make_train_step(donate=False, accum_steps=4)(
+        fresh(), batch, rng
+    )
+    np.testing.assert_allclose(
+        float(m_full["loss"]), float(m_acc["loss"]), rtol=1e-5
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        full.params,
+        acc.params,
+    )
+
+
+def test_grad_accumulation_threads_batchnorm_stats():
+    """With BatchNorm models the scan threads batch_stats microbatch to
+    microbatch and the final stats land in the new state."""
+    from tpuflow.models import get_model
+
+    model = get_model("resnet18", num_classes=10)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+    )
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        tx=optax.sgd(1e-2),
+    )
+    batch = {
+        "x": jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3)),
+        "y": jnp.zeros((8,), jnp.int32),
+    }
+    new_state, _ = make_train_step(donate=False, accum_steps=2)(
+        state, batch, jax.random.PRNGKey(2)
+    )
+    before = np.asarray(
+        jax.tree_util.tree_leaves(state.batch_stats)[0]
+    )
+    after = np.asarray(
+        jax.tree_util.tree_leaves(new_state.batch_stats)[0]
+    )
+    assert not np.array_equal(before, after)  # stats advanced through scan
+
+
+def test_grad_accumulation_rejects_ragged_split():
+    state = _make_state()
+    with pytest.raises(ValueError, match="accum_steps"):
+        make_train_step(donate=False, accum_steps=3)(
+            state, _batch(64), jax.random.PRNGKey(0)
+        )

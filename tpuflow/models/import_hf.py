@@ -70,19 +70,18 @@ def hf_gpt2_to_params(source, config) -> dict:
     if config.n_experts:
         raise ValueError("HF GPT-2 has no MoE variant to import from")
     sd = source.state_dict() if hasattr(source, "state_dict") else dict(source)
+    wte = _np(sd["transformer.wte.weight"])
     if "lm_head.weight" in sd:
         # Our LM head is weight-tied to wte; an untied fine-tune would
         # import into silently wrong logits.
-        if not np.array_equal(
-            _np(sd["lm_head.weight"]), _np(sd["transformer.wte.weight"])
-        ):
+        if not np.array_equal(_np(sd["lm_head.weight"]), wte):
             raise ValueError(
                 "checkpoint has an untied lm_head (lm_head.weight != "
                 "wte.weight); the tpuflow GPT-2 ties the LM head to the "
                 "token embedding and cannot represent it"
             )
     params: dict = {
-        "wte": _np(sd["transformer.wte.weight"]),
+        "wte": wte,
         "wpe": _np(sd["transformer.wpe.weight"]),
         "ln_f": {
             "scale": _np(sd["transformer.ln_f.weight"]),

@@ -73,6 +73,7 @@ def make_train_step(
     loss_fn: Callable = cross_entropy_loss,
     *,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable:
     """Build the jitted SPMD train step.
 
@@ -80,35 +81,99 @@ def make_train_step(
     and compiled by XLA (static shapes; the Python epoch loop only feeds
     sharded batches, SURVEY.md §3.5). ``rng`` is folded with ``state.step`` so
     dropout masks differ per step while the traced function stays pure.
+
+    ``accum_steps > 1`` enables gradient accumulation: the batch's leading
+    axis splits into that many equal microbatches, a ``lax.scan`` runs
+    forward+backward per microbatch (peak activation memory drops by the
+    same factor), averaged gradients feed ONE optimizer update — numerically
+    identical to the full-batch step for mean losses (pinned by
+    tests/test_train_step.py). The scan is a compiler-friendly loop: one
+    trace, static shapes, grads carried in place.
     """
 
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
     def train_step(state: TrainState, batch, rng):
-        dropout_rng = jax.random.fold_in(rng, state.step)
+        base_rng = jax.random.fold_in(rng, state.step)
         has_stats = bool(state.batch_stats)
 
-        def compute_loss(params):
+        def compute_loss(params, batch_stats, mb, dropout_rng):
             # 'losses' collects auxiliary objectives the model sows (e.g. the
             # MoE load-balance loss); models without any sow leave it empty.
             mutable = ["losses"] + (["batch_stats"] if has_stats else [])
+            variables = {"params": params}
+            if has_stats:
+                variables["batch_stats"] = batch_stats
             logits, updates = state.apply_fn(
-                _variables(state, params),
-                batch["x"],
+                variables,
+                mb["x"],
                 train=True,
                 rngs={"dropout": dropout_rng},
                 mutable=mutable,
             )
             from tpuflow.models.losses import sum_sown_losses
 
-            loss = loss_fn(logits, batch["y"]) + sum_sown_losses(updates)
+            loss = loss_fn(logits, mb["y"]) + sum_sown_losses(updates)
             return loss, (logits, updates)
 
-        (loss, (logits, updates)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+        if accum_steps == 1:
+            (loss, (logits, updates)), grads = grad_fn(
+                state.params, state.batch_stats, batch, base_rng
+            )
+            acc = accuracy(logits, batch["y"])
+            new_stats = updates.get("batch_stats") if has_stats else None
+        else:
+            n_rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            if n_rows % accum_steps:
+                raise ValueError(
+                    f"batch of {n_rows} rows does not split into "
+                    f"accum_steps={accum_steps} equal microbatches"
+                )
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape(
+                    accum_steps, x.shape[0] // accum_steps, *x.shape[1:]
+                ),
+                batch,
+            )
+
+            def body(carry, inp):
+                gsum, lsum, asum, stats = carry
+                mb, idx = inp
+                (l, (logits, updates)), g = grad_fn(
+                    state.params, stats, mb, jax.random.fold_in(base_rng, idx)
+                )
+                carry = (
+                    jax.tree_util.tree_map(jnp.add, gsum, g),
+                    lsum + l,
+                    asum + accuracy(logits, mb["y"]),
+                    updates["batch_stats"] if has_stats else stats,
+                )
+                return carry, None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (gsum, lsum, asum, new_stats), _ = jax.lax.scan(
+                body,
+                (zeros, 0.0, 0.0, state.batch_stats),
+                (micro, jnp.arange(accum_steps)),
+            )
+            # Equal microbatches: the mean of microbatch means IS the
+            # full-batch mean, for the loss and its gradient alike.
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                gsum,
+                state.params,
+            )
+            loss = lsum / accum_steps
+            acc = asum / accum_steps
         new_state = state.apply_gradients(grads=grads)
         if has_stats:
-            new_state = new_state.replace(batch_stats=updates["batch_stats"])
-        metrics = {"loss": loss, "accuracy": accuracy(logits, batch["y"])}
+            new_state = new_state.replace(batch_stats=new_stats)
+        metrics = {"loss": loss, "accuracy": acc}
         return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
