@@ -77,6 +77,40 @@ class TpuGptTrain(FlowSpec):
         default=1,
         help="gradient-accumulation microbatches per optimizer step",
     )
+    lr_schedule = Parameter(
+        "lr_schedule", default="constant", help="constant | cosine | linear"
+    )
+    warmup_steps = Parameter(
+        "warmup_steps", default=0, help="linear LR warmup steps"
+    )
+    grad_clip = Parameter(
+        "grad_clip", default=0.0, help="global-norm gradient clip (0 = off)"
+    )
+    weight_decay = Parameter(
+        "weight_decay", default=1e-4, help="adamw decoupled weight decay"
+    )
+    decay_steps = Parameter(
+        "decay_steps",
+        default=0,
+        help="LR decay horizon in steps (0 = this run's epochs*steps); set "
+        "explicitly when extending a run via --from-run so the restored "
+        "step counter lands mid-schedule, not past it",
+    )
+
+    def _optimizer(self):
+        from tpuflow.train import make_optimizer
+
+        total = int(self.epochs) * int(self.steps_per_epoch)
+        return make_optimizer(
+            self.learning_rate,
+            optimizer="adamw",
+            weight_decay=float(self.weight_decay),
+            grad_clip_norm=float(self.grad_clip) or None,
+            warmup_steps=int(self.warmup_steps),
+            decay_steps=int(self.decay_steps)
+            or max(total - int(self.warmup_steps), 1),
+            schedule=self.lr_schedule,
+        )
 
     def _config(self):
         from tpuflow.models.gpt2 import GPT2Config
@@ -115,7 +149,6 @@ class TpuGptTrain(FlowSpec):
     def train(self):
         import jax
         import jax.numpy as jnp
-        import optax
 
         from tpuflow import dist
         from tpuflow.ckpt import CheckpointManager
@@ -161,7 +194,7 @@ class TpuGptTrain(FlowSpec):
         )
         print(f"[gpt_flow] mesh {dict(mesh.shape)}, preset {self.preset}")
         model = GPT2(cfg)
-        tx = optax.adamw(self.learning_rate)
+        tx = self._optimizer()
 
         def init_fn(rng):
             params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
@@ -281,7 +314,7 @@ class TpuGptTrain(FlowSpec):
             f"microbatches={self.microbatches}"
         )
         model = GPT2(cfg)
-        tx = optax.adamw(self.learning_rate)
+        tx = self._optimizer()
         loss_fn = gpt2_pipeline_loss(
             cfg, mesh=mesh, n_microbatches=self.microbatches
         )

@@ -1,0 +1,82 @@
+"""Optimizer factory: schedules, warmup, clipping (tpuflow.train.optim)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.train import make_optimizer, make_schedule
+
+
+def test_warmup_ramps_then_cosine_decays():
+    sched = make_schedule(
+        1e-3, warmup_steps=10, decay_steps=90, schedule="cosine",
+        final_scale=0.1,
+    )
+    assert float(sched(0)) == 0.0
+    assert float(sched(5)) == pytest.approx(5e-4)
+    assert float(sched(10)) == pytest.approx(1e-3)
+    # End of decay: the final_scale floor, held afterwards.
+    assert float(sched(100)) == pytest.approx(1e-4, rel=1e-3)
+    assert float(sched(1000)) == pytest.approx(1e-4, rel=1e-3)
+
+
+def test_linear_and_constant_schedules():
+    lin = make_schedule(1.0, decay_steps=10, schedule="linear", final_scale=0.5)
+    assert float(lin(0)) == pytest.approx(1.0)
+    assert float(lin(10)) == pytest.approx(0.5)
+    const = make_schedule(0.25)
+    assert float(const(0)) == float(const(999)) == 0.25
+    with pytest.raises(ValueError, match="schedule"):
+        make_schedule(1.0, schedule="step")
+
+
+def test_grad_clipping_caps_update_norm():
+    params = {"w": jnp.zeros((4,))}
+    huge = {"w": jnp.full((4,), 1e6)}
+    tx = make_optimizer(
+        1.0, optimizer="sgd", momentum=0.0, grad_clip_norm=1.0
+    )
+    state = tx.init(params)
+    updates, _ = tx.update(huge, state, params)
+    norm = float(jnp.linalg.norm(updates["w"]))
+    assert norm == pytest.approx(1.0, rel=1e-5)  # clipped to the global norm
+
+    tx2 = make_optimizer(1.0, optimizer="sgd", momentum=0.0)
+    updates2, _ = tx2.update(huge, tx2.init(params), params)
+    assert float(jnp.linalg.norm(updates2["w"])) > 1e5  # unclipped
+
+
+def test_adamw_schedule_reaches_the_update():
+    """The LR schedule lives inside the compiled update: a step at the
+    warmup floor must produce a ~zero update, a later one a real one."""
+    params = {"w": jnp.ones((3,))}
+    g = {"w": jnp.ones((3,))}
+    tx = make_optimizer(1e-2, warmup_steps=5, decay_steps=10, schedule="cosine")
+    state = tx.init(params)
+    u0, state = tx.update(g, state, params)  # step 0: lr == 0
+    np.testing.assert_allclose(np.asarray(u0["w"]), 0.0, atol=1e-8)
+    for _ in range(5):
+        u, state = tx.update(g, state, params)
+    assert float(jnp.abs(u["w"]).max()) > 1e-4  # post-warmup: real updates
+
+
+def test_bad_args_raise():
+    with pytest.raises(ValueError, match="optimizer"):
+        make_optimizer(1.0, optimizer="lamb")
+    with pytest.raises(ValueError, match="grad_clip_norm"):
+        make_optimizer(1.0, grad_clip_norm=0.0)
+
+
+def test_default_flags_keep_optax_state_tree():
+    """Constant schedule + no warmup must produce the exact opt_state pytree
+    of plain optax.adamw(lr), so pre-factory checkpoints keep restoring."""
+    import optax
+
+    params = {"w": jnp.ones((2,))}
+    ours = make_optimizer(1e-3).init(params)
+    plain = optax.adamw(1e-3).init(params)
+    assert (
+        jax.tree_util.tree_structure(ours)
+        == jax.tree_util.tree_structure(plain)
+    )

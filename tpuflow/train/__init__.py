@@ -1,5 +1,6 @@
 """Distributed training runtime (Trainer, configs, context, Result)."""
 
+from tpuflow.train.optim import make_optimizer, make_schedule
 from tpuflow.train.step import (
     TrainState,
     create_train_state,
@@ -28,6 +29,8 @@ __all__ = [
     "create_train_state",
     "get_context",
     "make_eval_step",
+    "make_optimizer",
+    "make_schedule",
     "make_train_step",
     "per_worker_batch_size",
 ]
