@@ -27,20 +27,53 @@ from tpuflow.flow import (  # noqa: E402
     step,
 )
 
-def _lm_loader(batch_size: int, steps: int, seq_len: int, vocab: int):
+def _lm_loader(
+    batch_size: int, steps: int, seq_len: int, vocab: int,
+    dataset: str = "lm_synth",
+):
     """Sharded LM loader from the data subsystem (D4/D16 for the GPT
-    family): 'lm_synth' yields {'x': tokens[:, :-1], 'y': tokens[:, 1:]}
-    with the same seeded per-epoch reshuffle semantics as the image
-    loaders (set_epoch ↔ my_ray_module.py:149-151)."""
+    family): yields {'x': tokens[:, :-1], 'y': tokens[:, 1:]} with the same
+    seeded per-epoch reshuffle semantics as the image loaders (set_epoch ↔
+    my_ray_module.py:149-151). 'lm_synth' is the deterministic stand-in;
+    'lm_text' trains byte-level on a local text file (drop a .txt into
+    $TPUFLOW_DATA_DIR or point TPUFLOW_TEXT_FILE at one)."""
     from tpuflow.data import ShardedLoader, load_dataset
 
-    ds = load_dataset(
-        "lm_synth",
-        synthetic_size=max(batch_size * steps, batch_size),
-        seq_len=seq_len,
-        vocab_size=vocab,
-    )
-    return ShardedLoader(ds.train, batch_size=batch_size, shuffle=True)
+    if dataset == "lm_text":
+        from tpuflow.data.datasets import Split
+
+        ds = load_dataset("lm_text", seq_len=seq_len)
+        if vocab < 256:
+            raise ValueError(
+                f"lm_text is byte-level (vocab 256) but the model's "
+                f"vocab_size is {vocab}"
+            )
+        train = ds.train
+        if train.images.shape[0] < batch_size:
+            raise ValueError(
+                f"lm_text corpus yields only {train.images.shape[0]} "
+                f"windows of seq_len+1 bytes — fewer than one batch of "
+                f"{batch_size}; use a bigger file or smaller --batch-size"
+            )
+        # Honor steps_per_epoch as the epoch length (and keep the LR decay
+        # horizon, epochs*steps_per_epoch, truthful) by capping the split;
+        # a smaller file just yields fewer steps.
+        cap = batch_size * steps
+        if train.images.shape[0] > cap:
+            train = Split(train.images[:cap], train.labels[:cap])
+    elif dataset == "lm_synth":
+        ds = load_dataset(
+            "lm_synth",
+            synthetic_size=max(batch_size * steps, batch_size),
+            seq_len=seq_len,
+            vocab_size=vocab,
+        )
+        train = ds.train
+    else:
+        raise ValueError(
+            f"unknown --dataset {dataset!r}; available: lm_synth, lm_text"
+        )
+    return ShardedLoader(train, batch_size=batch_size, shuffle=True)
 
 
 class TpuGptTrain(FlowSpec):
@@ -64,6 +97,9 @@ class TpuGptTrain(FlowSpec):
         "microbatches", default=2, help="pipeline microbatches per step"
     )
     attn_impl = Parameter("attn_impl", default="xla", help="xla|flash|ring|ulysses")
+    dataset = Parameter(
+        "dataset", default="lm_synth", help="lm_synth | lm_text (byte-level)"
+    )
     from_run = Parameter(
         "from_run", default="", help="run pathspec to resume full state from"
     )
@@ -237,7 +273,7 @@ class TpuGptTrain(FlowSpec):
 
             loader = _lm_loader(
                 self.batch_size, self.steps_per_epoch, self.seq_len,
-                cfg.vocab_size,
+                cfg.vocab_size, dataset=self.dataset,
             )
             seq_spec = "seq" if self.seq_axis > 1 else None
             batch_sharding = jax.sharding.NamedSharding(
@@ -385,7 +421,7 @@ class TpuGptTrain(FlowSpec):
 
             loader = _lm_loader(
                 self.batch_size, self.steps_per_epoch, self.seq_len,
-                cfg.vocab_size,
+                cfg.vocab_size, dataset=self.dataset,
             )
             data_sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec("data")

@@ -221,3 +221,49 @@ def test_lm_synth_dataset_and_loader():
     idx0, idx1 = (set(ld._indices().tolist()) for ld in loaders)
     assert not (idx0 & idx1)
     assert idx0 | idx1 == set(range(64))
+
+
+def test_lm_text_from_file_roundtrips_bytes(tmp_path):
+    """lm_text chunks a real file's bytes into (seq_len+1) windows with a
+    95/5 train/test split; the bytes survive the round trip exactly."""
+    text = ("the quick brown fox jumps over the lazy dog. " * 64).encode()
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(text)
+    ds = load_dataset("lm_text", data_dir=str(tmp_path), seq_len=16)
+    assert not ds.synthetic
+    assert ds.num_classes == 256
+    n_win = len(text) // 17
+    assert ds.train.images.shape[0] + ds.test.images.shape[0] == n_win
+    # Input/target are the same window shifted by one.
+    np.testing.assert_array_equal(
+        ds.train.images[0, 1:], ds.train.labels[0, :-1]
+    )
+    # First window reproduces the file's first bytes.
+    np.testing.assert_array_equal(
+        ds.train.images[0], np.frombuffer(text[:16], np.uint8).astype(np.int32)
+    )
+
+
+def test_lm_text_synthetic_fallback_and_env_override(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUFLOW_TEXT_FILE", raising=False)
+    ds = load_dataset("lm_text", data_dir=str(tmp_path), seq_len=8)
+    assert ds.synthetic  # no .txt anywhere -> deterministic stand-in
+    assert int(ds.train.images.max()) < 256
+
+    p = tmp_path / "elsewhere.log.txt"
+    p.write_bytes(b"abcdefgh" * 40)
+    monkeypatch.setenv("TPUFLOW_TEXT_FILE", str(p))
+    ds2 = load_dataset("lm_text", data_dir=str(tmp_path / "nodir"), seq_len=8)
+    assert not ds2.synthetic
+
+
+def test_lm_text_too_small_file_raises(tmp_path):
+    (tmp_path / "tiny.txt").write_bytes(b"hi")
+    with pytest.raises(ValueError, match="bytes"):
+        load_dataset("lm_text", data_dir=str(tmp_path), seq_len=64)
+
+
+def test_lm_text_explicit_missing_path_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TEXT_FILE", str(tmp_path / "nope.txt"))
+    with pytest.raises(FileNotFoundError, match="nope.txt"):
+        load_dataset("lm_text", data_dir=str(tmp_path), seq_len=8)

@@ -178,6 +178,66 @@ def _load_synthetic_lm(
     )
 
 
+def _load_text_lm(
+    data_dir: str, seq_len: int, text_path: str | None = None
+) -> Dataset:
+    """Byte-level LM dataset from a local text file — the zero-dependency
+    real-data path for the GPT family (no tokenizer assets needed: the
+    vocabulary is the 256 byte values).
+
+    Source resolution: explicit ``text_path`` → ``TPUFLOW_TEXT_FILE`` env →
+    first ``*.txt`` under the data dir. The file's bytes chunk into
+    non-overlapping ``seq_len + 1`` windows (input = window[:-1], target =
+    window[1:]), split 95/5 into train/test along document order. With no
+    file present, a deterministic byte-pattern corpus stands in
+    (``synthetic=True``), mirroring the image datasets' fallback policy.
+    """
+    import glob as _glob
+
+    explicit = text_path or os.environ.get("TPUFLOW_TEXT_FILE")
+    if explicit:
+        if not os.path.exists(explicit):
+            # An explicitly requested file must never silently degrade to
+            # the synthetic stand-in (a typo'd path would otherwise train
+            # on fake data while claiming real text).
+            raise FileNotFoundError(
+                f"lm_text: requested text file does not exist: {explicit}"
+            )
+        path = explicit
+    else:
+        txts = sorted(_glob.glob(os.path.join(data_dir, "*.txt")))
+        path = txts[0] if txts else None
+    if path is None:
+        # No file anywhere: the deterministic stand-in, shifted into the
+        # printable-byte range (reuses the lm_synth generator, one pattern
+        # source to maintain).
+        base = _load_synthetic_lm(512, seq_len, 95)
+        return Dataset(
+            "lm_text",
+            Split(base.train.images + 32, base.train.labels + 32),
+            Split(base.test.images + 32, base.test.labels + 32),
+            256,
+            synthetic=True,
+        )
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), dtype=np.uint8)
+    n_win = len(raw) // (seq_len + 1)
+    if n_win < 4:
+        raise ValueError(
+            f"{path}: need at least {4 * (seq_len + 1)} bytes for "
+            f"seq_len={seq_len}, have {len(raw)}"
+        )
+    tokens = (
+        raw[: n_win * (seq_len + 1)].reshape(n_win, seq_len + 1).astype(np.int32)
+    )
+    n_train = max(int(n_win * 0.95), 1)
+    if n_train == tokens.shape[0]:
+        n_train -= 1
+    train = Split(tokens[:n_train, :-1], tokens[:n_train, 1:])
+    test = Split(tokens[n_train:, :-1], tokens[n_train:, 1:])
+    return Dataset("lm_text", train, test, 256, synthetic=False)
+
+
 def _load_fashion_mnist(data_dir: str, name: str) -> Dataset:
     prefix = "" if name == "fashion_mnist" else ""
     files = {
@@ -252,6 +312,7 @@ def load_dataset(
     synthetic_size: int = 2_000,
     seq_len: int = 64,
     vocab_size: int = 512,
+    text_path: str | None = None,
 ) -> Dataset:
     """Load (or synthesize) a dataset by name, with npz caching under a
     FileLock so only one process per host does the decode/generation.
@@ -266,6 +327,10 @@ def load_dataset(
         # Deterministic + parameterized by shape: cheap to regenerate, and
         # an npz cache keyed only on the name would collide across shapes.
         return _load_synthetic_lm(synthetic_size, seq_len, vocab_size)
+    if name == "lm_text":
+        # One file read + reshape: cheaper than an npz round-trip, and the
+        # cache key problem is the same as lm_synth's.
+        return _load_text_lm(data_dir, seq_len, text_path)
     cache = os.path.join(data_dir, f"{name}_cache.npz")
     with FileLock(os.path.join(data_dir, f".{name}.lock")):
         if os.path.exists(cache):
@@ -286,7 +351,7 @@ def load_dataset(
         else:
             raise KeyError(
                 f"unknown dataset {name!r}; available: fashion_mnist, mnist, "
-                "cifar10, imagenet_synth, lm_synth"
+                "cifar10, imagenet_synth, lm_synth, lm_text"
             )
         np.savez(
             cache,
