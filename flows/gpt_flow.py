@@ -12,8 +12,11 @@ Medium: python flows/gpt_flow.py run --preset medium --data-axis 4 --fsdp-axis 8
 """
 
 import functools
+import math
 import os
 import sys
+
+import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -40,27 +43,18 @@ def _lm_loader(
     from tpuflow.data import ShardedLoader, load_dataset
 
     if dataset == "lm_text":
-        from tpuflow.data.datasets import Split
-
         ds = load_dataset("lm_text", seq_len=seq_len)
         if vocab < 256:
             raise ValueError(
                 f"lm_text is byte-level (vocab 256) but the model's "
                 f"vocab_size is {vocab}"
             )
-        train = ds.train
-        if train.images.shape[0] < batch_size:
+        if ds.train.images.shape[0] < batch_size:
             raise ValueError(
-                f"lm_text corpus yields only {train.images.shape[0]} "
+                f"lm_text corpus yields only {ds.train.images.shape[0]} "
                 f"windows of seq_len+1 bytes — fewer than one batch of "
                 f"{batch_size}; use a bigger file or smaller --batch-size"
             )
-        # Honor steps_per_epoch as the epoch length (and keep the LR decay
-        # horizon, epochs*steps_per_epoch, truthful) by capping the split;
-        # a smaller file just yields fewer steps.
-        cap = batch_size * steps
-        if train.images.shape[0] > cap:
-            train = Split(train.images[:cap], train.labels[:cap])
     elif dataset == "lm_synth":
         ds = load_dataset(
             "lm_synth",
@@ -68,12 +62,26 @@ def _lm_loader(
             seq_len=seq_len,
             vocab_size=vocab,
         )
-        train = ds.train
     else:
         raise ValueError(
             f"unknown --dataset {dataset!r}; available: lm_synth, lm_text"
         )
-    return ShardedLoader(train, batch_size=batch_size, shuffle=True)
+    # Epoch length honors --steps-per-epoch (keeping the LR decay horizon,
+    # epochs*steps_per_epoch, truthful) via max_batches: each epoch's
+    # reshuffle ranges over the WHOLE corpus, so successive epochs see
+    # different windows of a large file. Held-out loader pads+masks its
+    # ragged tail so every test window counts in the validation perplexity.
+    train = ShardedLoader(
+        ds.train, batch_size=batch_size, shuffle=True, max_batches=steps
+    )
+    val = ShardedLoader(
+        ds.test,
+        batch_size=batch_size,
+        shuffle=False,
+        pad_tail=True,
+        drop_last=False,
+    )
+    return train, val
 
 
 class TpuGptTrain(FlowSpec):
@@ -190,7 +198,7 @@ class TpuGptTrain(FlowSpec):
         from tpuflow.ckpt import CheckpointManager
         from tpuflow.models.gpt2 import GPT2
         from tpuflow.parallel import create_sharded_state, gpt2_tensor_rules
-        from tpuflow.train import TrainState, make_train_step
+        from tpuflow.train import TrainState, make_eval_step, make_train_step
 
         cfg = self._config()
         if self.resume_checkpoint is not None:
@@ -271,7 +279,7 @@ class TpuGptTrain(FlowSpec):
                 )
                 print("[gpt_flow] full sharded state restored")
 
-            loader = _lm_loader(
+            loader, val_loader = _lm_loader(
                 self.batch_size, self.steps_per_epoch, self.seq_len,
                 cfg.vocab_size, dataset=self.dataset,
             )
@@ -280,6 +288,7 @@ class TpuGptTrain(FlowSpec):
                 mesh, jax.sharding.PartitionSpec(("data", "fsdp"), seq_spec)
             )
             train_step = make_train_step(accum_steps=int(self.accum_steps))
+            eval_step = make_eval_step()
             rng = jax.random.PRNGKey(1)
             history = []
             for epoch in range(self.epochs):
@@ -295,7 +304,35 @@ class TpuGptTrain(FlowSpec):
                 jax.block_until_ready(state.params)
                 epoch_loss = float(jnp.stack(losses).mean())
                 history.append(epoch_loss)
-                print(f"[gpt_flow] epoch {epoch}: loss={epoch_loss:.4f}")
+                # Held-out validation: token-level loss -> perplexity over
+                # EVERY test window (padded tail masked out). The
+                # best/retention policy keys on real val loss, matching the
+                # reference's save-best-on-val semantics
+                # (my_ray_module.py:190-201), not the train loss.
+                tot = cnt = 0.0
+                for b in val_loader:
+                    m = eval_step(
+                        state,
+                        {
+                            "x": jax.device_put(b["x"], batch_sharding),
+                            "y": jax.device_put(b["y"], batch_sharding),
+                            # Loader masks rows; token loss is (rows, seq).
+                            "mask": jax.device_put(
+                                np.broadcast_to(
+                                    b["mask"][:, None], b["y"].shape
+                                ).astype(np.float32),
+                                batch_sharding,
+                            ),
+                        },
+                    )
+                    tot += float(m["loss_sum"])
+                    cnt += float(m["count"])
+                val_loss = tot / max(cnt, 1.0)
+                ppl = math.exp(min(val_loss, 30.0))
+                print(
+                    f"[gpt_flow] epoch {epoch}: loss={epoch_loss:.4f} "
+                    f"val_loss={val_loss:.4f} ppl={ppl:.2f}"
+                )
                 mgr.save(
                     int(state.step),
                     {
@@ -303,7 +340,11 @@ class TpuGptTrain(FlowSpec):
                         "params": state.params,
                         "opt_state": state.opt_state,
                     },
-                    metrics={"val_loss": epoch_loss},
+                    metrics={
+                        "val_loss": val_loss,
+                        "train_loss": epoch_loss,
+                        "ppl": ppl,
+                    },
                 )
             mgr.wait_until_finished()
             self.result_checkpoint = mgr.checkpoint()
@@ -419,7 +460,7 @@ class TpuGptTrain(FlowSpec):
                 updates, opt_state = tx.update(grads, opt_state, params)
                 return optax.apply_updates(params, updates), opt_state, loss
 
-            loader = _lm_loader(
+            loader, _ = _lm_loader(
                 self.batch_size, self.steps_per_epoch, self.seq_len,
                 cfg.vocab_size, dataset=self.dataset,
             )

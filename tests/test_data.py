@@ -267,3 +267,16 @@ def test_lm_text_explicit_missing_path_raises(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUFLOW_TEXT_FILE", str(tmp_path / "nope.txt"))
     with pytest.raises(FileNotFoundError, match="nope.txt"):
         load_dataset("lm_text", data_dir=str(tmp_path), seq_len=8)
+
+
+def test_max_batches_caps_epoch_but_roams_the_corpus():
+    """max_batches bounds batches per epoch while the reshuffle still draws
+    from the whole split — different epochs cover different rows."""
+    split = _toy_split(100)
+    ld = ShardedLoader(split, 10, shuffle=True, seed=1, max_batches=3)
+    assert len(ld) == 3
+    e0 = np.concatenate([b["y"] for b in ld])
+    assert len(e0) == 30
+    ld.set_epoch(1)
+    e1 = np.concatenate([b["y"] for b in ld])
+    assert not np.array_equal(np.sort(e0), np.sort(e1))  # new rows seen

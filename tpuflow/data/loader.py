@@ -48,6 +48,10 @@ class ShardedLoader:
     num_shards: int = 1
     drop_last: bool = True
     pad_tail: bool = False  # emit a final padded+masked batch (eval mode)
+    # Cap on batches per epoch (None = all). The per-epoch permutation still
+    # ranges over the WHOLE split, so successive epochs cover different
+    # subsets — bounding epoch length without pinning training to a prefix.
+    max_batches: int | None = None
 
     def __post_init__(self):
         if not 0 <= self.shard_index < self.num_shards:
@@ -79,11 +83,17 @@ class ShardedLoader:
     def __len__(self) -> int:
         n = len(self._indices())
         if self.drop_last and not self.pad_tail:
-            return n // self.batch_size
-        return -(-n // self.batch_size)
+            count = n // self.batch_size
+        else:
+            count = -(-n // self.batch_size)
+        if self.max_batches is not None:
+            count = min(count, self.max_batches)
+        return count
 
     def __iter__(self) -> Iterator[dict]:
         order = self._indices()
+        if self.max_batches is not None:
+            order = order[: self.max_batches * self.batch_size]
         bs = self.batch_size
         n_full = len(order) // bs
         for b in range(n_full):
