@@ -246,3 +246,27 @@ def test_grad_accumulation_rejects_ragged_split():
         make_train_step(donate=False, accum_steps=3)(
             state, _batch(64), jax.random.PRNGKey(0)
         )
+
+
+def test_grad_norm_metric_matches_manual():
+    state = _make_state()
+    batch = _batch(32, seed=5)
+    rng = jax.random.PRNGKey(0)
+    _, metrics = make_train_step(donate=False)(state, batch, rng)
+    assert float(metrics["grad_norm"]) > 0.0
+
+    # Manual check: recompute grads with the same rng folding and compare.
+    from tpuflow.models.losses import cross_entropy_loss
+
+    def loss_fn(params):
+        logits = state.apply_fn(
+            {"params": params}, batch["x"], train=True,
+            rngs={"dropout": jax.random.fold_in(rng, state.step)},
+            mutable=["losses"],
+        )[0]
+        return cross_entropy_loss(logits, batch["y"])
+
+    grads = jax.grad(loss_fn)(state.params)
+    np.testing.assert_allclose(
+        float(metrics["grad_norm"]), float(optax.global_norm(grads)), rtol=1e-5
+    )

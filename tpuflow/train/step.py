@@ -173,7 +173,16 @@ def make_train_step(
         new_state = state.apply_gradients(grads=grads)
         if has_stats:
             new_state = new_state.replace(batch_stats=new_stats)
-        metrics = {"loss": loss, "accuracy": acc}
+        import optax
+
+        # Pre-clip global gradient norm: the standard training-health signal
+        # (spikes predict divergence; ~0 flags dead gradients). One fused
+        # reduction — noise next to the backward pass.
+        metrics = {
+            "loss": loss,
+            "accuracy": acc,
+            "grad_norm": optax.global_norm(grads),
+        }
         return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
