@@ -449,3 +449,65 @@ def test_prewarm_restore_handle_and_nonraw_noop(tmp_path):
     raw._ARENA.prewarm_wait()
     assert sum(len(v) for v in raw._ARENA._buffers.values()) == 0
     mgr.close()
+
+
+def test_bfloat16_leaf_dtype_roundtrips(tmp_path):
+    """Manifest dtype spelling for extended types (VERDICT-class latent bug:
+    np.dtype(bfloat16).str is raw void '<V2', losing the type): a bf16 leaf
+    must restore as bf16 with identical bytes."""
+    state = {"w": jnp.arange(64, dtype=jnp.bfloat16).reshape(8, 8) / 7.0}
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, state, metrics={"val_loss": 1.0})
+    mgr.wait_until_finished()
+    restored = mgr.restore(1)
+    got = restored["w"]
+    assert np.dtype(got.dtype) == np.dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32), np.asarray(state["w"], np.float32)
+    )
+    mgr.close()
+
+
+def test_save_dtype_halves_bytes_and_restores_to_template(tmp_path):
+    """save_dtype='bfloat16': float32 leaves are written half-size, integer
+    leaves stay exact, and a float32 template restores rounded-to-bf16
+    values in float32."""
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 64)).astype(np.float32)
+    state = {"w": jnp.asarray(w), "step": jnp.asarray(7, jnp.int32)}
+
+    full = CheckpointManager(str(tmp_path / "full"), async_save=False)
+    full.save(1, state)
+    full.wait_until_finished()
+    half = CheckpointManager(
+        str(tmp_path / "half"), async_save=False, save_dtype="bfloat16"
+    )
+    half.save(1, state)
+    half.wait_until_finished()
+
+    def payload_bytes(root):
+        return sum(
+            os.path.getsize(os.path.join(r, f))
+            for r, _, fs in os.walk(root)
+            for f in fs
+            if f.endswith(".bin")
+        )
+
+    nb_full = payload_bytes(tmp_path / "full" / "step_1")
+    nb_half = payload_bytes(tmp_path / "half" / "step_1")
+    assert nb_half < 0.6 * nb_full  # the f32 leaf halved; the int4 is noise
+
+    abstract = {
+        "w": jax.ShapeDtypeStruct((64, 64), np.float32),
+        "step": jax.ShapeDtypeStruct((), np.int32),
+    }
+    restored = half.restore(1, abstract_state=abstract)
+    assert restored["w"].dtype == np.float32
+    assert int(restored["step"]) == 7  # integers never downcast
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]),
+        np.asarray(jnp.asarray(w).astype(jnp.bfloat16), np.float32),
+    )
+    assert half.restore_metadata(1)["save_dtype"] == "bfloat16"
+    full.close()
+    half.close()

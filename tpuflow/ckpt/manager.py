@@ -84,6 +84,7 @@ class CheckpointManager:
         best_mode: str = "min",
         async_save: bool = True,
         format: str = "auto",
+        save_dtype: str | None = None,
     ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -91,6 +92,19 @@ class CheckpointManager:
         self.best_metric = best_metric
         self.best_mode = best_mode
         self._async = async_save
+        # Reduced-precision checkpointing: cast floating leaves wider than
+        # ``save_dtype`` down before writing (e.g. 'bfloat16' halves f32
+        # checkpoint bytes — and doubles effective save/restore GB/s).
+        # Restore-with-template casts back to the template dtype, so
+        # training resumes in full precision from rounded values. Lossy by
+        # design; leave None for bit-exact checkpoints. Integer leaves
+        # (step counters, token ids) are never touched.
+        if save_dtype is not None and save_dtype not in ("bfloat16", "float16"):
+            raise ValueError(
+                f"save_dtype must be None, 'bfloat16' or 'float16', "
+                f"got {save_dtype!r}"
+            )
+        self.save_dtype = save_dtype
         # 'raw' = native striped-IO per-leaf files (fast path; needs fully
         # addressable leaves, i.e. single-host); 'orbax' = tensorstore OCDBT
         # (multi-host sharded writes). 'auto' picks raw when possible.
@@ -336,6 +350,9 @@ class CheckpointManager:
             "process_count": jax.process_count(),
             "device_count": jax.device_count(),
         }
+        if self.save_dtype is not None:
+            state = _downcast(state, self.save_dtype)
+            meta["save_dtype"] = self.save_dtype
 
         def _commit(merge: bool = False) -> None:
             # The step becomes visible (metadata.json present) only once its
@@ -520,6 +537,28 @@ class CheckpointManager:
         return Checkpoint(
             path=self._step_dir(chosen), metadata=self._read_meta(chosen) or {}
         )
+
+
+def _downcast(state, dtype_name: str):
+    """Cast floating leaves WIDER than ``dtype_name`` down to it (the
+    reduced-precision save path; see CheckpointManager save_dtype). Integer
+    and already-narrow leaves pass through untouched; works for jax arrays
+    (device-side cast, sharding preserved) and host numpy alike."""
+    import jax.numpy as jnp
+
+    target = jnp.dtype(dtype_name)
+
+    def cast(leaf):
+        d = getattr(leaf, "dtype", None)
+        if (
+            d is not None
+            and jnp.issubdtype(d, jnp.floating)
+            and jnp.dtype(d).itemsize > target.itemsize
+        ):
+            return leaf.astype(target)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, state)
 
 
 def prewarm_restore_handle(

@@ -437,6 +437,15 @@ def _leaf_shards(leaf) -> list[tuple[list[int], np.ndarray]]:
     return [([0] * arr.ndim, arr)]
 
 
+def _dtype_str(d) -> str:
+    """Manifest dtype spelling. Extended types (bfloat16, float8_*) have a
+    raw-void ``.str`` ('<V2') that loses the type identity — their ``.name``
+    parses back via the ml_dtypes registry; standard dtypes keep the
+    endianness-explicit ``.str``."""
+    d = np.dtype(d)
+    return d.name if d.kind == "V" else d.str
+
+
 def _gather_host(tree):
     """Synchronous device→host stage: (path, full_shape, dtype, shards).
 
@@ -446,13 +455,13 @@ def _gather_host(tree):
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         shards = _leaf_shards(leaf)
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-            shape, dtype = list(leaf.shape), np.dtype(leaf.dtype).str
+            shape, dtype = list(leaf.shape), _dtype_str(leaf.dtype)
         else:
             # Pure-Python scalar/list leaves: derive shape/dtype the same way
             # _leaf_shards does, so processes that own no shard of the leaf
             # (every rank but 0) still emit a valid manifest entry.
             arr = np.asarray(leaf)
-            shape, dtype = list(arr.shape), arr.dtype.str
+            shape, dtype = list(arr.shape), _dtype_str(arr.dtype)
         out.append((_path_names(path), shape, dtype, shards))
     return out
 
