@@ -24,6 +24,7 @@ from tpuflow.flow import (  # noqa: E402
     FlowSpec,
     Parameter,
     Run,
+    card,
     current,
     device_profile,
     retry,
@@ -291,6 +292,7 @@ class TpuGptTrain(FlowSpec):
             eval_step = make_eval_step()
             rng = jax.random.PRNGKey(1)
             history = []
+            epoch_records = []
             for epoch in range(self.epochs):
                 loader.set_epoch(epoch)
                 losses = []
@@ -329,6 +331,14 @@ class TpuGptTrain(FlowSpec):
                     cnt += float(m["count"])
                 val_loss = tot / max(cnt, 1.0)
                 ppl = math.exp(min(val_loss, 30.0))
+                epoch_records.append(
+                    {
+                        "epoch": epoch,
+                        "train_loss": epoch_loss,
+                        "val_loss": val_loss,
+                        "ppl": ppl,
+                    }
+                )
                 print(
                     f"[gpt_flow] epoch {epoch}: loss={epoch_loss:.4f} "
                     f"val_loss={val_loss:.4f} ppl={ppl:.2f}"
@@ -349,6 +359,7 @@ class TpuGptTrain(FlowSpec):
             mgr.wait_until_finished()
             self.result_checkpoint = mgr.checkpoint()
             self.loss_history = history
+            self.metrics_history = epoch_records
             mgr.close()
             if self.sample_tokens > 0:
                 # Demonstrate the LM inference surface on the trained model:
@@ -497,11 +508,95 @@ class TpuGptTrain(FlowSpec):
             mgr.wait_until_finished()
             self.result_checkpoint = mgr.checkpoint()
             self.loss_history = history
+            self.metrics_history = [
+                {"epoch": i, "train_loss": l} for i, l in enumerate(history)
+            ]
             mgr.close()
 
+    @card(type="blank")
     @step
     def end(self):
+        self._render_card()
         print(f"[gpt_flow] loss history: {self.loss_history}")
+
+    def _render_card(self):
+        """Training-curve card (D14): per-epoch loss chart + metrics table +
+        final-perplexity headline, the train-side sibling of eval_flow's
+        error-analysis card. Chart style follows the dataviz method: one
+        axis (both series are token-level loss in nats — perplexity stays in
+        the table), categorical slots 1-2 of the validated reference
+        palette, 2px lines, recessive grid, legend for two series."""
+        records = getattr(self, "metrics_history", None)
+        if not records:
+            return
+        from tpuflow.flow import Image, Markdown, Table
+
+        buf = current.card
+        buf.append(Markdown("# Training curves"))
+        last = records[-1]
+        if "ppl" in last:
+            buf.append(
+                Markdown(
+                    f"Final **val perplexity {last['ppl']:.2f}** "
+                    f"(val loss {last['val_loss']:.4f}) after "
+                    f"{len(records)} epoch(s)."
+                )
+            )
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            fig, ax = plt.subplots(figsize=(6, 3.2), facecolor="#fcfcfb")
+            ax.set_facecolor("#fcfcfb")
+            xs = [r["epoch"] for r in records]
+            ax.plot(
+                xs,
+                [r["train_loss"] for r in records],
+                color="#2a78d6",
+                linewidth=2,
+                marker="o",
+                markersize=4,
+                label="train loss",
+            )
+            if "val_loss" in last:
+                ax.plot(
+                    xs,
+                    [r["val_loss"] for r in records],
+                    color="#eb6834",
+                    linewidth=2,
+                    marker="o",
+                    markersize=4,
+                    label="val loss",
+                )
+                ax.legend(frameon=False)
+            from matplotlib.ticker import MaxNLocator
+
+            ax.xaxis.set_major_locator(MaxNLocator(integer=True))
+            ax.set_xlabel("epoch")
+            ax.set_ylabel("loss (nats/token)")
+            ax.grid(True, color="#e5e4e0", linewidth=0.5)
+            for side in ("top", "right"):
+                ax.spines[side].set_visible(False)
+            fig.tight_layout()
+            buf.append(Image.from_matplotlib(fig))
+            plt.close(fig)
+        except Exception as e:  # cards must never fail the run
+            buf.append(Markdown(f"(chart unavailable: {e})"))
+        headers = list(records[0].keys())
+        buf.append(
+            Table(
+                [
+                    [
+                        f"{r.get(h):.4f}" if isinstance(r.get(h), float) else r.get(h)
+                        for h in headers
+                    ]
+                    for r in records
+                ],
+                headers=headers,
+            )
+        )
 
 
 if __name__ == "__main__":
