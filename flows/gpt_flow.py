@@ -15,6 +15,7 @@ import functools
 import math
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -294,16 +295,28 @@ class TpuGptTrain(FlowSpec):
             history = []
             epoch_records = []
             for epoch in range(self.epochs):
+                t_epoch = time.monotonic()
                 loader.set_epoch(epoch)
                 losses = []
-                for b in loader:
+                n_tokens = 0
+                for i, b in enumerate(loader):
                     batch = {
                         "x": jax.device_put(b["x"], batch_sharding),
                         "y": jax.device_put(b["y"], batch_sharding),
                     }
                     state, metrics = train_step(state, batch, rng)
                     losses.append(metrics["loss"])
+                    if epoch == 0 and i == 0:
+                        # Fence out jit compilation so throughput numbers
+                        # are comparable across epochs; the first batch's
+                        # tokens are excluded from the rate accordingly.
+                        jax.block_until_ready(metrics["loss"])
+                        t_epoch = time.monotonic()
+                    else:
+                        n_tokens += int(np.prod(b["y"].shape))
                 jax.block_until_ready(state.params)
+                epoch_s = time.monotonic() - t_epoch
+                tok_s = n_tokens / max(epoch_s, 1e-9) if n_tokens else None
                 epoch_loss = float(jnp.stack(losses).mean())
                 history.append(epoch_loss)
                 # Held-out validation: token-level loss -> perplexity over
@@ -337,11 +350,13 @@ class TpuGptTrain(FlowSpec):
                         "train_loss": epoch_loss,
                         "val_loss": val_loss,
                         "ppl": ppl,
+                        "tokens_per_s": round(tok_s, 1) if tok_s else None,
                     }
                 )
+                rate = f" ({tok_s:.0f} tok/s)" if tok_s else ""
                 print(
                     f"[gpt_flow] epoch {epoch}: loss={epoch_loss:.4f} "
-                    f"val_loss={val_loss:.4f} ppl={ppl:.2f}"
+                    f"val_loss={val_loss:.4f} ppl={ppl:.2f}{rate}"
                 )
                 mgr.save(
                     int(state.step),
@@ -367,13 +382,30 @@ class TpuGptTrain(FlowSpec):
                 # params and all — GSPMD handles the gather under jit.
                 from tpuflow.infer import generate
 
-                prompt = jnp.zeros((1, 4), jnp.int32)
+                # Byte-level corpora get a readable prompt ("The ") and a
+                # text rendering of the sample; token corpora print ids.
+                byte_level = self.dataset == "lm_text"
+                prompt = (
+                    jnp.asarray([list(b"The ")], jnp.int32)
+                    if byte_level
+                    else jnp.zeros((1, 4), jnp.int32)
+                )
                 toks = generate(
                     model, state.params, prompt,
                     max_new_tokens=int(self.sample_tokens), temperature=0.0,
                 )
                 self.sample = [int(t) for t in toks[0]]
-                print(f"[gpt_flow] greedy sample: {self.sample}")
+                if byte_level:
+                    # Out-of-range ids (an undertrained model can emit the
+                    # unused vocab tail) render as the replacement char
+                    # rather than being silently dropped.
+                    text = "".join(
+                        chr(t) if 0 <= t < 256 else "�"
+                        for t in self.sample
+                    )
+                    print(f"[gpt_flow] greedy sample: {text!r}")
+                else:
+                    print(f"[gpt_flow] greedy sample: {self.sample}")
         self.next(self.end)
 
     def _train_pipeline(self, cfg):
@@ -585,15 +617,15 @@ class TpuGptTrain(FlowSpec):
         except Exception as e:  # cards must never fail the run
             buf.append(Markdown(f"(chart unavailable: {e})"))
         headers = list(records[0].keys())
+
+        def fmt(v):
+            if isinstance(v, float):
+                return f"{v:.1f}" if abs(v) >= 100 else f"{v:.4f}"
+            return v
+
         buf.append(
             Table(
-                [
-                    [
-                        f"{r.get(h):.4f}" if isinstance(r.get(h), float) else r.get(h)
-                        for h in headers
-                    ]
-                    for r in records
-                ],
+                [[fmt(r.get(h)) for h in headers] for r in records],
                 headers=headers,
             )
         )
