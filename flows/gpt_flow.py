@@ -158,6 +158,32 @@ class TpuGptTrain(FlowSpec):
             schedule=self.lr_schedule,
         )
 
+    def _validation_loss(self, state, val_loader, eval_step, batch_sharding):
+        """Mean token-level loss over the held-out split: the jitted eval
+        step consumes the loader's row mask broadcast to token shape, so the
+        padded tail contributes nothing."""
+        import jax
+
+        tot = cnt = 0.0
+        for b in val_loader:
+            m = eval_step(
+                state,
+                {
+                    "x": jax.device_put(b["x"], batch_sharding),
+                    "y": jax.device_put(b["y"], batch_sharding),
+                    # Loader masks rows; token loss is (rows, seq).
+                    "mask": jax.device_put(
+                        np.broadcast_to(
+                            b["mask"][:, None], b["y"].shape
+                        ).astype(np.float32),
+                        batch_sharding,
+                    ),
+                },
+            )
+            tot += float(m["loss_sum"])
+            cnt += float(m["count"])
+        return tot / max(cnt, 1.0)
+
     def _config(self):
         from tpuflow.models.gpt2 import GPT2Config
 
@@ -324,25 +350,9 @@ class TpuGptTrain(FlowSpec):
                 # best/retention policy keys on real val loss, matching the
                 # reference's save-best-on-val semantics
                 # (my_ray_module.py:190-201), not the train loss.
-                tot = cnt = 0.0
-                for b in val_loader:
-                    m = eval_step(
-                        state,
-                        {
-                            "x": jax.device_put(b["x"], batch_sharding),
-                            "y": jax.device_put(b["y"], batch_sharding),
-                            # Loader masks rows; token loss is (rows, seq).
-                            "mask": jax.device_put(
-                                np.broadcast_to(
-                                    b["mask"][:, None], b["y"].shape
-                                ).astype(np.float32),
-                                batch_sharding,
-                            ),
-                        },
-                    )
-                    tot += float(m["loss_sum"])
-                    cnt += float(m["count"])
-                val_loss = tot / max(cnt, 1.0)
+                val_loss = self._validation_loss(
+                    state, val_loader, eval_step, batch_sharding
+                )
                 ppl = math.exp(min(val_loss, 30.0))
                 epoch_records.append(
                     {

@@ -149,13 +149,9 @@ def test_generate_with_fsdp_sharded_params(mesh8):
             init_fn, mesh8, jax.random.PRNGKey(0), fsdp=True
         )
         # The equivalence claim is only meaningful if something IS sharded.
-        specs = [
-            s.spec
-            for s in jax.tree_util.tree_leaves(
-                shardings, is_leaf=lambda x: hasattr(x, "spec")
-            )
-        ]
-        assert any(any(p is not None for p in sp) for sp in specs)
+        from tpuflow.parallel import has_sharded_leaf
+
+        assert has_sharded_leaf(shardings)
         got = np.asarray(
             generate(
                 model, state.params, prompt, max_new_tokens=5, temperature=0.0

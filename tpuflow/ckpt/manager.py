@@ -200,19 +200,13 @@ class CheckpointManager:
         the restored state overlaps it on a background thread instead of
         serializing into the restore. No-op for Orbax-format steps.
         """
-        from tpuflow.ckpt import raw as raw_fmt
-
-        if raw_fmt._mmap_enabled():
-            return  # mmap restores never fill arena buffers
         try:
             chosen = self._resolve_step(step, best)
         except (ValueError, FileNotFoundError):
             return
-        state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
-        if not raw_fmt.is_raw(state_dir):
-            return
-        raw_fmt._ARENA.prewarm(
-            raw_fmt.manifest_shard_sizes(state_dir), background=background
+        _prewarm_state_dir(
+            os.path.join(self._step_dir(chosen), _STATE_DIR),
+            background=background,
         )
 
     def prewarm_restore_wait(self) -> None:
@@ -539,6 +533,25 @@ class CheckpointManager:
         )
 
 
+def _prewarm_state_dir(
+    state_dir: str,
+    *,
+    subtree: tuple[str, ...] | None = None,
+    background: bool = True,
+) -> None:
+    """Shared body of prewarm_restore / prewarm_restore_handle: back the
+    restore arena for one raw-format state dir (no-op for non-raw dirs and
+    under mmap mode, where restores never fill arena buffers)."""
+    from tpuflow.ckpt import raw as raw_fmt
+
+    if raw_fmt._mmap_enabled() or not raw_fmt.is_raw(state_dir):
+        return
+    raw_fmt._ARENA.prewarm(
+        raw_fmt.manifest_shard_sizes(state_dir, subtree=subtree),
+        background=background,
+    )
+
+
 def _downcast(state, dtype_name: str):
     """Cast floating leaves WIDER than ``dtype_name`` down to it (the
     reduced-precision save path; see CheckpointManager save_dtype). Integer
@@ -573,18 +586,11 @@ def prewarm_restore_handle(
     restore's flag so only the params subtree's buffers are backed.
     Best-effort: non-raw, non-local, or mmap-mode handles are a no-op.
     """
-    from tpuflow.ckpt import raw as raw_fmt
-
-    if raw_fmt._mmap_enabled():
-        return  # mmap restores never fill arena buffers
     try:
-        state_dir = os.path.join(checkpoint.path, _STATE_DIR)
-        if raw_fmt.is_raw(state_dir):
-            raw_fmt._ARENA.prewarm(
-                raw_fmt.manifest_shard_sizes(
-                    state_dir, subtree=("params",) if weights_only else None
-                )
-            )
+        _prewarm_state_dir(
+            os.path.join(checkpoint.path, _STATE_DIR),
+            subtree=("params",) if weights_only else None,
+        )
     except (OSError, ValueError, KeyError, AttributeError):
         pass
 

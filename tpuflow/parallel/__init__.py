@@ -9,6 +9,7 @@ from tpuflow.parallel.pipeline import (
 from tpuflow.parallel.sharding import (
     create_sharded_state,
     gpt2_tensor_rules,
+    has_sharded_leaf,
     make_shardings,
 )
 from tpuflow.parallel.ulysses import ulysses_attention
@@ -16,6 +17,7 @@ from tpuflow.parallel.ulysses import ulysses_attention
 __all__ = [
     "create_sharded_state",
     "gpt2_tensor_rules",
+    "has_sharded_leaf",
     "make_shardings",
     "make_pipeline_loss",
     "gpt2_pipeline_loss",

@@ -130,6 +130,25 @@ def make_shardings(
     return jax.tree_util.tree_map_with_path(one, abstract_tree)
 
 
+def has_sharded_leaf(shardings, axis: str | None = None) -> bool:
+    """True if any leaf of a shardings pytree is actually partitioned
+    (optionally: on the named ``axis``). Guards equivalence checks that
+    would pass vacuously if a rules/threshold regression silently returned
+    fully replicated shardings (used by tests and the multichip dryrun)."""
+    for s in jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec")
+    ):
+        for part in s.spec:
+            if part is None:
+                continue
+            if axis is None:
+                return True
+            names = part if isinstance(part, tuple) else (part,)
+            if axis in names:
+                return True
+    return False
+
+
 def create_sharded_state(
     init_fn: Callable,
     mesh: Mesh,
