@@ -1,0 +1,206 @@
+"""GPT eval flow: event-triggered LM evaluation + generation card.
+
+The LM-family sibling of ``eval_flow.py`` (reference RayTorchEval,
+eval_flow.py:19-54): auto-triggered when ``TpuGptTrain`` finishes, it
+resolves the finished run's checkpoint handle AND the ``model_config``
+artifact the train flow stores alongside it, rebuilds the model, restores
+weights (zero-copy once the producer succeeded), computes test perplexity
+over the held-out split, greedy- and temperature-samples the model, and
+renders a card: perplexity headline, samples, and the producing run's
+training curves.
+
+Run:        python flows/gpt_eval_flow.py run --checkpoint-run-pathspec TpuGptTrain/<id>
+Triggered:  python flows/gpt_eval_flow.py run --triggered
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpuflow.flow import (  # noqa: E402
+    FlowSpec,
+    Markdown,
+    Parameter,
+    Run,
+    Table,
+    card,
+    current,
+    device_profile,
+    namespace,
+    step,
+    trigger_on_finish,
+)
+
+
+@trigger_on_finish(flow="TpuGptTrain")
+class TpuGptEval(FlowSpec):
+    """Evaluate a finished GPT training run: test perplexity + samples."""
+
+    checkpoint_run_pathspec = Parameter(
+        "checkpoint_run_pathspec",
+        default="",
+        help="run pathspec holding the result artifacts (TpuGptTrain/<id>)",
+    )
+    eval_namespace = Parameter(
+        "eval_namespace", default="", help="namespace to read artifacts from"
+    )
+    batch_size = Parameter("batch_size", default=8, help="eval batch size")
+    sample_tokens = Parameter(
+        "sample_tokens", default=32, help="tokens to generate per sample"
+    )
+
+    def _get_run(self):
+        """Trigger run first, then the explicit pathspec, else raise
+        (↔ reference eval_flow.py:40-54)."""
+        if current.trigger is not None and current.trigger.run is not None:
+            return current.trigger.run
+        if self.eval_namespace:
+            namespace(self.eval_namespace)
+        if self.checkpoint_run_pathspec:
+            return Run(self.checkpoint_run_pathspec)
+        raise ValueError(
+            "no checkpoint source: run with --triggered after a TpuGptTrain "
+            "run, or pass --checkpoint-run-pathspec TpuGptTrain/<id>"
+        )
+
+    @device_profile(interval=1)
+    @card(type="blank")
+    @step
+    def start(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from tpuflow.ckpt import restore_from_handle
+        from tpuflow.data import ShardedLoader, load_dataset
+        from tpuflow.infer import generate, render_tokens
+        from tpuflow.models.gpt2 import GPT2, GPT2Config
+        from tpuflow.train import TrainState, make_eval_step, run_validation
+
+        run = self._get_run()
+        ckpt = run.data.result_checkpoint
+        mc = dict(run.data.model_config)
+        dataset = run.data.dataset_used
+        seq_len = int(run.data.seq_len_used)
+        synthetic_size = int(run.data.synthetic_size_used)
+        if dataset not in ("lm_synth", "lm_text"):
+            # Never fall back silently: a wrong corpus would be presented
+            # as the labeled dataset's perplexity.
+            raise ValueError(
+                f"training run used unknown dataset {dataset!r}; this eval "
+                "flow supports lm_synth and lm_text"
+            )
+        print(f"[gpt_eval] evaluating {ckpt.path} ({mc})")
+
+        cfg = GPT2Config(dropout=0.0, **mc)
+        model = GPT2(cfg)
+        # Weights-only restore; zero-copy (mmap) is sound once the producing
+        # run has succeeded — no writer can recycle its files anymore.
+        params = restore_from_handle(
+            ckpt, weights_only=True, zero_copy=run.successful
+        )
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(0.0)
+        )
+
+        # Test perplexity over the SAME held-out split the training flow
+        # validated on (pad+mask the ragged tail; every window counts).
+        ds = load_dataset(
+            dataset,
+            seq_len=seq_len,
+            vocab_size=cfg.vocab_size,
+            synthetic_size=synthetic_size,
+        )
+        loader = ShardedLoader(
+            ds.test,
+            batch_size=int(self.batch_size),
+            shuffle=False,
+            pad_tail=True,
+            drop_last=False,
+        )
+        self.test_loss = run_validation(state, loader, make_eval_step())
+        self.test_ppl = math.exp(min(self.test_loss, 30.0))
+        print(
+            f"[gpt_eval] test loss={self.test_loss:.4f} "
+            f"ppl={self.test_ppl:.2f}"
+        )
+
+        # Samples: greedy + two temperatures (one compile — temperature is
+        # a traced operand in tpuflow.infer.generate).
+        byte_level = dataset == "lm_text"
+        prompt = (
+            jnp.asarray([list(b"The ")], jnp.int32)
+            if byte_level
+            else jnp.zeros((1, 4), jnp.int32)
+        )
+
+        def render(toks):
+            return render_tokens(toks[0], byte_level=byte_level)
+
+        n_new = int(self.sample_tokens)
+        self.samples = [
+            (
+                "greedy",
+                render(
+                    generate(
+                        model, params, prompt, max_new_tokens=n_new,
+                        temperature=0.0,
+                    )
+                ),
+            )
+        ] + [
+            (
+                f"T={t}",
+                render(
+                    generate(
+                        model, params, prompt, max_new_tokens=n_new,
+                        temperature=t, top_k=40,
+                        rng=jax.random.PRNGKey(0),
+                    )
+                ),
+            )
+            for t in (0.7, 1.0)
+        ]
+        for name, text in self.samples:
+            print(f"[gpt_eval] sample ({name}): {text!r}")
+
+        # Card: headline + samples + the producer's training curves.
+        current.card.append(Markdown("# GPT evaluation"))
+        current.card.append(
+            Markdown(
+                f"Test perplexity **{self.test_ppl:.2f}** "
+                f"(loss {self.test_loss:.4f} nats/token) on `{dataset}`."
+            )
+        )
+        current.card.append(
+            Table([[n, t] for n, t in self.samples], headers=["sampling", "text"])
+        )
+        history = getattr(run.data, "metrics_history", None)
+        if history:
+            headers = list(history[0].keys())
+            current.card.append(Markdown("## Producer training history"))
+            current.card.append(
+                Table(
+                    [
+                        [
+                            f"{r.get(h):.4f}"
+                            if isinstance(r.get(h), float)
+                            else r.get(h)
+                            for h in headers
+                        ]
+                        for r in history
+                    ],
+                    headers=headers,
+                )
+            )
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(f"[gpt_eval] done: test ppl={self.test_ppl:.2f}")
+
+
+if __name__ == "__main__":
+    TpuGptEval.main()

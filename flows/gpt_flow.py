@@ -32,6 +32,13 @@ from tpuflow.flow import (  # noqa: E402
     step,
 )
 
+def _lm_corpus_size(batch_size: int, steps: int) -> int:
+    """Docs in the lm_synth corpus for a run's parameters — ONE source of
+    truth shared by the loader and the ``synthetic_size_used`` artifact the
+    eval flow mirrors to see the identical test split."""
+    return max(batch_size * steps, batch_size)
+
+
 def _lm_loader(
     batch_size: int, steps: int, seq_len: int, vocab: int,
     dataset: str = "lm_synth",
@@ -60,7 +67,7 @@ def _lm_loader(
     elif dataset == "lm_synth":
         ds = load_dataset(
             "lm_synth",
-            synthetic_size=max(batch_size * steps, batch_size),
+            synthetic_size=_lm_corpus_size(batch_size, steps),
             seq_len=seq_len,
             vocab_size=vocab,
         )
@@ -159,30 +166,18 @@ class TpuGptTrain(FlowSpec):
         )
 
     def _validation_loss(self, state, val_loader, eval_step, batch_sharding):
-        """Mean token-level loss over the held-out split: the jitted eval
-        step consumes the loader's row mask broadcast to token shape, so the
-        padded tail contributes nothing."""
+        """Mean token-level loss over the held-out split (shared
+        tpuflow.train.run_validation; padded tail masked out)."""
         import jax
 
-        tot = cnt = 0.0
-        for b in val_loader:
-            m = eval_step(
-                state,
-                {
-                    "x": jax.device_put(b["x"], batch_sharding),
-                    "y": jax.device_put(b["y"], batch_sharding),
-                    # Loader masks rows; token loss is (rows, seq).
-                    "mask": jax.device_put(
-                        np.broadcast_to(
-                            b["mask"][:, None], b["y"].shape
-                        ).astype(np.float32),
-                        batch_sharding,
-                    ),
-                },
-            )
-            tot += float(m["loss_sum"])
-            cnt += float(m["count"])
-        return tot / max(cnt, 1.0)
+        from tpuflow.train import run_validation
+
+        return run_validation(
+            state,
+            val_loader,
+            eval_step,
+            place=lambda x: jax.device_put(x, batch_sharding),
+        )
 
     def _config(self):
         from tpuflow.models.gpt2 import GPT2Config
@@ -229,6 +224,25 @@ class TpuGptTrain(FlowSpec):
         from tpuflow.train import TrainState, make_eval_step, make_train_step
 
         cfg = self._config()
+        # Artifacts a downstream eval flow needs to rebuild the model
+        # (cross-flow handoff: the checkpoint handle alone doesn't carry
+        # the architecture).
+        self.model_config = {
+            "vocab_size": cfg.vocab_size,
+            "n_ctx": cfg.n_ctx,
+            "n_embd": cfg.n_embd,
+            "n_layer": cfg.n_layer,
+            "n_head": cfg.n_head,
+            "scan_layers": cfg.scan_layers,
+            "n_experts": cfg.n_experts,
+        }
+        self.dataset_used = self.dataset
+        self.seq_len_used = int(self.seq_len)
+        # lm_synth's corpus (and so its test split) is sized from the run
+        # parameters; an eval flow must mirror it to see the same split.
+        self.synthetic_size_used = _lm_corpus_size(
+            int(self.batch_size), int(self.steps_per_epoch)
+        )
         if self.resume_checkpoint is not None:
             # Back the restore's destination pages on a background thread
             # while the mesh/model/jit setup below runs (ckpt.RestoreArena).
@@ -405,17 +419,12 @@ class TpuGptTrain(FlowSpec):
                     max_new_tokens=int(self.sample_tokens), temperature=0.0,
                 )
                 self.sample = [int(t) for t in toks[0]]
-                if byte_level:
-                    # Out-of-range ids (an undertrained model can emit the
-                    # unused vocab tail) render as the replacement char
-                    # rather than being silently dropped.
-                    text = "".join(
-                        chr(t) if 0 <= t < 256 else "�"
-                        for t in self.sample
-                    )
-                    print(f"[gpt_flow] greedy sample: {text!r}")
-                else:
-                    print(f"[gpt_flow] greedy sample: {self.sample}")
+                from tpuflow.infer import render_tokens
+
+                print(
+                    "[gpt_flow] greedy sample: "
+                    f"{render_tokens(self.sample, byte_level=byte_level)!r}"
+                )
         self.next(self.end)
 
     def _train_pipeline(self, cfg):

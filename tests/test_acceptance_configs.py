@@ -136,3 +136,38 @@ def test_pipeline_parallel_flow_checkpoint_resume(env):
     run2 = Run(pathspec2)
     assert run2.successful
     assert run2.data.loss_history[0] < first_loss
+
+
+@pytest.mark.slow
+def test_gpt_eval_flow_consumes_train_run(env):
+    """Cross-flow LM handoff: the GPT eval flow rebuilds the model from the
+    train run's model_config artifact, restores weights, and its test
+    perplexity matches the training flow's final val perplexity (identical
+    split + math)."""
+    sys.modules.pop("gpt_eval_flow", None)
+    gpt_flow = importlib.import_module("gpt_flow")
+    gpt_eval_flow = importlib.import_module("gpt_eval_flow")
+
+    pathspec = gpt_flow.TpuGptTrain.main(
+        [
+            "run", "--epochs", "1", "--steps-per-epoch", "8",
+            "--batch-size", "8", "--data-axis", "2", "--fsdp-axis", "2",
+            "--tensor-axis", "2", "--seq-len", "32",
+        ]
+    )
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    assert run.successful
+    train_ppl = run.data.metrics_history[-1]["ppl"]
+
+    eval_spec = gpt_eval_flow.TpuGptEval.main(
+        [
+            "run", "--checkpoint-run-pathspec", pathspec,
+            "--sample-tokens", "4",
+        ]
+    )
+    erun = Run(eval_spec)
+    assert erun.successful
+    assert erun.data.test_ppl == pytest.approx(train_ppl, rel=1e-4)
+    assert len(erun.data.samples) == 3

@@ -2,6 +2,6 @@
 plus autoregressive KV-cache generation for the LM family."""
 
 from tpuflow.infer.engine import BatchPredictor, map_batches
-from tpuflow.infer.generate import generate
+from tpuflow.infer.generate import generate, render_tokens
 
-__all__ = ["BatchPredictor", "generate", "map_batches"]
+__all__ = ["BatchPredictor", "generate", "map_batches", "render_tokens"]

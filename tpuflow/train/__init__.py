@@ -7,6 +7,7 @@ from tpuflow.train.step import (
     make_eval_step,
     make_train_step,
     per_worker_batch_size,
+    run_validation,
 )
 from tpuflow.train.trainer import (
     CheckpointConfig,
@@ -33,4 +34,5 @@ __all__ = [
     "make_schedule",
     "make_train_step",
     "per_worker_batch_size",
+    "run_validation",
 ]

@@ -188,6 +188,33 @@ def make_train_step(
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 
+def run_validation(state, loader, eval_step, *, place=None) -> float:
+    """Mean per-element loss over a (possibly pad_tail) eval loader.
+
+    The loader's per-row mask is broadcast to the label shape (so LM
+    batches mask whole padded rows of tokens), every batch runs through the
+    jitted ``eval_step``, and the masked sums accumulate host-side.
+    ``place`` maps host arrays onto devices (default ``jnp.asarray``; pass
+    a sharded ``device_put`` for mesh execution). One implementation shared
+    by the training flows' per-epoch validation and the eval flows."""
+    import numpy as np
+
+    if place is None:
+        place = jnp.asarray
+    tot = cnt = 0.0
+    for b in loader:
+        batch = {"x": place(b["x"]), "y": place(b["y"])}
+        mask = b.get("mask")
+        if mask is not None:
+            if mask.shape != b["y"].shape:
+                mask = np.broadcast_to(mask[:, None], b["y"].shape)
+            batch["mask"] = place(np.ascontiguousarray(mask, np.float32))
+        m = eval_step(state, batch)
+        tot += float(m["loss_sum"])
+        cnt += float(m["count"])
+    return tot / max(cnt, 1.0)
+
+
 def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
     """Build the jitted eval step for the full validation pass
     (reference my_ray_module.py:162-175).
