@@ -101,6 +101,9 @@ class TpuGptEval(FlowSpec):
         params = restore_from_handle(
             ckpt, weights_only=True, zero_copy=run.successful
         )
+        # One host->device upload now, instead of one per jitted call below
+        # (on CPU this aliases the restored buffers zero-copy).
+        params = jax.tree_util.tree_map(jnp.asarray, params)
         state = TrainState.create(
             apply_fn=model.apply, params=params, tx=optax.sgd(0.0)
         )
@@ -179,22 +182,10 @@ class TpuGptEval(FlowSpec):
         )
         history = getattr(run.data, "metrics_history", None)
         if history:
-            headers = list(history[0].keys())
+            from tpuflow.flow import metrics_table
+
             current.card.append(Markdown("## Producer training history"))
-            current.card.append(
-                Table(
-                    [
-                        [
-                            f"{r.get(h):.4f}"
-                            if isinstance(r.get(h), float)
-                            else r.get(h)
-                            for h in headers
-                        ]
-                        for r in history
-                    ],
-                    headers=headers,
-                )
-            )
+            current.card.append(metrics_table(history))
         self.next(self.end)
 
     @step

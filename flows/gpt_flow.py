@@ -635,19 +635,9 @@ class TpuGptTrain(FlowSpec):
             plt.close(fig)
         except Exception as e:  # cards must never fail the run
             buf.append(Markdown(f"(chart unavailable: {e})"))
-        headers = list(records[0].keys())
+        from tpuflow.flow import metrics_table
 
-        def fmt(v):
-            if isinstance(v, float):
-                return f"{v:.1f}" if abs(v) >= 100 else f"{v:.4f}"
-            return v
-
-        buf.append(
-            Table(
-                [[fmt(r.get(h)) for h in headers] for r in records],
-                headers=headers,
-            )
-        )
+        buf.append(metrics_table(records))
 
 
 if __name__ == "__main__":

@@ -270,3 +270,38 @@ def test_grad_norm_metric_matches_manual():
     np.testing.assert_allclose(
         float(metrics["grad_norm"]), float(optax.global_norm(grads)), rtol=1e-5
     )
+
+
+def test_ema_tracks_params_with_exact_update_math():
+    """EMA weights follow e' = d*e + (1-d)*p' after each step, start as a
+    copy of the initial params, and ride the state pytree (checkpointable,
+    evaluable via state.replace(params=state.ema_params))."""
+    from tpuflow.train import with_ema
+
+    state = with_ema(_make_state(lr=0.1))
+    init = jax.tree_util.tree_map(np.asarray, state.params)
+    step = make_train_step(donate=False, ema_decay=0.9)
+    batch = _batch(32, seed=9)
+    s1, _ = step(state, batch, jax.random.PRNGKey(0))
+    want = jax.tree_util.tree_map(
+        lambda e, p: 0.9 * e + 0.1 * np.asarray(p), init, s1.params
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), b, rtol=1e-6, atol=1e-7
+        ),
+        s1.ema_params,
+        want,
+    )
+    # EMA lags the raw params (decay < 1) but is no longer the init copy.
+    lead = jax.tree_util.tree_leaves(s1.params)[0]
+    ema = jax.tree_util.tree_leaves(s1.ema_params)[0]
+    assert not np.array_equal(np.asarray(ema), np.asarray(lead))
+
+
+def test_ema_requires_seeding():
+    state = _make_state()
+    with pytest.raises(ValueError, match="with_ema"):
+        make_train_step(donate=False, ema_decay=0.99)(
+            state, _batch(8), jax.random.PRNGKey(0)
+        )

@@ -6,7 +6,13 @@ Authoring: ``FlowSpec``, ``@step``, ``Parameter``, ``current``; decorators
 ``Run``/``Task``/``namespace``; card components ``Markdown``/``Table``/
 ``Image``. See tpuflow.flow.runner for execution semantics."""
 
-from tpuflow.flow.cards import CardBuffer, Image, Markdown, Table
+from tpuflow.flow.cards import (
+    CardBuffer,
+    Image,
+    Markdown,
+    Table,
+    metrics_table,
+)
 from tpuflow.flow.client import Run, Task, namespace
 from tpuflow.flow.decorators import (
     card,
@@ -30,6 +36,7 @@ __all__ = [
     "Table",
     "Task",
     "card",
+    "metrics_table",
     "current",
     "device_profile",
     "kubernetes",

@@ -86,6 +86,23 @@ class Table:
         return "\n".join(parts)
 
 
+def metrics_table(records: Sequence[dict]) -> Table:
+    """A Table of per-step/per-epoch metric dicts with consistent float
+    formatting (4 decimals; 1 decimal for magnitudes ≥ 100, e.g. token
+    rates). One renderer shared by every card that shows a metrics history,
+    so the same record never formats differently across cards."""
+
+    def fmt(v):
+        if isinstance(v, float):
+            return f"{v:.1f}" if abs(v) >= 100 else f"{v:.4f}"
+        return v
+
+    headers = list(records[0].keys()) if records else []
+    return Table(
+        [[fmt(r.get(h)) for h in headers] for r in records], headers=headers
+    )
+
+
 class CardBuffer:
     """``current.card`` — append components during the step
     (↔ current.card.append, eval_flow.py:98-100,109)."""

@@ -8,6 +8,7 @@ from tpuflow.train.step import (
     make_train_step,
     per_worker_batch_size,
     run_validation,
+    with_ema,
 )
 from tpuflow.train.trainer import (
     CheckpointConfig,
@@ -35,4 +36,5 @@ __all__ = [
     "make_train_step",
     "per_worker_batch_size",
     "run_validation",
+    "with_ema",
 ]

@@ -37,6 +37,12 @@ class TrainState(train_state.TrainState):
     """
 
     batch_stats: Any = flax.struct.field(default_factory=dict)
+    # Exponential moving average of params (empty dict = EMA off). Enable
+    # with ``with_ema(state)`` + ``make_train_step(ema_decay=...)``; the
+    # averaged weights ride the state pytree, so they checkpoint/restore
+    # with everything else and evaluate via ``state.replace(params=
+    # state.ema_params)``.
+    ema_params: Any = flax.struct.field(default_factory=dict)
 
 
 def create_train_state(model, rng, sample_input, tx) -> TrainState:
@@ -69,11 +75,22 @@ def per_worker_batch_size(global_batch_size: int, num_workers: int) -> int:
     return per
 
 
+def with_ema(state: TrainState) -> TrainState:
+    """Seed EMA tracking: the averaged weights start as a COPY of the
+    current params (distinct buffers — aliasing them would donate the same
+    buffer through two pytree leaves on the first donated step). Pair with
+    ``make_train_step(ema_decay=...)``."""
+    return state.replace(
+        ema_params=jax.tree_util.tree_map(jnp.copy, state.params)
+    )
+
+
 def make_train_step(
     loss_fn: Callable = cross_entropy_loss,
     *,
     donate: bool = True,
     accum_steps: int = 1,
+    ema_decay: float | None = None,
 ) -> Callable:
     """Build the jitted SPMD train step.
 
@@ -173,6 +190,19 @@ def make_train_step(
         new_state = state.apply_gradients(grads=grads)
         if has_stats:
             new_state = new_state.replace(batch_stats=new_stats)
+        if ema_decay is not None:
+            if not state.ema_params:
+                raise ValueError(
+                    "ema_decay is set but the state carries no ema_params; "
+                    "seed them with tpuflow.train.with_ema(state)"
+                )
+            new_state = new_state.replace(
+                ema_params=jax.tree_util.tree_map(
+                    lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
+                    state.ema_params,
+                    new_state.params,
+                )
+            )
         import optax
 
         # Pre-clip global gradient norm: the standard training-health signal
