@@ -50,6 +50,12 @@ class TpuGptEval(FlowSpec):
     sample_tokens = Parameter(
         "sample_tokens", default=32, help="tokens to generate per sample"
     )
+    weights = Parameter(
+        "weights",
+        default="raw",
+        help="raw | ema — evaluate the trained weights or the EMA average "
+        "(requires the producer to have run with --ema-decay)",
+    )
 
     def _get_run(self):
         """Trigger run first, then the explicit pathspec, else raise
@@ -98,8 +104,15 @@ class TpuGptEval(FlowSpec):
         model = GPT2(cfg)
         # Weights-only restore; zero-copy (mmap) is sound once the producing
         # run has succeeded — no writer can recycle its files anymore.
+        # --weights ema selects the averaged-weights subtree an --ema-decay
+        # producer checkpointed (a loud KeyError if it didn't).
+        if self.weights not in ("raw", "ema"):
+            raise ValueError(f"--weights must be raw or ema, got {self.weights!r}")
         params = restore_from_handle(
-            ckpt, weights_only=True, zero_copy=run.successful
+            ckpt,
+            weights_only=True,
+            subtree=("ema_params",) if self.weights == "ema" else None,
+            zero_copy=run.successful,
         )
         # One host->device upload now, instead of one per jitted call below
         # (on CPU this aliases the restored buffers zero-copy).

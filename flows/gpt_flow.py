@@ -142,6 +142,17 @@ class TpuGptTrain(FlowSpec):
     weight_decay = Parameter(
         "weight_decay", default=1e-4, help="adamw decoupled weight decay"
     )
+    ema_decay = Parameter(
+        "ema_decay",
+        default=0.0,
+        help="EMA decay for averaged weights (0 = off; e.g. 0.999)",
+    )
+    ckpt_dtype = Parameter(
+        "ckpt_dtype",
+        default="",
+        help="reduced-precision checkpoints: bfloat16 | float16 (default "
+        "bit-exact)",
+    )
     decay_steps = Parameter(
         "decay_steps",
         default=0,
@@ -267,6 +278,11 @@ class TpuGptTrain(FlowSpec):
                     "pipeline schedule already microbatches via "
                     "--microbatches"
                 )
+            if float(self.ema_decay) > 0.0:
+                raise ValueError(
+                    "--ema-decay is not supported in pipeline mode "
+                    "(--stage-axis > 1); the pipeline step tracks no EMA"
+                )
             self._train_pipeline(cfg)
             self.next(self.end)
             return
@@ -297,6 +313,7 @@ class TpuGptTrain(FlowSpec):
             mgr = CheckpointManager(
                 os.path.join(current.tpu_storage_path, "checkpoints"),
                 max_to_keep=2,
+                save_dtype=self.ckpt_dtype or None,
             )
             if self.resume_checkpoint is not None:
                 from tpuflow.ckpt import restore_from_handle
@@ -311,6 +328,11 @@ class TpuGptTrain(FlowSpec):
                     "params": abstract.params,
                     "opt_state": abstract.opt_state,
                 }
+                if float(self.ema_decay) > 0.0:
+                    # EMA runs save/restore the averaged weights too; the
+                    # resume run must pass the same --ema-decay flag (the
+                    # checkpoint's leaf structure includes them).
+                    tmpl["ema_params"] = abstract.params
                 restored = restore_from_handle(
                     self.resume_checkpoint, abstract_state=tmpl
                 )
@@ -318,6 +340,9 @@ class TpuGptTrain(FlowSpec):
                     step=restored["step"],
                     params=restored["params"],
                     opt_state=restored["opt_state"],
+                    # Present exactly when the template asked for it (the
+                    # raw restore errors on any structure mismatch).
+                    ema_params=restored.get("ema_params", {}),
                 )
                 print("[gpt_flow] full sharded state restored")
 
@@ -329,7 +354,16 @@ class TpuGptTrain(FlowSpec):
             batch_sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(("data", "fsdp"), seq_spec)
             )
-            train_step = make_train_step(accum_steps=int(self.accum_steps))
+            if float(self.ema_decay) > 0.0 and not state.ema_params:
+                # Seed EMA only on fresh starts — a resume above already
+                # restored the averaged weights.
+                from tpuflow.train import with_ema
+
+                state = with_ema(state)
+            train_step = make_train_step(
+                accum_steps=int(self.accum_steps),
+                ema_decay=float(self.ema_decay) or None,
+            )
             eval_step = make_eval_step()
             rng = jax.random.PRNGKey(1)
             history = []
@@ -382,13 +416,16 @@ class TpuGptTrain(FlowSpec):
                     f"[gpt_flow] epoch {epoch}: loss={epoch_loss:.4f} "
                     f"val_loss={val_loss:.4f} ppl={ppl:.2f}{rate}"
                 )
+                payload = {
+                    "step": state.step,
+                    "params": state.params,
+                    "opt_state": state.opt_state,
+                }
+                if float(self.ema_decay) > 0.0:
+                    payload["ema_params"] = state.ema_params
                 mgr.save(
                     int(state.step),
-                    {
-                        "step": state.step,
-                        "params": state.params,
-                        "opt_state": state.opt_state,
-                    },
+                    payload,
                     metrics={
                         "val_loss": val_loss,
                         "train_loss": epoch_loss,
@@ -481,6 +518,7 @@ class TpuGptTrain(FlowSpec):
             mgr = CheckpointManager(
                 os.path.join(current.tpu_storage_path, "checkpoints"),
                 max_to_keep=2,
+                save_dtype=self.ckpt_dtype or None,
             )
             if self.resume_checkpoint is not None:
                 abstract = {

@@ -600,6 +600,7 @@ def restore_from_handle(
     *,
     abstract_state=None,
     weights_only: bool = False,
+    subtree: tuple | None = None,
     zero_copy: bool = False,
 ):
     """Restore state from a flow-level ``Checkpoint`` handle.
@@ -629,9 +630,14 @@ def restore_from_handle(
             )
         state_dir = os.path.join(path, _STATE_DIR)
         if raw_fmt.is_raw(state_dir):
-            if weights_only:
+            if weights_only or subtree is not None:
                 params = raw_fmt.restore_raw(
-                    state_dir, subtree=("params",), zero_copy=zero_copy
+                    state_dir,
+                    # weights_only = the params subtree; an explicit subtree
+                    # selects any other weight tree in the payload (e.g.
+                    # ('ema_params',) for EMA evaluation).
+                    subtree=subtree or ("params",),
+                    zero_copy=zero_copy,
                 )
                 if abstract_state is not None:
                     abstract = _abstractify(abstract_state)

@@ -110,6 +110,11 @@ def make_train_step(
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if ema_decay is not None and not 0.0 < ema_decay < 1.0:
+        raise ValueError(
+            f"ema_decay must be in (0, 1), got {ema_decay} (>= 1 freezes or "
+            "diverges the average)"
+        )
 
     def train_step(state: TrainState, batch, rng):
         base_rng = jax.random.fold_in(rng, state.step)
