@@ -114,3 +114,31 @@ def test_bf16_checkpoint_imports():
     cfg = config_from_hf(hf_cfg)
     params = hf_gpt2_to_params(sd, cfg)
     assert params["wte"].dtype == np.float32
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_export_roundtrip_matches_hf_logits(scan_layers):
+    """Export direction: a tpuflow-trained param tree loads into a torch
+    GPT2LMHeadModel and produces OUR logits — the fine-tune-here,
+    publish-anywhere path."""
+    from tpuflow.models.import_hf import params_to_hf_state_dict
+
+    _, hf_cfg = _tiny_hf(seed=2)
+    cfg = config_from_hf(hf_cfg, scan_layers=scan_layers)
+    # Fresh tpuflow-side params (as if trained here).
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    sd = {
+        k: torch.from_numpy(v)
+        for k, v in params_to_hf_state_dict(params, cfg).items()
+    }
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    hf_model.load_state_dict(sd)
+
+    tokens = np.arange(2 * 10, dtype=np.int32).reshape(2, 10) % 128
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(tokens)))
+    theirs = _hf_logits(hf_model, tokens.astype(np.int64))
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)

@@ -659,6 +659,14 @@ def restore_from_handle(
                 _abstractify(abstract_state) if abstract_state is not None else None,
                 zero_copy=zero_copy,
             )
+        if subtree is not None:
+            # Only the raw format supports arbitrary-subtree partial
+            # restores; silently returning the wrong tree (e.g. raw params
+            # labeled as EMA) would be worse than failing.
+            raise ValueError(
+                "subtree selection requires the raw checkpoint format; "
+                f"{state_dir} is Orbax-format"
+            )
         if weights_only and abstract_state is not None:
             item = {"params": _abstractify(abstract_state)}
             ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())

@@ -36,27 +36,27 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+# ONE mapping drives both directions: (hf module, our module,
+# ((hf param, our param), ...)). HF LayerNorms use weight/bias, Conv1Ds
+# weight/bias; ours use scale/bias and kernel/bias respectively.
+_BLOCK_MAP = (
+    ("ln_1", "ln_1", (("weight", "scale"), ("bias", "bias"))),
+    ("attn.c_attn", "c_attn", (("weight", "kernel"), ("bias", "bias"))),
+    ("attn.c_proj", "c_proj", (("weight", "kernel"), ("bias", "bias"))),
+    ("ln_2", "ln_2", (("weight", "scale"), ("bias", "bias"))),
+    ("mlp.c_fc", "mlp_fc", (("weight", "kernel"), ("bias", "bias"))),
+    ("mlp.c_proj", "mlp_proj", (("weight", "kernel"), ("bias", "bias"))),
+)
+
+
 def _block_params(sd: Mapping[str, Any], i: int) -> dict:
     p = f"transformer.h.{i}."
     return {
-        "ln_1": {"scale": _np(sd[p + "ln_1.weight"]), "bias": _np(sd[p + "ln_1.bias"])},
-        "c_attn": {
-            "kernel": _np(sd[p + "attn.c_attn.weight"]),
-            "bias": _np(sd[p + "attn.c_attn.bias"]),
-        },
-        "c_proj": {
-            "kernel": _np(sd[p + "attn.c_proj.weight"]),
-            "bias": _np(sd[p + "attn.c_proj.bias"]),
-        },
-        "ln_2": {"scale": _np(sd[p + "ln_2.weight"]), "bias": _np(sd[p + "ln_2.bias"])},
-        "mlp_fc": {
-            "kernel": _np(sd[p + "mlp.c_fc.weight"]),
-            "bias": _np(sd[p + "mlp.c_fc.bias"]),
-        },
-        "mlp_proj": {
-            "kernel": _np(sd[p + "mlp.c_proj.weight"]),
-            "bias": _np(sd[p + "mlp.c_proj.bias"]),
-        },
+        ours: {
+            our_param: _np(sd[f"{p}{hf_mod}.{hf_param}"])
+            for hf_param, our_param in pairs
+        }
+        for hf_mod, ours, pairs in _BLOCK_MAP
     }
 
 
@@ -118,6 +118,47 @@ def hf_gpt2_to_params(source, config) -> dict:
         for i, b in enumerate(blocks):
             params[f"h{i}"] = b
     return params
+
+
+def params_to_hf_state_dict(params, config) -> dict:
+    """tpuflow params pytree → HF GPT-2 ``state_dict`` (the export
+    direction: fine-tune here, publish a checkpoint any transformers user
+    can load). Inverse of :func:`hf_gpt2_to_params`; numpy float32 values
+    (convert with ``torch.from_numpy`` / ``load_state_dict`` downstream).
+    Scan-stacked layouts are unstacked back into per-layer entries; the
+    tied ``lm_head.weight`` is emitted explicitly (HF models accept and
+    re-tie it)."""
+    if config.n_experts:
+        raise ValueError("HF GPT-2 has no MoE variant to export to")
+    import jax
+
+    def arr(x):
+        return np.asarray(x, np.float32)
+
+    sd = {
+        "transformer.wte.weight": arr(params["wte"]),
+        "transformer.wpe.weight": arr(params["wpe"]),
+        "transformer.ln_f.weight": arr(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": arr(params["ln_f"]["bias"]),
+    }
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
+
+    def block(i):
+        if config.scan_layers:
+            return {
+                k: jax.tree_util.tree_map(lambda x: x[i], v)
+                for k, v in params["h"]["block"].items()
+            }
+        return params[f"h{i}"]
+
+    for i in range(config.n_layer):
+        b = block(i)
+        for hf_mod, ours, pairs in _BLOCK_MAP:
+            for hf_param, our_param in pairs:
+                sd[f"transformer.h.{i}.{hf_mod}.{hf_param}"] = arr(
+                    b[ours][our_param]
+                )
+    return sd
 
 
 def config_from_hf(hf_config, **overrides):
