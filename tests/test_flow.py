@@ -221,3 +221,17 @@ def test_deploy_and_params_cli(isolated_home, capsys):
         main(LinearFlow, ["run", "--nope", "1"])
     with pytest.raises(SystemExit):
         main(LinearFlow, ["run", "--x"])
+
+
+def test_metrics_table_formats_consistently():
+    """One shared renderer for metrics histories: floats get 4 decimals,
+    magnitudes >= 100 get 1 (token rates), non-floats pass through."""
+    from tpuflow.flow import metrics_table
+
+    t = metrics_table(
+        [{"epoch": 0, "loss": 1.23456, "tokens_per_s": 8123.456}]
+    )
+    html = t._render()
+    assert "1.2346" in html and "8123.5" in html and "epoch" in html
+    assert "<td>0</td>" in html  # ints pass through unformatted
+    assert metrics_table([])._render()  # empty history renders, no crash

@@ -158,3 +158,11 @@ def test_generate_with_fsdp_sharded_params(mesh8):
             )
         )
     np.testing.assert_array_equal(got, want)
+
+
+def test_render_tokens_modes():
+    from tpuflow.infer import render_tokens
+
+    assert render_tokens([72, 105], byte_level=True) == "Hi"
+    assert render_tokens([72, 300], byte_level=True) == "H\N{REPLACEMENT CHARACTER}"
+    assert render_tokens([7, 11]) == "7 11"
