@@ -21,6 +21,7 @@ from tpuflow.dist.mesh import (
     force_cpu_platform,
     initialize,
     is_initialized,
+    make_hybrid_mesh,
     make_mesh,
     process_count,
     process_index,
@@ -43,6 +44,7 @@ __all__ = [
     "force_cpu_platform",
     "initialize",
     "is_initialized",
+    "make_hybrid_mesh",
     "make_mesh",
     "process_count",
     "process_index",

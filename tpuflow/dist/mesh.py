@@ -320,6 +320,96 @@ def make_mesh(
     return Mesh(dev_array, names)
 
 
+def make_hybrid_mesh(
+    dcn_axes: Mapping[str, int],
+    ici_axes: Mapping[str, int],
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Multi-slice mesh: ``dcn_axes`` partition across TPU slices (traffic
+    rides the data-center network), ``ici_axes`` partition within each slice
+    (traffic rides the chip interconnect).
+
+    The standard multi-pod recipe — e.g. 2× v5e-16 slices as
+    ``make_hybrid_mesh({"data": 2}, {"fsdp": 16})``: the gradient all-reduce
+    crosses DCN once per step (bandwidth-tolerant), while FSDP's per-layer
+    all-gathers/reduce-scatters stay on ICI (latency-critical) — the axis
+    placement SURVEY.md §1's scaling model prescribes. Axis sizes must
+    multiply to the slice count and per-slice device count respectively;
+    canonical axes missing from either map are appended at size 1 (on the
+    ICI side) so every tpuflow sharding rule resolves.
+
+    Slices are identified by ``device.slice_index`` (TPU runtimes expose
+    it); on single-slice or CPU platforms a DCN product of 1 degrades to
+    exactly ``make_mesh`` semantics.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dcn_axes = dict(dcn_axes)
+    ici_axes = dict(ici_axes)
+    overlap = set(dcn_axes) & set(ici_axes)
+    if overlap:
+        raise ValueError(f"axes {sorted(overlap)} appear in both dcn and ici maps")
+    n_slices = math.prod(dcn_axes.values()) if dcn_axes else 1
+    if n_slices == 1:
+        return make_mesh({**dcn_axes, **ici_axes}, devices=devices)
+    if any(v == -1 for v in (*dcn_axes.values(), *ici_axes.values())):
+        raise ValueError(
+            "-1 axis inference is not supported in multi-slice hybrid "
+            "meshes; specify every axis size explicitly"
+        )
+
+    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
+    if len(slice_ids) != n_slices:
+        raise ValueError(
+            f"dcn axes {dict(dcn_axes)} want {n_slices} slices but the "
+            f"devices span {len(slice_ids)} (slice ids {slice_ids})"
+        )
+    per_slice = [d for d in devices if getattr(d, "slice_index", 0) == slice_ids[0]]
+    n_ici = math.prod(ici_axes.values())
+    if any(
+        sum(1 for d in devices if getattr(d, "slice_index", 0) == s) != len(per_slice)
+        for s in slice_ids
+    ) or n_ici != len(per_slice):
+        raise ValueError(
+            f"ici axes {dict(ici_axes)} want {n_ici} devices per slice; "
+            f"slices are uneven or sized differently"
+        )
+    for name in _DEFAULT_AXES:
+        if name not in dcn_axes:
+            ici_axes.setdefault(name, 1)
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    try:
+        from jax.experimental import mesh_utils
+
+        # create_hybrid_device_mesh takes same-length per-axis (ici, dcn)
+        # shapes whose elementwise product is the mesh shape: our DCN axes
+        # are ici-size 1 and vice versa, giving DCN axes outermost
+        # (contiguous slices) and ICI axes laid onto each slice's torus.
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) * len(dcn_axes) + tuple(ici_axes.values()),
+            tuple(dcn_axes.values()) + (1,) * len(ici_axes),
+            devices=devices,
+            allow_split_physical_axes=True,
+        )
+    except Exception as e:
+        # Fallback: group by slice id (outer = DCN), flat order within.
+        # Correct slice placement, but the ICI axes lose torus-aware layout
+        # — say so instead of silently degrading collective locality.
+        logger.warning(
+            "create_hybrid_device_mesh failed (%s); falling back to "
+            "slice-grouped flat device order — ICI collectives may not be "
+            "nearest-neighbor",
+            e,
+        )
+        by_slice = [
+            [d for d in devices if getattr(d, "slice_index", 0) == s]
+            for s in slice_ids
+        ]
+        dev_array = np.asarray(by_slice).reshape(shape)
+    return Mesh(dev_array, names)
+
+
 def data_axis_size(mesh: Mesh) -> int:
     """Number of data-parallel shards (the reference's world size,
     my_ray_module.py:149)."""
