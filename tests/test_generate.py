@@ -166,3 +166,52 @@ def test_render_tokens_modes():
     assert render_tokens([72, 105], byte_level=True) == "Hi"
     assert render_tokens([72, 300], byte_level=True) == "H\N{REPLACEMENT CHARACTER}"
     assert render_tokens([7, 11]) == "7 11"
+
+
+def test_top_p_restricts_to_nucleus():
+    """With a peaked distribution and small top_p, sampling must collapse to
+    the argmax token; top_p=1.0 must behave like plain sampling (same rng,
+    same tokens)."""
+    model, params = _model()
+    prompt = np.ones((2, 4), np.int32)
+    rng = jax.random.PRNGKey(5)
+    # Tiny nucleus + tiny temperature → the top token dominates: equals greedy.
+    tight = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=6, temperature=0.05,
+            top_p=0.05, rng=rng,
+        )
+    )
+    greedy = np.asarray(
+        generate(model, params, prompt, max_new_tokens=6, temperature=0.0)
+    )
+    np.testing.assert_array_equal(tight, greedy)
+    # Full nucleus = no filtering: matches the unfiltered sample exactly.
+    full = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=6, temperature=0.9,
+            top_p=1.0, rng=rng,
+        )
+    )
+    plain = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=6, temperature=0.9, rng=rng,
+        )
+    )
+    np.testing.assert_array_equal(full, plain)
+
+
+def test_top_p_sweep_does_not_recompile_and_validates():
+    model, params = _model()
+    prompt = np.ones((1, 4), np.int32)
+    from tpuflow.infer.generate import _generate_jit
+
+    before = _generate_jit._cache_size()
+    for p in (0.8, 0.9, 0.95):
+        generate(
+            model, params, prompt, max_new_tokens=3, temperature=0.9,
+            top_p=p, rng=jax.random.PRNGKey(0),
+        )
+    assert _generate_jit._cache_size() == before + 1  # traced operand
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, max_new_tokens=3, top_p=0.0)

@@ -10,7 +10,8 @@ is the TPU-native shape of that loop:
   token, rng, and done-mask ride the carry, so the entire generation is ONE
   jitted XLA program: no per-token Python dispatch, no dynamic shapes, no
   host↔device chatter until the final tokens come back.
-- Sampling is temperature / top-k categorical (greedy at temperature=0),
+- Sampling is temperature / top-k / top-p categorical (greedy at
+  temperature=0),
   with an EOS done-mask that freezes finished rows to ``pad_id``.
 
 Works on any backend; on a sharded mesh the batch axis shards over 'data'
@@ -25,12 +26,23 @@ import jax
 import jax.numpy as jnp
 
 
-def _sample(logits, rng, temperature, *, greedy: bool, top_k: int | None):
+def _sample(
+    logits,
+    rng,
+    temperature,
+    top_p,
+    *,
+    greedy: bool,
+    top_k: int | None,
+    use_top_p: bool,
+):
     """(B, V) logits → (B,) sampled token ids.
 
-    ``greedy`` (the temperature == 0 case) and ``top_k`` change the program
-    shape and are static; ``temperature`` is a traced operand so sweeping it
-    does not recompile the generation program.
+    ``greedy`` (the temperature == 0 case), ``top_k``, and whether nucleus
+    filtering applies change the program shape and are static;
+    ``temperature`` and the ``top_p`` value are traced operands so sweeping
+    either does not recompile the generation program. With both filters
+    set, top-k applies first, then the nucleus filter over what remains.
     """
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -38,13 +50,27 @@ def _sample(logits, rng, temperature, *, greedy: bool, top_k: int | None):
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if use_top_p:
+        # Nucleus: keep the smallest prefix of the sorted distribution with
+        # cumulative probability >= top_p (the first token always survives).
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # prefix BEFORE this token is < top_p
+        # Threshold = smallest kept logit per row.
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(
     jax.jit,
     static_argnums=(0,),
-    static_argnames=("max_new_tokens", "greedy", "top_k", "eos_id", "pad_id"),
+    static_argnames=(
+        "max_new_tokens", "greedy", "top_k", "use_top_p", "eos_id", "pad_id"
+    ),
 )
 def _generate_jit(
     model,
@@ -52,10 +78,12 @@ def _generate_jit(
     prompt,
     rng,
     temperature,
+    top_p,
     *,
     max_new_tokens: int,
     greedy: bool,
     top_k: int | None,
+    use_top_p: bool,
     eos_id: int | None,
     pad_id: int,
 ):
@@ -68,7 +96,8 @@ def _generate_jit(
     cache = vars_out["cache"]
     rng, sub = jax.random.split(rng)
     tok = _sample(
-        logits[:, -1, :], sub, temperature, greedy=greedy, top_k=top_k
+        logits[:, -1, :], sub, temperature, top_p,
+        greedy=greedy, top_k=top_k, use_top_p=use_top_p,
     )
     # EOS semantics: the eos token itself IS emitted (so callers can trim at
     # it); only positions after it are frozen to pad_id.
@@ -86,7 +115,8 @@ def _generate_jit(
         )
         rng, sub = jax.random.split(rng)
         sampled = _sample(
-            logits[:, -1, :], sub, temperature, greedy=greedy, top_k=top_k
+            logits[:, -1, :], sub, temperature, top_p,
+            greedy=greedy, top_k=top_k, use_top_p=use_top_p,
         )
         nxt = jnp.where(done, pad_id, sampled)
         if eos_id is not None:
@@ -122,6 +152,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     eos_id: int | None = None,
     pad_id: int = 0,
     rng=None,
@@ -133,7 +164,8 @@ def generate(
     upstream) and ``T + max_new_tokens`` must fit the model's ``n_ctx``
     (the fixed cache size). ``temperature=0`` is greedy decoding; any other
     temperature is a traced operand (sweeping it reuses the compiled
-    program). With ``eos_id`` set, the eos token itself is emitted and the
+    program); ``top_k`` and ``top_p`` nucleus filtering compose (top-k
+    first). With ``eos_id`` set, the eos token itself is emitted and the
     row's remaining positions are frozen to ``pad_id``.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -141,6 +173,11 @@ def generate(
     n_ctx = model.config.n_ctx
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_p must be in (0, 1], got {top_p} (<= 0 would mask every "
+            "token)"
+        )
     if T + max_new_tokens > n_ctx:
         raise ValueError(
             f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
@@ -154,9 +191,11 @@ def generate(
         prompt,
         rng,
         jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
         max_new_tokens=max_new_tokens,
         greedy=temperature == 0.0,
         top_k=top_k,
+        use_top_p=top_p is not None,
         eos_id=eos_id,
         pad_id=pad_id,
     )
