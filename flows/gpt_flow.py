@@ -618,7 +618,7 @@ class TpuGptTrain(FlowSpec):
         records = getattr(self, "metrics_history", None)
         if not records:
             return
-        from tpuflow.flow import Image, Markdown, Table
+        from tpuflow.flow import Image, Markdown, metrics_table
 
         buf = current.card
         buf.append(Markdown("# Training curves"))
@@ -673,8 +673,6 @@ class TpuGptTrain(FlowSpec):
             plt.close(fig)
         except Exception as e:  # cards must never fail the run
             buf.append(Markdown(f"(chart unavailable: {e})"))
-        from tpuflow.flow import metrics_table
-
         buf.append(metrics_table(records))
 
 
