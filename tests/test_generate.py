@@ -215,3 +215,36 @@ def test_top_p_sweep_does_not_recompile_and_validates():
     assert _generate_jit._cache_size() == before + 1  # traced operand
     with pytest.raises(ValueError, match="top_p"):
         generate(model, params, prompt, max_new_tokens=3, top_p=0.0)
+
+
+def test_sequence_logprob_matches_eval_loss():
+    """-sum(sequence_logprob) over the batch must equal the eval step's
+    loss_sum on the same tokens — one definition of token likelihood."""
+    import optax
+
+    from tpuflow.infer import sequence_logprob
+    from tpuflow.train import TrainState, make_eval_step
+
+    model, params = _model()
+    tokens = np.arange(4 * 17, dtype=np.int32).reshape(4, 17) % 512
+    lp = np.asarray(sequence_logprob(model, params, tokens))
+    assert lp.shape == (4,) and (lp < 0).all()
+
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.0)
+    )
+    m = make_eval_step()(
+        state, {"x": tokens[:, :-1], "y": tokens[:, 1:]}
+    )
+    np.testing.assert_allclose(-lp.sum(), float(m["loss_sum"]), rtol=1e-5)
+
+    # Masked positions don't contribute; per_token normalizes by real count.
+    mask = np.ones_like(tokens)
+    mask[:, 9:] = 0
+    lp_masked = np.asarray(sequence_logprob(model, params, tokens, mask=mask))
+    lp_short = np.asarray(sequence_logprob(model, params, tokens[:, :9]))
+    np.testing.assert_allclose(lp_masked, lp_short, rtol=1e-5)
+    per_tok = np.asarray(
+        sequence_logprob(model, params, tokens, mask=mask, per_token=True)
+    )
+    np.testing.assert_allclose(per_tok, lp_masked / 8.0, rtol=1e-6)

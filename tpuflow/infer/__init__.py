@@ -3,5 +3,12 @@ plus autoregressive KV-cache generation for the LM family."""
 
 from tpuflow.infer.engine import BatchPredictor, map_batches
 from tpuflow.infer.generate import generate, render_tokens
+from tpuflow.infer.score import sequence_logprob
 
-__all__ = ["BatchPredictor", "generate", "map_batches", "render_tokens"]
+__all__ = [
+    "BatchPredictor",
+    "generate",
+    "map_batches",
+    "render_tokens",
+    "sequence_logprob",
+]

@@ -1,0 +1,53 @@
+"""Per-sequence log-likelihood scoring for the LM family.
+
+The third leg of LM inference next to batch classification and sampling:
+``sequence_logprob`` returns each sequence's total (or mean) token
+log-likelihood under the model — the primitive behind reranking,
+best-of-n selection, and data filtering. One jitted forward per batch;
+works on padded batches via a token mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(0,), static_argnames=("per_token",))
+def _score_jit(model, params, tokens, mask, *, per_token: bool):
+    logits = model.apply({"params": params}, tokens[:, :-1])
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    targets = tokens[:, 1:]
+    picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    m = mask[:, 1:].astype(picked.dtype)
+    total = jnp.sum(picked * m, axis=-1)
+    if per_token:
+        return total / jnp.maximum(jnp.sum(m, axis=-1), 1.0)
+    return total
+
+
+def sequence_logprob(model, params, tokens, *, mask=None, per_token=False):
+    """log p(tokens[:, 1:] | prefixes) per sequence.
+
+    ``tokens``: (B, T) int32. ``mask``: optional (B, T) {0,1} — position i
+    contributes iff ``mask[i] == 1``. The mask gates CONTRIBUTIONS only,
+    not attention: masked tokens still sit in the causal context, so it is
+    exact for RIGHT-padded batches (trailing pad never precedes a scored
+    token — pinned by test) but NOT for left-padded or interior-masked
+    sequences; right-align ragged batches before scoring. The first token
+    never contributes (it is only conditioned on). ``per_token=True``
+    returns the mean instead of the sum (length-normalized scores for
+    comparing sequences of different lengths). Returns (B,) float32.
+    """
+    tokens = jnp.asarray(tokens, jnp.int32)
+    if mask is None:
+        mask = jnp.ones(tokens.shape, jnp.float32)
+    else:
+        mask = jnp.asarray(mask, jnp.float32)
+        if mask.shape != tokens.shape:
+            raise ValueError(
+                f"mask shape {mask.shape} != tokens shape {tokens.shape}"
+            )
+    return _score_jit(model, params, tokens, mask, per_token=per_token)
