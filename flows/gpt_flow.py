@@ -107,6 +107,15 @@ class TpuGptTrain(FlowSpec):
     fsdp_axis = Parameter("fsdp_axis", default=2, help="mesh 'fsdp' size")
     tensor_axis = Parameter("tensor_axis", default=1, help="mesh 'tensor' size")
     seq_axis = Parameter("seq_axis", default=1, help="mesh 'seq' size")
+    expert_axis = Parameter(
+        "expert_axis", default=1, help="mesh 'expert' size (expert parallel)"
+    )
+    experts = Parameter(
+        "experts",
+        default=0,
+        help="Switch-MoE experts per block (0 = dense MLP); shard over "
+        "--expert-axis",
+    )
     stage_axis = Parameter(
         "stage_axis", default=1, help="mesh 'stage' size (GPipe pipeline)"
     )
@@ -198,11 +207,13 @@ class TpuGptTrain(FlowSpec):
         # of depth) — the TPU-first defaults for real training.
         if self.preset == "medium":
             return GPT2Config.medium(
-                attn_impl=self.attn_impl, scan_layers=True, remat=True
+                attn_impl=self.attn_impl, scan_layers=True, remat=True,
+                n_experts=int(self.experts),
             )
         if self.preset == "gpt2":
             return GPT2Config(
-                attn_impl=self.attn_impl, scan_layers=True, remat=True
+                attn_impl=self.attn_impl, scan_layers=True, remat=True,
+                n_experts=int(self.experts),
             )
         return GPT2Config.small_test(
             attn_impl=self.attn_impl,
@@ -211,6 +222,7 @@ class TpuGptTrain(FlowSpec):
             # (one leading layer axis to shard over 'stage').
             scan_layers=self.stage_axis > 1,
             n_layer=max(2, self.stage_axis),
+            n_experts=int(self.experts),
         )
 
     @step
@@ -263,7 +275,7 @@ class TpuGptTrain(FlowSpec):
         if self.stage_axis > 1:
             # Pipeline composes with data parallelism only; the other axis
             # parameters (fsdp defaults to 2) don't apply to this mesh.
-            if self.tensor_axis > 1 or self.seq_axis > 1:
+            if self.tensor_axis > 1 or self.seq_axis > 1 or self.expert_axis > 1:
                 raise ValueError(
                     "pipeline (--stage-axis) composes with --data-axis only"
                 )
@@ -286,12 +298,18 @@ class TpuGptTrain(FlowSpec):
             self._train_pipeline(cfg)
             self.next(self.end)
             return
+        if int(self.experts) and int(self.experts) % int(self.expert_axis):
+            raise ValueError(
+                f"--experts {self.experts} must be divisible by "
+                f"--expert-axis {self.expert_axis}"
+            )
         mesh = dist.make_mesh(
             {
                 "data": self.data_axis,
                 "fsdp": self.fsdp_axis,
                 "tensor": self.tensor_axis,
                 "seq": self.seq_axis,
+                "expert": self.expert_axis,
             }
         )
         print(f"[gpt_flow] mesh {dict(mesh.shape)}, preset {self.preset}")
@@ -308,7 +326,11 @@ class TpuGptTrain(FlowSpec):
                 mesh,
                 jax.random.PRNGKey(0),
                 fsdp=True,
-                tensor_rules=gpt2_tensor_rules if self.tensor_axis > 1 else None,
+                # The rules carry BOTH tensor and expert placements and
+                # self-gate on axis sizes.
+                tensor_rules=gpt2_tensor_rules
+                if self.tensor_axis > 1 or self.expert_axis > 1
+                else None,
             )
             mgr = CheckpointManager(
                 os.path.join(current.tpu_storage_path, "checkpoints"),

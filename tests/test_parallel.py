@@ -111,3 +111,41 @@ def test_make_shardings_respects_divisibility():
     sh = make_shardings(tree, mesh, fsdp=True)
     assert sh["odd"].spec == jax.sharding.PartitionSpec(None, None)
     assert any(s is not None for s in sh["big"].spec)
+
+
+def test_expert_weights_shard_over_expert_axis():
+    """gpt2_tensor_rules places MoE expert weights on the 'expert' mesh axis
+    (the flow passes the rules whenever --expert-axis > 1; regression for
+    the silently-replicated-experts bug)."""
+    import jax.numpy as jnp
+    import optax
+
+    from tpuflow import dist
+    from tpuflow.models.gpt2 import GPT2, GPT2Config
+    from tpuflow.parallel import (
+        create_sharded_state,
+        gpt2_tensor_rules,
+        has_sharded_leaf,
+    )
+    from tpuflow.train import TrainState
+
+    mesh = dist.make_mesh({"data": 2, "expert": 4})
+    cfg = GPT2Config.small_test(n_experts=4, dropout=0.0)
+    model = GPT2(cfg)
+
+    def init_fn(rng):
+        params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adamw(1e-3)
+        )
+
+    with mesh:
+        state, shardings = create_sharded_state(
+            init_fn,
+            mesh,
+            jax.random.PRNGKey(0),
+            fsdp=True,
+            tensor_rules=gpt2_tensor_rules,
+        )
+    assert has_sharded_leaf(shardings, axis="expert")
+    assert "expert" in str(state.params["h0"]["moe"]["w1"].sharding.spec)
