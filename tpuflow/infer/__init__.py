@@ -3,10 +3,11 @@ plus autoregressive KV-cache generation for the LM family."""
 
 from tpuflow.infer.engine import BatchPredictor, map_batches
 from tpuflow.infer.generate import generate, render_tokens
-from tpuflow.infer.score import sequence_logprob
+from tpuflow.infer.score import best_of_n, sequence_logprob
 
 __all__ = [
     "BatchPredictor",
+    "best_of_n",
     "generate",
     "map_batches",
     "render_tokens",

@@ -15,6 +15,65 @@ import jax
 import jax.numpy as jnp
 
 
+def best_of_n(
+    model,
+    params,
+    prompt,
+    *,
+    n: int,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng=None,
+    per_token: bool = True,
+):
+    """Sample ``n`` continuations per prompt row and return the one the
+    model itself scores highest.
+
+    The standard rerank loop composed from the two inference primitives:
+    ONE ``generate`` call over the (B*n)-row tiled prompt (each row draws
+    independently), one ``sequence_logprob`` pass scoring only the
+    continuation tokens (the prompt conditions but is masked out of the
+    score — leading real context, so the mask semantics are exact), then an
+    argmax per original row. Returns ``(tokens (B, max_new_tokens),
+    logprob (B,))``. ``per_token=True`` compares length-normalized scores.
+    Plain sampling only: there is no eos/pad handling here — every
+    continuation token is scored (fixed-length candidates).
+    """
+    from tpuflow.infer.generate import generate
+
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    tiled = jnp.repeat(prompt, n, axis=0)
+    conts = generate(
+        model,
+        params,
+        tiled,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+        rng=rng,
+    )
+    full = jnp.concatenate([tiled, conts], axis=1)
+    mask = jnp.concatenate(
+        [
+            jnp.zeros((B * n, T), jnp.float32),
+            jnp.ones((B * n, max_new_tokens), jnp.float32),
+        ],
+        axis=1,
+    )
+    scores = sequence_logprob(
+        model, params, full, mask=mask, per_token=per_token
+    ).reshape(B, n)
+    best = jnp.argmax(scores, axis=-1)
+    picked = conts.reshape(B, n, max_new_tokens)[jnp.arange(B), best]
+    return picked, scores[jnp.arange(B), best]
+
+
 @functools.partial(jax.jit, static_argnums=(0,), static_argnames=("per_token",))
 def _score_jit(model, params, tokens, mask, *, per_token: bool):
     logits = model.apply({"params": params}, tokens[:, :-1])
