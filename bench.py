@@ -45,6 +45,72 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# On-TPU evidence ledger (committed to the repo): every bench leg that
+# actually executed on the TPU platform persists its record here the moment
+# it succeeds, so a tunnel that is healthy mid-round but dead at round-end
+# snapshot time no longer erases all hardware validation. When the chip is
+# down, main() merges the last-good record into the bench output annotated
+# "cached": true with its capture provenance.
+TPU_EVIDENCE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "TPU_EVIDENCE.json"
+)
+
+
+# Legs captured by THIS process (fresh, not cached) — lets main() avoid
+# labeling evidence measured moments ago as stale.
+_FRESH_LEGS: set[str] = set()
+
+
+def _evidence_read() -> dict | None:
+    try:
+        with open(TPU_EVIDENCE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _evidence_merge(updates: dict) -> None:
+    """Merge leg records into TPU_EVIDENCE.json, provenance stamped per leg.
+
+    Provenance lives inside each leg record (not file-global) so a later
+    partial capture — e.g. a device-ckpt-only rerun — cannot re-stamp legs
+    it didn't measure. The read-modify-write is serialized under an fcntl
+    lock: the opportunistic watcher (tools/tpu_watch.py) and a round-end
+    bench can run concurrently.
+    """
+    import subprocess
+
+    from tpuflow.utils.locking import FileLock
+
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    commit = None
+    try:
+        proc = subprocess.run(
+            ["git", "-C", os.path.dirname(TPU_EVIDENCE_PATH), "rev-parse",
+             "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            commit = proc.stdout.strip()
+    except Exception:
+        pass
+    with FileLock(TPU_EVIDENCE_PATH + ".lock"):
+        ev = _evidence_read() or {}
+        for leg, rec in updates.items():
+            if isinstance(rec, dict):
+                rec = {**rec, "recorded_at": stamp}
+                if commit:
+                    rec["git_commit"] = commit
+            ev[leg] = rec
+        tmp = f"{TPU_EVIDENCE_PATH}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(ev, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, TPU_EVIDENCE_PATH)
+    _FRESH_LEGS.update(updates)
+    _log(f"[bench] TPU evidence persisted: {sorted(updates)}")
+
+
 # bf16 peak FLOP/s per chip for MFU accounting, matched (in order) against
 # jax.devices()[0].device_kind — which reads like 'TPU v5 lite', not 'v5e'.
 _PEAK_FLOPS = (
@@ -149,6 +215,7 @@ def bench_train() -> dict | None:
         "model_tflops_per_s": round(flops_per_s / 1e12, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "compile_s": round(compile_s, 1),
+        "timed_steps": n_timed,
     }
     _log(f"[bench] train: {rec}")
     try:
@@ -354,9 +421,12 @@ def run_train_bench() -> dict | None:
             _log(f"[bench] train child failed rc={proc.returncode} (mode={mode})")
             continue
         try:
-            return json.loads(proc.stdout.strip().splitlines()[-1])
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
         except (ValueError, IndexError):
             continue
+        if isinstance(rec, dict) and rec.get("platform") == "tpu":
+            _evidence_merge({"train": rec})
+        return rec
     return None
 
 
@@ -468,17 +538,47 @@ def main() -> None:
     mgr2.close()
     shutil.rmtree(bench_dir, ignore_errors=True)
 
+    value = 2 * nbytes / (t_save + t_restore) / 1e9
+    if use_device and jax.default_backend() == "tpu":
+        _evidence_merge({
+            "ckpt_device": {
+                "platform": "tpu",
+                "payload_gib": round(nbytes / 2**30, 3),
+                "save_gbps": round(nbytes / t_save / 1e9, 4),
+                "restore_gbps": round(nbytes / t_restore / 1e9, 4),
+                "combined_gbps": round(value, 4),
+                "note": "device-path tier: shards staged through the TPU "
+                        "platform (dev boxes reach the chip via a network "
+                        "tunnel, so this bounds the tunnel, not HBM/DMA)",
+            }
+        })
+
     train = run_train_bench()
 
-    value = 2 * nbytes / (t_save + t_restore) / 1e9
     record = {
         "metric": "sharded_ckpt_save_restore_throughput",
         "value": round(value, 4),
         "unit": "GB/s",
         "vs_baseline": round(value / 2.0, 4),
     }
+    extra: dict = {}
     if train is not None:
-        record["extra"] = {"train": train}
+        extra["train"] = train
+    if not (isinstance(train, dict) and train.get("platform") == "tpu"):
+        # Chip unreachable (or leg degraded to CPU): surface the last good
+        # on-hardware records with provenance instead of reporting nothing.
+        # Legs measured by THIS run (e.g. a fresh device-ckpt capture whose
+        # sibling train leg degraded) are labeled fresh, not cached.
+        ev = _evidence_read()
+        if ev is not None:
+            extra["tpu_evidence"] = {
+                "cached": not _FRESH_LEGS,  # every leg predates this run
+                "cached_legs": sorted(k for k in ev if k not in _FRESH_LEGS),
+                "fresh_legs": sorted(k for k in ev if k in _FRESH_LEGS),
+                **ev,
+            }
+    if extra:
+        record["extra"] = extra
     print(json.dumps(record))
 
 

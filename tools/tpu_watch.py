@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Opportunistic on-TPU evidence capturer.
+
+The dev-box TPU is reached through a tunnel that flaps: it can be healthy
+for hours mid-round and dead at round-end snapshot time, which previously
+erased all hardware validation (the round-end bench is the only recorded
+run). This watcher closes that gap: it probes the default JAX platform on
+an interval and, on the first healthy TPU probe, fires the full bench
+suite (train steps/s + MFU, flash fwd/bwd vs XLA, KV-cache decode — via
+``bench.py``'s train child — plus the device-path checkpoint leg), which
+persists every TPU-platform record to ``TPU_EVIDENCE.json``; the watcher
+then commits the evidence and exits.
+
+Run it in the background for a whole working session:
+
+    python tools/tpu_watch.py >> tools/tpu_watch.log 2>&1 &
+
+Env knobs: TPU_WATCH_INTERVAL_S (probe cadence, default 600),
+TPU_WATCH_MAX_S (give up after, default 11h),
+TPU_WATCH_PROBE_TIMEOUT_S (per-probe hang bound, default 90).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE.json")
+
+
+def _clean_env(extra: dict[str, str] | None = None) -> dict[str, str]:
+    """Child env with every platform pin / stale probe verdict removed."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "TPUFLOW_PLATFORM_PROBED",
+                     "TPUFLOW_PLATFORM_BACKEND", "TPUFLOW_FORCE_CPU")
+    }
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _drop_probe_cache() -> None:
+    home = os.environ.get(
+        "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
+    )
+    try:
+        os.remove(os.path.join(home, "platform_probe.json"))
+    except OSError:
+        pass
+
+
+def probe(timeout_s: float) -> str | None:
+    """Backend name of the default platform, or None if init fails/hangs."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            timeout=timeout_s, capture_output=True, text=True,
+            env=_clean_env(),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if p.returncode != 0:
+        return None
+    out = p.stdout.strip().splitlines()
+    return out[-1] if out else None
+
+
+def run_bench(extra_env: dict[str, str], timeout_s: float = 3600) -> bool:
+    _drop_probe_cache()
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=_clean_env(extra_env), timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("[tpu_watch] bench timed out", flush=True)
+        return False
+    tail = "\n".join(p.stderr.splitlines()[-25:])
+    print(f"[tpu_watch] bench rc={p.returncode}\n{tail}", flush=True)
+    return p.returncode == 0
+
+
+def evidence_has_tpu_train() -> bool:
+    try:
+        with open(EVIDENCE) as f:
+            return json.load(f).get("train", {}).get("platform") == "tpu"
+    except (OSError, ValueError):
+        return False
+
+
+def main() -> int:
+    interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", "600"))
+    probe_timeout = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "90"))
+    deadline = time.time() + float(
+        os.environ.get("TPU_WATCH_MAX_S", str(11 * 3600))
+    )
+    while time.time() < deadline:
+        stamp = time.strftime("%H:%M:%S")
+        backend = probe(probe_timeout)
+        if backend != "tpu":
+            print(f"[tpu_watch {stamp}] probe: {backend!r} — chip not "
+                  f"reachable; retry in {interval:.0f}s", flush=True)
+            time.sleep(interval)
+            continue
+        print(f"[tpu_watch {stamp}] TPU healthy — firing bench suite",
+              flush=True)
+        # Full suite: host-tier ckpt + TPU train/flash/decode legs. A longer
+        # train-child timeout than the round-end default: this run is the
+        # evidence capture, so give slow tunnel compiles room.
+        run_bench({"TPUFLOW_BENCH_TRAIN_TIMEOUT": "900"})
+        if not evidence_has_tpu_train():
+            print("[tpu_watch] bench ran but produced no TPU train record; "
+                  "will keep probing", flush=True)
+            time.sleep(interval)
+            continue
+        # Device-path checkpoint tier (small payload: the tunnel moves
+        # ~0.01 GB/s, this leg documents that path rather than racing it).
+        run_bench({
+            "TPUFLOW_BENCH_DEVICE": "1",
+            "TPUFLOW_BENCH_TRAIN": "0",
+            "TPUFLOW_BENCH_GB": "0.125",
+            "TPUFLOW_BENCH_DEVICES": "1",
+        }, timeout_s=1800)
+        # add makes the (possibly untracked) file known to git; the
+        # pathspec'd commit then includes ONLY it — never files another
+        # process staged mid-work.
+        subprocess.run(["git", "-C", REPO, "add", "TPU_EVIDENCE.json"])
+        subprocess.run([
+            "git", "-C", REPO, "commit", "-m",
+            "Record on-TPU bench evidence (train+MFU, flash kernels, decode, "
+            "device ckpt tier)",
+            "-m", "No-Verification-Needed: benchmark data capture only",
+            "--", "TPU_EVIDENCE.json",
+        ])
+        print("[tpu_watch] evidence committed; exiting", flush=True)
+        return 0
+    print("[tpu_watch] deadline reached without a healthy TPU window",
+          flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
