@@ -127,6 +127,11 @@ def main() -> int:
             "TPUFLOW_BENCH_TRAIN": "0",
             "TPUFLOW_BENCH_GB": "0.125",
             "TPUFLOW_BENCH_DEVICES": "1",
+            # Device-path capture only: skip the disk tier (whose cold
+            # restore drops the machine's page cache) and the 3.4 GiB
+            # overlap leg — both already measured by the main suite run.
+            "TPUFLOW_BENCH_DISK": "0",
+            "TPUFLOW_BENCH_OVERLAP": "0",
         }, timeout_s=1800)
         # add makes the (possibly untracked) file known to git; the
         # pathspec'd commit then includes ONLY it — never files another
