@@ -285,3 +285,160 @@ def test_best_of_n_picks_the_highest_scoring_sample():
             np.asarray(picked)[b], conts.reshape(2, 3, 6)[b, k]
         )
         assert float(score[b]) == pytest.approx(float(scores[b, k]), rel=1e-6)
+
+
+def test_ragged_prompts_decode_token_exact_vs_per_row():
+    """LEFT-padded mixed-length prompt batch (prompt_lens) must greedy-decode
+    exactly what each row produces in its own dense single-row call — the
+    per-row position shift and pad key masking have to be exact for this to
+    hold (VERDICT r2 #5; parity bar: ragged rows in the reference engine,
+    eval_flow.py:85-90)."""
+    from tpuflow.infer import pad_ragged
+
+    model, params = _model()
+    prompts = [
+        list(range(5, 12)),          # len 7
+        [3, 4, 5],                   # len 3
+        [100, 200, 300, 400, 17],    # len 5
+        [511],                       # len 1
+    ]
+    padded, lens = pad_ragged(prompts, pad_id=0)
+    assert padded.shape == (4, 7)
+    got = np.asarray(
+        generate(
+            model, params, padded, prompt_lens=lens, max_new_tokens=6,
+            temperature=0.0,
+        )
+    )
+    for i, p in enumerate(prompts):
+        dense = np.asarray(
+            generate(
+                model,
+                params,
+                np.asarray([p], np.int32),
+                max_new_tokens=6,
+                temperature=0.0,
+            )
+        )
+        np.testing.assert_array_equal(got[i], dense[0])
+
+
+def test_ragged_prompts_scan_layers_and_eos():
+    """Ragged decoding composes with scan_layers, and eos freezing applies
+    per row on a ragged batch."""
+    from tpuflow.infer import pad_ragged
+
+    model, params = _model(scan_layers=True)
+    prompts = [[5, 6, 7, 8], [9, 10]]
+    padded, lens = pad_ragged(prompts, pad_id=0)
+    got = np.asarray(
+        generate(
+            model, params, padded, prompt_lens=lens, max_new_tokens=5,
+            temperature=0.0,
+        )
+    )
+    for i, p in enumerate(prompts):
+        dense = np.asarray(
+            generate(
+                model, params, np.asarray([p], np.int32), max_new_tokens=5,
+                temperature=0.0,
+            )
+        )
+        np.testing.assert_array_equal(got[i], dense[0])
+
+    # EOS: declare row 0's first greedy token as eos — the row emits it,
+    # then freezes to pad_id; row 1 is unaffected.
+    eos = int(got[0, 0])
+    if eos != int(got[1, 0]):  # only meaningful when rows diverge
+        out = np.asarray(
+            generate(
+                model, params, padded, prompt_lens=lens, max_new_tokens=5,
+                temperature=0.0, eos_id=eos, pad_id=0,
+            )
+        )
+        assert out[0, 0] == eos and (out[0, 1:] == 0).all()
+        np.testing.assert_array_equal(out[1], got[1])
+
+
+def test_chunked_prefill_matches_single_prefill():
+    """Multi-token decode calls on a WARM cache (start > 0) are exact: two
+    chunked prefill calls produce the same logits as one full prefill
+    (ADVICE r2 #3 — previously a documented-but-unenforced wrong-answer
+    contract; now routed through masked cache attention via lax.cond)."""
+    model, params = _model()
+    toks = jnp.asarray([[5, 6, 7, 8, 9, 10, 11, 12]], jnp.int32)
+    full, _ = model.apply(
+        {"params": params}, toks, decode=True, mutable=["cache"]
+    )
+    _, v1 = model.apply(
+        {"params": params}, toks[:, :5], decode=True, mutable=["cache"]
+    )
+    tail, _ = model.apply(
+        {"params": params, "cache": v1["cache"]},
+        toks[:, 5:],
+        decode=True,
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(full[:, 5:]), np.asarray(tail), atol=1e-5
+    )
+
+
+def test_sequence_logprob_left_padded_matches_per_row():
+    """pad_lens makes left-padded scoring token-exact vs per-row dense
+    scoring (the attention/position machinery, not just the mask)."""
+    from tpuflow.infer import pad_ragged, sequence_logprob
+
+    model, params = _model()
+    rows = [list(range(7, 19)), [3, 4, 5, 6, 7]]
+    padded, lens = pad_ragged(rows, pad_id=0)
+    lp = np.asarray(
+        sequence_logprob(model, params, padded, prompt_lens=lens)
+    )
+    for i, r in enumerate(rows):
+        dense = np.asarray(
+            sequence_logprob(model, params, np.asarray([r], np.int32))
+        )
+        np.testing.assert_allclose(lp[i], dense[0], rtol=1e-5)
+
+
+def test_best_of_n_eos_aware_scoring():
+    """With eos_id set, candidates are scored up to AND INCLUDING their
+    first eos; the frozen pad tail contributes nothing — verified by
+    recomputing the masked scores by hand."""
+    from tpuflow.infer import best_of_n, generate as _gen, sequence_logprob
+
+    model, params = _model()
+    prompt = np.arange(2 * 4, dtype=np.int32).reshape(2, 4) % 512
+    rng = jax.random.PRNGKey(3)
+    # Pick an eos id that actually occurs early in some sampled row so the
+    # mask matters: sample once and use the most common first token.
+    probe = np.asarray(
+        _gen(model, params, np.repeat(prompt, 3, axis=0), max_new_tokens=7,
+             temperature=1.0, rng=rng)
+    )
+    eos = int(np.bincount(probe[:, 0]).argmax())
+    picked, score = best_of_n(
+        model, params, prompt, n=3, max_new_tokens=7, temperature=1.0,
+        rng=rng, eos_id=eos, pad_id=0,
+    )
+    conts = np.asarray(
+        _gen(model, params, np.repeat(prompt, 3, axis=0), max_new_tokens=7,
+             temperature=1.0, rng=rng, eos_id=eos, pad_id=0)
+    )
+    full = np.concatenate([np.repeat(prompt, 3, axis=0), conts], axis=1)
+    is_eos = (conts == eos).astype(np.int64)
+    strictly_before = (np.cumsum(is_eos, axis=1) - is_eos) > 0
+    mask = np.concatenate(
+        [np.zeros((6, 4), np.float32), (~strictly_before).astype(np.float32)],
+        axis=1,
+    )
+    scores = np.asarray(
+        sequence_logprob(model, params, full, mask=mask, per_token=True)
+    ).reshape(2, 3)
+    for b in range(2):
+        k = int(scores[b].argmax())
+        np.testing.assert_array_equal(
+            np.asarray(picked)[b], conts.reshape(2, 3, 7)[b, k]
+        )
+        assert float(score[b]) == pytest.approx(float(scores[b, k]), rel=1e-5)

@@ -2,7 +2,7 @@
 plus autoregressive KV-cache generation for the LM family."""
 
 from tpuflow.infer.engine import BatchPredictor, map_batches
-from tpuflow.infer.generate import generate, render_tokens
+from tpuflow.infer.generate import generate, pad_ragged, render_tokens
 from tpuflow.infer.score import best_of_n, sequence_logprob
 
 __all__ = [
@@ -10,6 +10,7 @@ __all__ = [
     "best_of_n",
     "generate",
     "map_batches",
+    "pad_ragged",
     "render_tokens",
     "sequence_logprob",
 ]

@@ -69,7 +69,7 @@ def _sample(
     jax.jit,
     static_argnums=(0,),
     static_argnames=(
-        "max_new_tokens", "greedy", "top_k", "use_top_p", "eos_id", "pad_id"
+        "max_new_tokens", "greedy", "top_k", "use_top_p", "eos_id", "pad_id",
     ),
 )
 def _generate_jit(
@@ -79,6 +79,7 @@ def _generate_jit(
     rng,
     temperature,
     top_p,
+    pad_lens=None,
     *,
     max_new_tokens: int,
     greedy: bool,
@@ -87,14 +88,20 @@ def _generate_jit(
     eos_id: int | None,
     pad_id: int,
 ):
+    # pad_lens None-vs-array is itself a jit specialization boundary (pytree
+    # structure), so dense batches compile the fast T x T prefill path.
     B, T = prompt.shape
 
     # Prefill: one pass over the prompt initializes + fills the caches.
     logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"]
+        {"params": params}, prompt, decode=True, mutable=["cache"],
+        pad_lens=pad_lens,
     )
     cache = vars_out["cache"]
     rng, sub = jax.random.split(rng)
+    # Left-padding puts every row's last REAL token in the last column, so
+    # logits[:, -1] is the right next-token distribution for dense and
+    # ragged batches alike.
     tok = _sample(
         logits[:, -1, :], sub, temperature, top_p,
         greedy=greedy, top_k=top_k, use_top_p=use_top_p,
@@ -112,6 +119,7 @@ def _generate_jit(
             tok[:, None],
             decode=True,
             mutable=["cache"],
+            pad_lens=pad_lens,
         )
         rng, sub = jax.random.split(rng)
         sampled = _sample(
@@ -144,6 +152,28 @@ def render_tokens(ids, *, byte_level: bool = False) -> str:
     return " ".join(str(t) for t in ids)
 
 
+def pad_ragged(prompts, *, pad_id: int = 0):
+    """LEFT-pad a list of variable-length token sequences to one (B, Tmax)
+    int32 array. Returns ``(prompt, prompt_lens)`` — pass both to
+    ``generate(..., prompt_lens=...)`` or ``sequence_logprob(...,
+    prompt_lens=...)``. Left-padding keeps every row's last real token in
+    the final column, which is what the single uniform decode loop needs
+    (no per-row gather at the prompt boundary)."""
+    import numpy as np
+
+    seqs = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+    if not seqs:
+        raise ValueError("prompts is empty")
+    lens = np.array([len(s) for s in seqs], np.int32)
+    if (lens == 0).any():
+        raise ValueError("every prompt must have at least one token")
+    T = int(lens.max())
+    out = np.full((len(seqs), T), pad_id, np.int32)
+    for i, s in enumerate(seqs):
+        out[i, T - len(s):] = s
+    return out, lens
+
+
 def generate(
     model,
     params,
@@ -156,17 +186,22 @@ def generate(
     eos_id: int | None = None,
     pad_id: int = 0,
     rng=None,
+    prompt_lens=None,
 ):
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, T) int32.
 
-    Returns (B, max_new_tokens) int32. The prompt must be dense (one length
-    per batch; left-align ragged prompts to their common prefix or pad+mask
-    upstream) and ``T + max_new_tokens`` must fit the model's ``n_ctx``
-    (the fixed cache size). ``temperature=0`` is greedy decoding; any other
-    temperature is a traced operand (sweeping it reuses the compiled
-    program); ``top_k`` and ``top_p`` nucleus filtering compose (top-k
-    first). With ``eos_id`` set, the eos token itself is emitted and the
-    row's remaining positions are frozen to ``pad_id``.
+    Returns (B, max_new_tokens) int32. ``T + max_new_tokens`` must fit the
+    model's ``n_ctx`` (the fixed cache size). ``temperature=0`` is greedy
+    decoding; any other temperature is a traced operand (sweeping it reuses
+    the compiled program); ``top_k`` and ``top_p`` nucleus filtering compose
+    (top-k first). With ``eos_id`` set, the eos token itself is emitted and
+    the row's remaining positions are frozen to ``pad_id``.
+
+    Ragged batches: pass ``prompt_lens`` (B,) with a LEFT-padded ``prompt``
+    (see ``pad_ragged``) — pad columns are masked out of attention and
+    positions are row-shifted, so mixed-length batches decode token-exactly
+    vs per-row dense calls (parity bar: the reference's engine takes ragged
+    rows, reference eval_flow.py:85-90).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
@@ -183,6 +218,21 @@ def generate(
             f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
             f"the model's n_ctx={n_ctx} (the KV cache size)"
         )
+    pad_lens = None
+    if prompt_lens is not None:
+        import numpy as np
+
+        lens = np.asarray(prompt_lens, np.int32)
+        if lens.shape != (B,):
+            raise ValueError(
+                f"prompt_lens shape {lens.shape} != (batch,) = ({B},)"
+            )
+        if (lens < 1).any() or (lens > T).any():
+            raise ValueError(
+                f"prompt_lens must be in [1, {T}], got "
+                f"[{lens.min()}, {lens.max()}]"
+            )
+        pad_lens = jnp.asarray(T - lens, jnp.int32)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(
@@ -192,6 +242,7 @@ def generate(
         rng,
         jnp.asarray(temperature, jnp.float32),
         jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        pad_lens,
         max_new_tokens=max_new_tokens,
         greedy=temperature == 0.0,
         top_k=top_k,

@@ -27,6 +27,9 @@ def best_of_n(
     top_p: float | None = None,
     rng=None,
     per_token: bool = True,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    prompt_lens=None,
 ):
     """Sample ``n`` continuations per prompt row and return the one the
     model itself scores highest.
@@ -35,11 +38,18 @@ def best_of_n(
     ONE ``generate`` call over the (B*n)-row tiled prompt (each row draws
     independently), one ``sequence_logprob`` pass scoring only the
     continuation tokens (the prompt conditions but is masked out of the
-    score — leading real context, so the mask semantics are exact), then an
-    argmax per original row. Returns ``(tokens (B, max_new_tokens),
-    logprob (B,))``. ``per_token=True`` compares length-normalized scores.
-    Plain sampling only: there is no eos/pad handling here — every
-    continuation token is scored (fixed-length candidates).
+    score), then an argmax per original row. Returns ``(tokens (B,
+    max_new_tokens), logprob (B,))``. ``per_token=True`` compares
+    length-normalized scores.
+
+    With ``eos_id`` set, candidates are variable-length: generation freezes
+    a row to ``pad_id`` after its eos, and scoring counts each candidate's
+    tokens up to AND INCLUDING its eos — trailing pad contributes nothing,
+    so a short confident answer competes fairly against a long one under
+    ``per_token``. Ragged prompts ride ``prompt_lens`` (LEFT-padded batch,
+    see ``generate``/``pad_ragged``); both the sampling and the scoring
+    pass then mask the pad columns, keeping mixed-length reranking
+    token-exact vs per-row calls.
     """
     from tpuflow.infer.generate import generate
 
@@ -48,6 +58,13 @@ def best_of_n(
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
     tiled = jnp.repeat(prompt, n, axis=0)
+    tiled_lens = None
+    pad_lens_full = None
+    if prompt_lens is not None:
+        import numpy as np
+
+        tiled_lens = np.repeat(np.asarray(prompt_lens, np.int32), n, axis=0)
+        pad_lens_full = jnp.asarray(T - tiled_lens, jnp.int32)
     conts = generate(
         model,
         params,
@@ -57,17 +74,23 @@ def best_of_n(
         top_k=top_k,
         top_p=top_p,
         rng=rng,
+        eos_id=eos_id,
+        pad_id=pad_id,
+        prompt_lens=tiled_lens,
     )
     full = jnp.concatenate([tiled, conts], axis=1)
+    cont_mask = jnp.ones((B * n, max_new_tokens), jnp.float32)
+    if eos_id is not None:
+        # Score through the first eos (inclusive); freeze-padded tail out.
+        is_eos = (conts == eos_id).astype(jnp.int32)
+        eos_strictly_before = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+        cont_mask = jnp.where(eos_strictly_before, 0.0, cont_mask)
     mask = jnp.concatenate(
-        [
-            jnp.zeros((B * n, T), jnp.float32),
-            jnp.ones((B * n, max_new_tokens), jnp.float32),
-        ],
-        axis=1,
+        [jnp.zeros((B * n, T), jnp.float32), cont_mask], axis=1
     )
     scores = sequence_logprob(
-        model, params, full, mask=mask, per_token=per_token
+        model, params, full, mask=mask, per_token=per_token,
+        pad_lens=pad_lens_full,
     ).reshape(B, n)
     best = jnp.argmax(scores, axis=-1)
     picked = conts.reshape(B, n, max_new_tokens)[jnp.arange(B), best]
@@ -75,8 +98,10 @@ def best_of_n(
 
 
 @functools.partial(jax.jit, static_argnums=(0,), static_argnames=("per_token",))
-def _score_jit(model, params, tokens, mask, *, per_token: bool):
-    logits = model.apply({"params": params}, tokens[:, :-1])
+def _score_jit(model, params, tokens, mask, pad_lens=None, *, per_token: bool):
+    logits = model.apply(
+        {"params": params}, tokens[:, :-1], pad_lens=pad_lens
+    )
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     targets = tokens[:, 1:]
     picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -87,26 +112,70 @@ def _score_jit(model, params, tokens, mask, *, per_token: bool):
     return total
 
 
-def sequence_logprob(model, params, tokens, *, mask=None, per_token=False):
+def sequence_logprob(
+    model, params, tokens, *, mask=None, per_token=False, pad_lens=None,
+    prompt_lens=None,
+):
     """log p(tokens[:, 1:] | prefixes) per sequence.
 
     ``tokens``: (B, T) int32. ``mask``: optional (B, T) {0,1} — position i
     contributes iff ``mask[i] == 1``. The mask gates CONTRIBUTIONS only,
-    not attention: masked tokens still sit in the causal context, so it is
-    exact for RIGHT-padded batches (trailing pad never precedes a scored
-    token — pinned by test) but NOT for left-padded or interior-masked
-    sequences; right-align ragged batches before scoring. The first token
-    never contributes (it is only conditioned on). ``per_token=True``
-    returns the mean instead of the sum (length-normalized scores for
-    comparing sequences of different lengths). Returns (B,) float32.
+    not attention: masked tokens still sit in the causal context, so on its
+    own it is exact for RIGHT-padded batches (trailing pad never precedes a
+    scored token — pinned by test) but not for left-padded sequences. For
+    LEFT-padded batches pass ``prompt_lens`` (B,) real lengths — the
+    ``pad_ragged`` convention, matching ``generate`` — or equivalently
+    ``pad_lens`` (B,) pad counts (``T - prompt_lens``); the model then
+    masks pad columns out of attention and shifts positions per row
+    (models.gpt2), making mixed-length scoring token-exact vs per-row dense
+    calls. The first (real) token never contributes (it is only conditioned
+    on). ``per_token=True`` returns the mean instead of the sum
+    (length-normalized scores for comparing sequences of different
+    lengths). Returns (B,) float32.
     """
     tokens = jnp.asarray(tokens, jnp.int32)
+    T = tokens.shape[1]
+    if prompt_lens is not None:
+        if pad_lens is not None:
+            raise ValueError("pass prompt_lens or pad_lens, not both")
+        import numpy as np
+
+        lens = np.asarray(prompt_lens, np.int32)
+        if (lens < 1).any() or (lens > T).any():
+            raise ValueError(
+                f"prompt_lens must be in [1, {T}], got "
+                f"[{lens.min()}, {lens.max()}]"
+            )
+        pad_lens = T - lens
+    elif pad_lens is not None:
+        import numpy as np
+
+        pl = np.asarray(pad_lens, np.int32)
+        if (pl < 0).any() or (pl >= T).any():
+            raise ValueError(
+                f"pad_lens must be in [0, {T - 1}], got "
+                f"[{pl.min()}, {pl.max()}]"
+            )
     if mask is None:
-        mask = jnp.ones(tokens.shape, jnp.float32)
+        if pad_lens is not None:
+            # Default for left-padded rows: score real positions only,
+            # EXCLUDING each row's first real token — like column 0 of a
+            # dense batch, it is conditioned on, never predicted (its
+            # would-be predictor is the last pad column).
+            mask = (
+                jnp.arange(tokens.shape[1])[None, :]
+                > jnp.asarray(pad_lens, jnp.int32)[:, None]
+            ).astype(jnp.float32)
+        else:
+            mask = jnp.ones(tokens.shape, jnp.float32)
     else:
         mask = jnp.asarray(mask, jnp.float32)
         if mask.shape != tokens.shape:
             raise ValueError(
                 f"mask shape {mask.shape} != tokens shape {tokens.shape}"
             )
-    return _score_jit(model, params, tokens, mask, per_token=per_token)
+    if pad_lens is not None:
+        pad_lens = jnp.asarray(pad_lens, jnp.int32)
+    return _score_jit(
+        model, params, tokens, mask, pad_lens, per_token=per_token
+    )

@@ -67,6 +67,36 @@ class GPT2Config:
         return cls(**kw)
 
 
+def _masked_attention(q, k, v, valid):
+    """Masked softmax attention, float32 statistics (bf16-safe), static
+    shapes. ``valid`` broadcasts against the (B, H, Tq, Tk) score matrix.
+    Fully-masked query rows (a left-pad column whose every key is invalid)
+    degrade to a uniform softmax over the -1e30 constants — finite garbage
+    that no real query ever attends to, so it stays isolated."""
+    import jax
+
+    D = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def _left_pad_attention(q, k, v, pad_lens):
+    """Causal attention over a LEFT-padded (B, T, H, D) batch: key columns
+    ``< pad_lens[b]`` are masked out of row b."""
+    T = q.shape[1]
+    pos = jnp.arange(T)
+    valid = (pos[None, :] <= pos[:, None])[None, None]  # causal (T, T)
+    valid = valid & (pos[None, None, None, :] >= pad_lens[:, None, None, None])
+    return _masked_attention(q, k, v, valid)
+
+
 class Block(nn.Module):
     """Pre-LN transformer block: LN → MHA → residual, LN → MLP → residual.
 
@@ -84,7 +114,7 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, train: bool, decode: bool = False):
+    def __call__(self, x, train: bool, decode: bool = False, pad_lens=None):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -96,7 +126,13 @@ class Block(nn.Module):
         k = k.reshape(B, T, cfg.n_head, head_dim)
         v = v.reshape(B, T, cfg.n_head, head_dim)
         if decode:
-            a = self._cached_attention(q, k, v)
+            a = self._cached_attention(q, k, v, pad_lens)
+        elif pad_lens is not None:
+            # Ragged (LEFT-padded) batch without a cache — the scoring path:
+            # pad columns are masked out of every key set and real positions
+            # are row-shifted, so a padded forward is token-exact vs a dense
+            # per-row forward (tpuflow.infer.score on mixed-length batches).
+            a = _left_pad_attention(q, k, v, pad_lens)
         else:
             a = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         a = a.reshape(B, T, cfg.n_embd)
@@ -124,7 +160,7 @@ class Block(nn.Module):
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
-    def _cached_attention(self, q, k, v):
+    def _cached_attention(self, q, k, v, pad_lens=None):
         """Fixed-size KV-cache attention (decode mode).
 
         Writes the new k/v at ``cache_index`` and attends q over the whole
@@ -132,6 +168,17 @@ class Block(nn.Module):
         ride ``lax.dynamic_update_slice`` (no data-dependent shapes), and
         the O(n_ctx) masked attention is the HBM-bandwidth-optimal form for
         single-token decode on TPU (a (1, n_ctx) GEMV per head on the MXU).
+
+        ``pad_lens`` (B,) marks rows as LEFT-padded: cache columns
+        ``< pad_lens[b]`` are invisible to every query of row b (ragged
+        prompt batches; tpuflow.infer.generate ``prompt_lens``).
+
+        Multi-token calls: a fresh-cache prefill (``start == 0``, no pads)
+        takes the T x T fast path through the pluggable attention dispatch;
+        any other multi-token call — chunked prefill at ``start > 0``, or a
+        padded prefill — runs masked attention over the whole cache, which
+        is exact for every (start, pad) combination (``lax.cond`` picks the
+        branch at runtime, so both compile into the one program).
         """
         import jax
 
@@ -163,31 +210,33 @@ class Block(nn.Module):
         )
         idx.value = start + T
 
-        if T > 1:
-            # Prefill: a multi-token decode call is by contract the FIRST
-            # call on a fresh cache (start == 0; tpuflow.infer.generate),
-            # so attention over just the incoming tokens with a plain causal
-            # mask is exact — and runs through the pluggable impl dispatch
-            # (T x T flash/xla) instead of softmaxing over n_ctx - T masked
-            # zero keys. Chunked prefill (multi-token calls at start > 0)
-            # is not supported.
-            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        def cache_attention():
+            # Key position k is visible to query position start+t iff
+            # k <= start+t (and, for left-padded rows, k >= pad_lens[b]).
+            q_pos = start + jnp.arange(T)[:, None]
+            k_pos = jnp.arange(cfg.n_ctx)[None, :]
+            valid = (k_pos <= q_pos)[None, None]
+            if pad_lens is not None:
+                valid = valid & (
+                    k_pos[None, None] >= pad_lens[:, None, None, None]
+                )
+            return _masked_attention(q, ck.value, cv.value, valid)
 
-        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk",
-            q.astype(jnp.float32),
-            ck.value.astype(jnp.float32),
-        ) * scale
-        # Key position k is visible to query position start+t iff k <= start+t.
-        q_pos = start + jnp.arange(T)[:, None]
-        k_pos = jnp.arange(cfg.n_ctx)[None, :]
-        s = jnp.where(k_pos <= q_pos, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum(
-            "bhqk,bkhd->bqhd", p, cv.value.astype(jnp.float32)
-        )
-        return out.astype(q.dtype)
+        if T > 1:
+            # Fresh-cache prefill (start == 0) takes an exact T x T path —
+            # the pluggable dispatch when dense, the left-padded masked
+            # form when ragged — instead of softmaxing over n_ctx - T dead
+            # cache columns; warm-cache (chunked) prefill takes the general
+            # cache path. Runtime branch: start is traced.
+            fast = (
+                (lambda: attention(
+                    q, k, v, causal=True, impl=cfg.attn_impl
+                ).astype(q.dtype))
+                if pad_lens is None
+                else (lambda: _left_pad_attention(q, k, v, pad_lens))
+            )
+            return jax.lax.cond(start == 0, fast, cache_attention)
+        return cache_attention()
 
 
 class _ScanBlock(nn.Module):
@@ -196,8 +245,11 @@ class _ScanBlock(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, train: bool, decode: bool = False):
-        return Block(self.config, name="block")(x, train, decode), None
+    def __call__(self, x, train: bool, decode: bool = False, pad_lens=None):
+        return (
+            Block(self.config, name="block")(x, train, decode, pad_lens),
+            None,
+        )
 
 
 class GPT2(nn.Module):
@@ -206,9 +258,18 @@ class GPT2(nn.Module):
     config: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
+    def __call__(
+        self, tokens, *, train: bool = False, decode: bool = False,
+        pad_lens=None,
+    ):
+        """``pad_lens`` (B,) int32 marks LEFT-padded rows: row b's first
+        ``pad_lens[b]`` columns are padding — their positions clamp to 0,
+        and every attention masks them out of the key set (ragged prompt
+        generation / scoring; tpuflow.infer)."""
         cfg = self.config
         B, T = tokens.shape
+        if pad_lens is not None:
+            pad_lens = jnp.asarray(pad_lens, jnp.int32)
         wte = self.param(
             "wte",
             nn.initializers.normal(0.02),
@@ -232,9 +293,25 @@ class GPT2(nn.Module):
             )
             start = pos.value
             pos.value = start + T
-            pe = jax.lax.dynamic_slice(
-                wpe, (start, jnp.int32(0)), (T, cfg.n_embd)
+            if pad_lens is not None:
+                # Left-padded rows: real positions shift down by the row's
+                # pad count (clamped — pad columns read position 0, whose
+                # output real tokens never attend to).
+                positions = jnp.clip(
+                    start + jnp.arange(T)[None, :] - pad_lens[:, None],
+                    0,
+                    cfg.n_ctx - 1,
+                )
+                pe = wpe[positions]  # (B, T, C)
+            else:
+                pe = jax.lax.dynamic_slice(
+                    wpe, (start, jnp.int32(0)), (T, cfg.n_embd)
+                )
+        elif pad_lens is not None:
+            positions = jnp.clip(
+                jnp.arange(T)[None, :] - pad_lens[:, None], 0, cfg.n_ctx - 1
             )
+            pe = wpe[positions]
         else:
             pe = wpe[:T]
         x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
@@ -254,13 +331,13 @@ class GPT2(nn.Module):
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,
             )
-            x, _ = blocks(cfg, name="h")(x, train, decode)
+            x, _ = blocks(cfg, name="h")(x, train, decode, pad_lens)
         else:
             block_cls = (
                 nn.remat(Block, static_argnums=(2, 3)) if cfg.remat else Block
             )
             for i in range(cfg.n_layer):
-                x = block_cls(cfg, name=f"h{i}")(x, train, decode)
+                x = block_cls(cfg, name=f"h{i}")(x, train, decode, pad_lens)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_f")(x)
         # Weight-tied LM head; logits in float32 for a stable softmax/CE.
         return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(
