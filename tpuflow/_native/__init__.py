@@ -197,11 +197,17 @@ def read_bytes(
     ``nbytes``) — the restore arena passes pre-backed buffers here so the
     read is a single page-cache memcpy with no first-touch faulting."""
     if out is not None:
-        assert out.dtype == np.uint8 and out.nbytes == nbytes, (
-            out.dtype,
-            out.nbytes,
-            nbytes,
-        )
+        # Hard validation (not assert: under `python -O` a size-mismatched
+        # buffer would reach the native striped reader, which writes nbytes
+        # regardless — heap corruption instead of an exception).
+        if out.dtype != np.uint8 or out.nbytes != nbytes or not (
+            out.flags["C_CONTIGUOUS"]
+        ):
+            raise ValueError(
+                f"out must be a contiguous uint8 array of exactly {nbytes} "
+                f"bytes; got dtype={out.dtype}, nbytes={out.nbytes}, "
+                f"contiguous={out.flags['C_CONTIGUOUS']}"
+            )
     else:
         out = aligned_empty(nbytes)
     L = lib()

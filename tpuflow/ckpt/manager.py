@@ -199,6 +199,12 @@ class CheckpointManager:
         build, model compile) — and the first-touch page-backing cost of
         the restored state overlaps it on a background thread instead of
         serializing into the restore. No-op for Orbax-format steps.
+
+        Contract: one restore per prewarm. The arena is process-global and
+        restores serialize on a process-wide lock; a prewarm issued while
+        another restore is in flight may lose (some of) its backing work
+        to that restore's cleanup — the optimization silently degrades,
+        correctness is unaffected.
         """
         try:
             chosen = self._resolve_step(step, best)
@@ -469,6 +475,15 @@ class CheckpointManager:
             # callers that delete the run directory right after close().
             self._pool.cancel_prewarm()
         self._ckptr.close()
+        # Terminal arena reclamation: a prewarm_restore whose restore never
+        # ran (step errored, caller aborted) must not pin pre-backed pages
+        # for the process lifetime — restore_raw's own cleanup only drops
+        # LANDED buffers. Clearing the process-global arena here can at
+        # worst discard another manager's in-flight prewarm backing work
+        # (a lost optimization, never correctness).
+        from tpuflow.ckpt import raw as raw_fmt
+
+        raw_fmt._ARENA.clear()
 
     # --------------------------------------------------------------- restore
     def _resolve_step(self, step: int | None, best: bool) -> int:

@@ -385,6 +385,13 @@ class RestoreArena:
             stack = self._buffers.get(int(nbytes))
             return stack.pop() if stack else None
 
+    def drop_present(self) -> None:
+        """Drop buffers that have LANDED, without joining an in-flight
+        background prewarm — its still-unlanded buffers survive (they
+        belong to the next restore)."""
+        with self._lock:
+            self._buffers.clear()
+
     def clear(self) -> None:
         self.prewarm_wait()
         with self._lock:
@@ -392,6 +399,9 @@ class RestoreArena:
 
 
 _ARENA = RestoreArena()
+# Process-wide restore serialization (see restore_raw): the arena hand-off
+# and its end-of-restore cleanup are only safe one restore at a time.
+_RESTORE_LOCK = threading.RLock()
 
 
 def _path_names(path) -> list[str]:
@@ -906,17 +916,28 @@ def restore_raw(
       this one holds the arrays — use only for read-only consumers of runs
       this process owns or that are finished (batch eval, benches).
     """
-    try:
-        return _restore_raw_inner(
-            directory, abstract_state, subtree=subtree, zero_copy=zero_copy
-        )
-    finally:
-        # Reclaim prewarmed-but-unconsumed arena buffers: a restore that
-        # took a different path than its prewarm anticipated (template
-        # mismatch → assemble fallback, partial-subtree read, mmap) must
-        # not pin pre-backed pages for the process lifetime. One restore
-        # per prewarm is the contract; leftovers die with the restore.
-        _ARENA.clear()
+    # Restores serialize on a process-wide lock: the arena is process-global
+    # and its cleanup below would otherwise steal/drop the pre-backed
+    # buffers of a concurrent restore (threads, or a prewarm for restore B
+    # issued while restore A is in flight). Serialization preserves the
+    # one-restore-per-prewarm contract; a prewarm issued mid-restore can
+    # still lose (some of) its backing work to the cleanup — a lost
+    # optimization, never a correctness problem.
+    with _RESTORE_LOCK:
+        try:
+            return _restore_raw_inner(
+                directory, abstract_state, subtree=subtree, zero_copy=zero_copy
+            )
+        finally:
+            # Reclaim prewarmed-but-unconsumed arena buffers: a restore that
+            # took a different path than its prewarm anticipated (template
+            # mismatch → assemble fallback, partial-subtree read, mmap) must
+            # not pin pre-backed pages for the process lifetime. One restore
+            # per prewarm is the contract; leftovers die with the restore.
+            # drop_present (not clear): an in-flight background prewarm for
+            # the NEXT restore is not joined-and-discarded, so its
+            # still-unlanded buffers survive for that restore.
+            _ARENA.drop_present()
 
 
 def _restore_raw_inner(
