@@ -98,6 +98,24 @@ class TpuGptEval(FlowSpec):
                 f"training run used unknown dataset {dataset!r}; this eval "
                 "flow supports lm_synth and lm_text"
             )
+        text_path = None
+        if dataset == "lm_text":
+            # Pin the corpus to the training run's recorded source: load
+            # the SAME file training resolved, and refuse to score if its
+            # bytes changed — env/data-dir drift between the flows can't
+            # silently swap the held-out split (the flow's own
+            # no-silent-fallback stance, applied to itself).
+            from tpuflow.data.lm import check_text_source
+
+            try:
+                source = dict(run.data.text_source)
+            except AttributeError as e:
+                raise ValueError(
+                    "training run recorded no text_source artifact (run "
+                    "predates corpus pinning); re-train or score manually"
+                ) from e
+            check_text_source(source)
+            text_path = source["path"]
         print(f"[gpt_eval] evaluating {ckpt.path} ({mc})")
 
         cfg = GPT2Config(dropout=0.0, **mc)
@@ -128,6 +146,7 @@ class TpuGptEval(FlowSpec):
             seq_len=seq_len,
             vocab_size=cfg.vocab_size,
             synthetic_size=synthetic_size,
+            text_path=text_path,
         )
         loader = ShardedLoader(
             ds.test,

@@ -1,23 +1,18 @@
 """GPT-2 FSDP training flow — the fully-sharded acceptance config.
 
-Covers BASELINE.md config 5 ("GPT-2-medium FSDP → pjit fully-sharded
-checkpoint, multi-host v5e-32") with the framework's idioms: parameters and
-optimizer state born sharded over the ('fsdp','data') axes (optionally
-tensor-parallel over 'tensor', sequence-parallel ring attention over 'seq'),
-per-epoch async sharded checkpoints with retention, and full-state resume
-from ``--from-run``.
+A reference-sized shell (cf. reference train_flow.py, a ~100-line wrapper
+over its library stack): CLI parameters bind onto
+``tpuflow.train.GptTrainConfig`` and the recipes in ``tpuflow.train.gpt``
+do the work — FSDP (+ tensor/sequence/expert parallel) or GPipe pipeline
+training, per-epoch async sharded checkpoints with retention/best, EMA,
+full-state resume, held-out perplexity, post-train sampling.
 
 Run:    python flows/gpt_flow.py run --preset test --steps-per-epoch 8
 Medium: python flows/gpt_flow.py run --preset medium --data-axis 4 --fsdp-axis 8
 """
 
-import functools
-import math
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -30,72 +25,13 @@ from tpuflow.flow import (  # noqa: E402
     device_profile,
     retry,
     step,
+    training_curve_card,
 )
-
-def _lm_corpus_size(batch_size: int, steps: int) -> int:
-    """Docs in the lm_synth corpus for a run's parameters — ONE source of
-    truth shared by the loader and the ``synthetic_size_used`` artifact the
-    eval flow mirrors to see the identical test split."""
-    return max(batch_size * steps, batch_size)
-
-
-def _lm_loader(
-    batch_size: int, steps: int, seq_len: int, vocab: int,
-    dataset: str = "lm_synth",
-):
-    """Sharded LM loader from the data subsystem (D4/D16 for the GPT
-    family): yields {'x': tokens[:, :-1], 'y': tokens[:, 1:]} with the same
-    seeded per-epoch reshuffle semantics as the image loaders (set_epoch ↔
-    my_ray_module.py:149-151). 'lm_synth' is the deterministic stand-in;
-    'lm_text' trains byte-level on a local text file (drop a .txt into
-    $TPUFLOW_DATA_DIR or point TPUFLOW_TEXT_FILE at one)."""
-    from tpuflow.data import ShardedLoader, load_dataset
-
-    if dataset == "lm_text":
-        ds = load_dataset("lm_text", seq_len=seq_len)
-        if vocab < 256:
-            raise ValueError(
-                f"lm_text is byte-level (vocab 256) but the model's "
-                f"vocab_size is {vocab}"
-            )
-        if ds.train.images.shape[0] < batch_size:
-            raise ValueError(
-                f"lm_text corpus yields only {ds.train.images.shape[0]} "
-                f"windows of seq_len+1 bytes — fewer than one batch of "
-                f"{batch_size}; use a bigger file or smaller --batch-size"
-            )
-    elif dataset == "lm_synth":
-        ds = load_dataset(
-            "lm_synth",
-            synthetic_size=_lm_corpus_size(batch_size, steps),
-            seq_len=seq_len,
-            vocab_size=vocab,
-        )
-    else:
-        raise ValueError(
-            f"unknown --dataset {dataset!r}; available: lm_synth, lm_text"
-        )
-    # Epoch length honors --steps-per-epoch (keeping the LR decay horizon,
-    # epochs*steps_per_epoch, truthful) via max_batches: each epoch's
-    # reshuffle ranges over the WHOLE corpus, so successive epochs see
-    # different windows of a large file. Held-out loader pads+masks its
-    # ragged tail so every test window counts in the validation perplexity.
-    train = ShardedLoader(
-        ds.train, batch_size=batch_size, shuffle=True, max_batches=steps
-    )
-    val = ShardedLoader(
-        ds.test,
-        batch_size=batch_size,
-        shuffle=False,
-        pad_tail=True,
-        drop_last=False,
-    )
-    return train, val
 
 
 class TpuGptTrain(FlowSpec):
-    """Train GPT-2 with FSDP (+ optional tensor/sequence parallelism) on
-    synthetic LM data, checkpointing the fully-sharded state."""
+    """Train GPT-2 with FSDP (+ optional tensor/sequence/expert/pipeline
+    parallelism) on LM data, checkpointing the fully-sharded state."""
 
     preset = Parameter("preset", default="test", help="test | gpt2 | medium")
     epochs = Parameter("epochs", default=2, help="epochs")
@@ -170,59 +106,35 @@ class TpuGptTrain(FlowSpec):
         "step counter lands mid-schedule, not past it",
     )
 
-    def _optimizer(self):
-        from tpuflow.train import make_optimizer
+    def _train_config(self):
+        from tpuflow.train import GptTrainConfig
 
-        total = int(self.epochs) * int(self.steps_per_epoch)
-        return make_optimizer(
-            self.learning_rate,
-            optimizer="adamw",
-            weight_decay=float(self.weight_decay),
-            grad_clip_norm=float(self.grad_clip) or None,
-            warmup_steps=int(self.warmup_steps),
-            decay_steps=int(self.decay_steps)
-            or max(total - int(self.warmup_steps), 1),
-            schedule=self.lr_schedule,
-        )
-
-    def _validation_loss(self, state, val_loader, eval_step, batch_sharding):
-        """Mean token-level loss over the held-out split (shared
-        tpuflow.train.run_validation; padded tail masked out)."""
-        import jax
-
-        from tpuflow.train import run_validation
-
-        return run_validation(
-            state,
-            val_loader,
-            eval_step,
-            place=lambda x: jax.device_put(x, batch_sharding),
-        )
-
-    def _config(self):
-        from tpuflow.models.gpt2 import GPT2Config
-
-        # Full-size presets scan the layer stack (compile time independent
-        # of depth) and rematerialize blocks (activation memory independent
-        # of depth) — the TPU-first defaults for real training.
-        if self.preset == "medium":
-            return GPT2Config.medium(
-                attn_impl=self.attn_impl, scan_layers=True, remat=True,
-                n_experts=int(self.experts),
-            )
-        if self.preset == "gpt2":
-            return GPT2Config(
-                attn_impl=self.attn_impl, scan_layers=True, remat=True,
-                n_experts=int(self.experts),
-            )
-        return GPT2Config.small_test(
+        return GptTrainConfig(
+            preset=self.preset,
+            epochs=int(self.epochs),
+            steps_per_epoch=int(self.steps_per_epoch),
+            batch_size=int(self.batch_size),
+            seq_len=int(self.seq_len),
+            learning_rate=float(self.learning_rate),
+            data_axis=int(self.data_axis),
+            fsdp_axis=int(self.fsdp_axis),
+            tensor_axis=int(self.tensor_axis),
+            seq_axis=int(self.seq_axis),
+            expert_axis=int(self.expert_axis),
+            experts=int(self.experts),
+            stage_axis=int(self.stage_axis),
+            microbatches=int(self.microbatches),
             attn_impl=self.attn_impl,
-            n_ctx=max(128, self.seq_len),
-            # Pipeline parallelism requires the scan-stacked block layout
-            # (one leading layer axis to shard over 'stage').
-            scan_layers=self.stage_axis > 1,
-            n_layer=max(2, self.stage_axis),
-            n_experts=int(self.experts),
+            dataset=self.dataset,
+            sample_tokens=int(self.sample_tokens),
+            accum_steps=int(self.accum_steps),
+            lr_schedule=self.lr_schedule,
+            warmup_steps=int(self.warmup_steps),
+            grad_clip=float(self.grad_clip),
+            weight_decay=float(self.weight_decay),
+            ema_decay=float(self.ema_decay),
+            ckpt_dtype=self.ckpt_dtype or None,
+            decay_steps=int(self.decay_steps),
         )
 
     @step
@@ -237,465 +149,64 @@ class TpuGptTrain(FlowSpec):
     @device_profile(interval=1)
     @step
     def train(self):
-        import jax
-        import jax.numpy as jnp
+        from tpuflow.data.lm import lm_corpus_size, text_source_record
+        from tpuflow.train import train_gpt
 
-        from tpuflow import dist
-        from tpuflow.ckpt import CheckpointManager
-        from tpuflow.models.gpt2 import GPT2
-        from tpuflow.parallel import create_sharded_state, gpt2_tensor_rules
-        from tpuflow.train import TrainState, make_eval_step, make_train_step
-
-        cfg = self._config()
-        # Artifacts a downstream eval flow needs to rebuild the model
-        # (cross-flow handoff: the checkpoint handle alone doesn't carry
-        # the architecture).
+        cfg = self._train_config()
+        cfg.validate()
+        mc = cfg.model_config()
+        # Artifacts a downstream eval flow needs to rebuild the model and
+        # see the identical held-out split (cross-flow handoff: the
+        # checkpoint handle alone carries neither the architecture nor the
+        # corpus identity).
         self.model_config = {
-            "vocab_size": cfg.vocab_size,
-            "n_ctx": cfg.n_ctx,
-            "n_embd": cfg.n_embd,
-            "n_layer": cfg.n_layer,
-            "n_head": cfg.n_head,
-            "scan_layers": cfg.scan_layers,
-            "n_experts": cfg.n_experts,
+            "vocab_size": mc.vocab_size,
+            "n_ctx": mc.n_ctx,
+            "n_embd": mc.n_embd,
+            "n_layer": mc.n_layer,
+            "n_head": mc.n_head,
+            "scan_layers": mc.scan_layers,
+            "n_experts": mc.n_experts,
         }
-        self.dataset_used = self.dataset
-        self.seq_len_used = int(self.seq_len)
+        self.dataset_used = cfg.dataset
+        self.seq_len_used = cfg.seq_len
         # lm_synth's corpus (and so its test split) is sized from the run
         # parameters; an eval flow must mirror it to see the same split.
-        self.synthetic_size_used = _lm_corpus_size(
-            int(self.batch_size), int(self.steps_per_epoch)
+        self.synthetic_size_used = lm_corpus_size(
+            cfg.batch_size, cfg.steps_per_epoch
         )
+        if cfg.dataset == "lm_text":
+            # Pin the corpus identity: path + content hash. The eval flow
+            # loads THIS file and errors if its bytes changed — the
+            # "held-out split" can never silently come from a different
+            # corpus than training saw.
+            self.text_source = text_source_record()
+            cfg.text_path = self.text_source["path"]
         if self.resume_checkpoint is not None:
             # Back the restore's destination pages on a background thread
-            # while the mesh/model/jit setup below runs (ckpt.RestoreArena).
+            # while the mesh/model/jit setup runs (ckpt.RestoreArena).
             from tpuflow.ckpt import prewarm_restore_handle
 
             prewarm_restore_handle(self.resume_checkpoint)
-        if self.stage_axis > 1:
-            # Pipeline composes with data parallelism only; the other axis
-            # parameters (fsdp defaults to 2) don't apply to this mesh.
-            if self.tensor_axis > 1 or self.seq_axis > 1 or self.expert_axis > 1:
-                raise ValueError(
-                    "pipeline (--stage-axis) composes with --data-axis only"
-                )
-            if self.fsdp_axis > 1:
-                print(
-                    "[gpt_flow] note: --fsdp-axis does not apply in pipeline "
-                    "mode; params shard by layer slice over 'stage' instead"
-                )
-            if int(self.accum_steps) > 1:
-                raise ValueError(
-                    "--accum-steps applies to the FSDP/DP step only; the "
-                    "pipeline schedule already microbatches via "
-                    "--microbatches"
-                )
-            if float(self.ema_decay) > 0.0:
-                raise ValueError(
-                    "--ema-decay is not supported in pipeline mode "
-                    "(--stage-axis > 1); the pipeline step tracks no EMA"
-                )
-            self._train_pipeline(cfg)
-            self.next(self.end)
-            return
-        if int(self.experts) and int(self.experts) % int(self.expert_axis):
-            raise ValueError(
-                f"--experts {self.experts} must be divisible by "
-                f"--expert-axis {self.expert_axis}"
-            )
-        mesh = dist.make_mesh(
-            {
-                "data": self.data_axis,
-                "fsdp": self.fsdp_axis,
-                "tensor": self.tensor_axis,
-                "seq": self.seq_axis,
-                "expert": self.expert_axis,
-            }
+        result = train_gpt(
+            cfg,
+            ckpt_dir=os.path.join(current.tpu_storage_path, "checkpoints"),
+            resume_checkpoint=self.resume_checkpoint,
         )
-        print(f"[gpt_flow] mesh {dict(mesh.shape)}, preset {self.preset}")
-        model = GPT2(cfg)
-        tx = self._optimizer()
-
-        def init_fn(rng):
-            params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
-            return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
-
-        with mesh:
-            state, shardings = create_sharded_state(
-                init_fn,
-                mesh,
-                jax.random.PRNGKey(0),
-                fsdp=True,
-                # The rules carry BOTH tensor and expert placements and
-                # self-gate on axis sizes.
-                tensor_rules=gpt2_tensor_rules
-                if self.tensor_axis > 1 or self.expert_axis > 1
-                else None,
-            )
-            mgr = CheckpointManager(
-                os.path.join(current.tpu_storage_path, "checkpoints"),
-                max_to_keep=2,
-                save_dtype=self.ckpt_dtype or None,
-            )
-            if self.resume_checkpoint is not None:
-                from tpuflow.ckpt import restore_from_handle
-
-                abstract = jax.tree_util.tree_map(
-                    lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-                    jax.eval_shape(init_fn, jax.random.PRNGKey(0)),
-                    shardings,
-                )
-                tmpl = {
-                    "step": abstract.step,
-                    "params": abstract.params,
-                    "opt_state": abstract.opt_state,
-                }
-                if float(self.ema_decay) > 0.0:
-                    # EMA runs save/restore the averaged weights too; the
-                    # resume run must pass the same --ema-decay flag (the
-                    # checkpoint's leaf structure includes them).
-                    tmpl["ema_params"] = abstract.params
-                restored = restore_from_handle(
-                    self.resume_checkpoint, abstract_state=tmpl
-                )
-                state = state.replace(
-                    step=restored["step"],
-                    params=restored["params"],
-                    opt_state=restored["opt_state"],
-                    # Present exactly when the template asked for it (the
-                    # raw restore errors on any structure mismatch).
-                    ema_params=restored.get("ema_params", {}),
-                )
-                print("[gpt_flow] full sharded state restored")
-
-            loader, val_loader = _lm_loader(
-                self.batch_size, self.steps_per_epoch, self.seq_len,
-                cfg.vocab_size, dataset=self.dataset,
-            )
-            seq_spec = "seq" if self.seq_axis > 1 else None
-            batch_sharding = jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec(("data", "fsdp"), seq_spec)
-            )
-            if float(self.ema_decay) > 0.0 and not state.ema_params:
-                # Seed EMA only on fresh starts — a resume above already
-                # restored the averaged weights.
-                from tpuflow.train import with_ema
-
-                state = with_ema(state)
-            train_step = make_train_step(
-                accum_steps=int(self.accum_steps),
-                ema_decay=float(self.ema_decay) or None,
-            )
-            eval_step = make_eval_step()
-            rng = jax.random.PRNGKey(1)
-            history = []
-            epoch_records = []
-            for epoch in range(self.epochs):
-                t_epoch = time.monotonic()
-                loader.set_epoch(epoch)
-                losses = []
-                n_tokens = 0
-                for i, b in enumerate(loader):
-                    batch = {
-                        "x": jax.device_put(b["x"], batch_sharding),
-                        "y": jax.device_put(b["y"], batch_sharding),
-                    }
-                    state, metrics = train_step(state, batch, rng)
-                    losses.append(metrics["loss"])
-                    if epoch == 0 and i == 0:
-                        # Fence out jit compilation so throughput numbers
-                        # are comparable across epochs; the first batch's
-                        # tokens are excluded from the rate accordingly.
-                        jax.block_until_ready(metrics["loss"])
-                        t_epoch = time.monotonic()
-                    else:
-                        n_tokens += int(np.prod(b["y"].shape))
-                jax.block_until_ready(state.params)
-                epoch_s = time.monotonic() - t_epoch
-                tok_s = n_tokens / max(epoch_s, 1e-9) if n_tokens else None
-                epoch_loss = float(jnp.stack(losses).mean())
-                history.append(epoch_loss)
-                # Held-out validation: token-level loss -> perplexity over
-                # EVERY test window (padded tail masked out). The
-                # best/retention policy keys on real val loss, matching the
-                # reference's save-best-on-val semantics
-                # (my_ray_module.py:190-201), not the train loss.
-                val_loss = self._validation_loss(
-                    state, val_loader, eval_step, batch_sharding
-                )
-                ppl = math.exp(min(val_loss, 30.0))
-                epoch_records.append(
-                    {
-                        "epoch": epoch,
-                        "train_loss": epoch_loss,
-                        "val_loss": val_loss,
-                        "ppl": ppl,
-                        "tokens_per_s": round(tok_s, 1) if tok_s else None,
-                    }
-                )
-                rate = f" ({tok_s:.0f} tok/s)" if tok_s else ""
-                print(
-                    f"[gpt_flow] epoch {epoch}: loss={epoch_loss:.4f} "
-                    f"val_loss={val_loss:.4f} ppl={ppl:.2f}{rate}"
-                )
-                payload = {
-                    "step": state.step,
-                    "params": state.params,
-                    "opt_state": state.opt_state,
-                }
-                if float(self.ema_decay) > 0.0:
-                    payload["ema_params"] = state.ema_params
-                mgr.save(
-                    int(state.step),
-                    payload,
-                    metrics={
-                        "val_loss": val_loss,
-                        "train_loss": epoch_loss,
-                        "ppl": ppl,
-                    },
-                )
-            mgr.wait_until_finished()
-            self.result_checkpoint = mgr.checkpoint()
-            self.loss_history = history
-            self.metrics_history = epoch_records
-            mgr.close()
-            if self.sample_tokens > 0:
-                # Demonstrate the LM inference surface on the trained model:
-                # greedy KV-cache decode (tpuflow.infer.generate), sharded
-                # params and all — GSPMD handles the gather under jit.
-                from tpuflow.infer import generate
-
-                # Byte-level corpora get a readable prompt ("The ") and a
-                # text rendering of the sample; token corpora print ids.
-                byte_level = self.dataset == "lm_text"
-                prompt = (
-                    jnp.asarray([list(b"The ")], jnp.int32)
-                    if byte_level
-                    else jnp.zeros((1, 4), jnp.int32)
-                )
-                toks = generate(
-                    model, state.params, prompt,
-                    max_new_tokens=int(self.sample_tokens), temperature=0.0,
-                )
-                self.sample = [int(t) for t in toks[0]]
-                from tpuflow.infer import render_tokens
-
-                print(
-                    "[gpt_flow] greedy sample: "
-                    f"{render_tokens(self.sample, byte_level=byte_level)!r}"
-                )
+        self.result_checkpoint = result.checkpoint
+        self.loss_history = result.loss_history
+        self.metrics_history = result.metrics_history
+        if result.sample is not None:
+            self.sample = result.sample
         self.next(self.end)
-
-    def _train_pipeline(self, cfg):
-        """GPipe pipeline-parallel training over a ('data','stage') mesh:
-        scan-stacked blocks shard by layer slice (tpuflow.parallel.pipeline),
-        grads flow through the microbatch schedule, checkpoints carry the
-        pipeline-sharded state (the raw format's shard-ownership rule covers
-        any sharding, so resume works unchanged)."""
-        import jax
-        import jax.numpy as jnp
-        import optax
-
-        from tpuflow import dist
-        from tpuflow.ckpt import CheckpointManager, restore_from_handle
-        from tpuflow.models.gpt2 import GPT2
-        from tpuflow.parallel import (
-            gpt2_pipeline_loss,
-            gpt2_pipeline_shardings,
-        )
-
-        mesh = dist.make_mesh(
-            {"data": self.data_axis, "stage": self.stage_axis}
-        )
-        print(
-            f"[gpt_flow] pipeline mesh {dict(mesh.shape)}, "
-            f"microbatches={self.microbatches}"
-        )
-        model = GPT2(cfg)
-        tx = self._optimizer()
-        loss_fn = gpt2_pipeline_loss(
-            cfg, mesh=mesh, n_microbatches=self.microbatches
-        )
-
-        def init_params(rng):
-            return model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
-
-        with mesh:
-            p_shapes = jax.eval_shape(init_params, jax.random.PRNGKey(0))
-            shardings = gpt2_pipeline_shardings(mesh, p_shapes)
-            # Params born sharded: init is jitted with the pipeline
-            # shardings as out_shardings, so no host ever materializes the
-            # full replicated tree.
-            params = jax.jit(init_params, out_shardings=shardings)(
-                jax.random.PRNGKey(0)
-            )
-            # Optimizer state mirrors the params tree (mu/nu under the same
-            # 'h' paths → 'stage'-sharded; counts are scalars → replicated),
-            # so the same path rule shards it.
-            opt_shape = jax.eval_shape(tx.init, p_shapes)
-            opt_shardings = gpt2_pipeline_shardings(mesh, opt_shape)
-            opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
-            start_step = 0
-
-            mgr = CheckpointManager(
-                os.path.join(current.tpu_storage_path, "checkpoints"),
-                max_to_keep=2,
-                save_dtype=self.ckpt_dtype or None,
-            )
-            if self.resume_checkpoint is not None:
-                abstract = {
-                    "step": jax.ShapeDtypeStruct((), jnp.int32),
-                    "params": jax.tree_util.tree_map(
-                        lambda s, sh: jax.ShapeDtypeStruct(
-                            s.shape, s.dtype, sharding=sh
-                        ),
-                        p_shapes,
-                        shardings,
-                    ),
-                    "opt_state": jax.tree_util.tree_map(
-                        lambda s, sh: jax.ShapeDtypeStruct(
-                            s.shape, s.dtype, sharding=sh
-                        ),
-                        opt_shape,
-                        opt_shardings,
-                    ),
-                }
-                restored = restore_from_handle(
-                    self.resume_checkpoint, abstract_state=abstract
-                )
-                # Normalize placement: scalar/replicated leaves may come
-                # back single-device; device_put onto the target shardings
-                # is idempotent for already-placed shards.
-                params = jax.device_put(restored["params"], shardings)
-                opt_state = jax.device_put(restored["opt_state"], opt_shardings)
-                start_step = int(restored["step"])
-                print("[gpt_flow] pipeline-sharded state restored")
-            mgr.prewarm({"params": params, "opt_state": opt_state})
-
-            # Donated params/opt_state: old and new state never coexist in
-            # HBM (matches make_train_step's donate pattern; safe because
-            # mgr.save snapshots device buffers synchronously before its
-            # async writer starts, and the loop rebinds both every step).
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def pp_step(params, opt_state, x, y):
-                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                return optax.apply_updates(params, updates), opt_state, loss
-
-            loader, _ = _lm_loader(
-                self.batch_size, self.steps_per_epoch, self.seq_len,
-                cfg.vocab_size, dataset=self.dataset,
-            )
-            data_sharding = jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec("data")
-            )
-            history = []
-            global_step = start_step
-            for epoch in range(self.epochs):
-                loader.set_epoch(epoch)
-                losses = []
-                for b in loader:
-                    params, opt_state, loss = pp_step(
-                        params,
-                        opt_state,
-                        jax.device_put(b["x"], data_sharding),
-                        jax.device_put(b["y"], data_sharding),
-                    )
-                    losses.append(loss)
-                    global_step += 1
-                jax.block_until_ready(params)
-                epoch_loss = float(jnp.stack(losses).mean())
-                history.append(epoch_loss)
-                print(f"[gpt_flow] pipeline epoch {epoch}: loss={epoch_loss:.4f}")
-                mgr.save(
-                    global_step,
-                    {
-                        "step": jnp.int32(global_step),
-                        "params": params,
-                        "opt_state": opt_state,
-                    },
-                    metrics={"val_loss": epoch_loss},
-                )
-            mgr.wait_until_finished()
-            self.result_checkpoint = mgr.checkpoint()
-            self.loss_history = history
-            self.metrics_history = [
-                {"epoch": i, "train_loss": l} for i, l in enumerate(history)
-            ]
-            mgr.close()
 
     @card(type="blank")
     @step
     def end(self):
-        self._render_card()
+        training_curve_card(
+            current.card, getattr(self, "metrics_history", None) or []
+        )
         print(f"[gpt_flow] loss history: {self.loss_history}")
-
-    def _render_card(self):
-        """Training-curve card (D14): per-epoch loss chart + metrics table +
-        final-perplexity headline, the train-side sibling of eval_flow's
-        error-analysis card. Chart style follows the dataviz method: one
-        axis (both series are token-level loss in nats — perplexity stays in
-        the table), categorical slots 1-2 of the validated reference
-        palette, 2px lines, recessive grid, legend for two series."""
-        records = getattr(self, "metrics_history", None)
-        if not records:
-            return
-        from tpuflow.flow import Image, Markdown, metrics_table
-
-        buf = current.card
-        buf.append(Markdown("# Training curves"))
-        last = records[-1]
-        if "ppl" in last:
-            buf.append(
-                Markdown(
-                    f"Final **val perplexity {last['ppl']:.2f}** "
-                    f"(val loss {last['val_loss']:.4f}) after "
-                    f"{len(records)} epoch(s)."
-                )
-            )
-        try:
-            import matplotlib
-
-            matplotlib.use("Agg")
-            import matplotlib.pyplot as plt
-
-            fig, ax = plt.subplots(figsize=(6, 3.2), facecolor="#fcfcfb")
-            ax.set_facecolor("#fcfcfb")
-            xs = [r["epoch"] for r in records]
-            ax.plot(
-                xs,
-                [r["train_loss"] for r in records],
-                color="#2a78d6",
-                linewidth=2,
-                marker="o",
-                markersize=4,
-                label="train loss",
-            )
-            if "val_loss" in last:
-                ax.plot(
-                    xs,
-                    [r["val_loss"] for r in records],
-                    color="#eb6834",
-                    linewidth=2,
-                    marker="o",
-                    markersize=4,
-                    label="val loss",
-                )
-                ax.legend(frameon=False)
-            from matplotlib.ticker import MaxNLocator
-
-            ax.xaxis.set_major_locator(MaxNLocator(integer=True))
-            ax.set_xlabel("epoch")
-            ax.set_ylabel("loss (nats/token)")
-            ax.grid(True, color="#e5e4e0", linewidth=0.5)
-            for side in ("top", "right"):
-                ax.spines[side].set_visible(False)
-            fig.tight_layout()
-            buf.append(Image.from_matplotlib(fig))
-            plt.close(fig)
-        except Exception as e:  # cards must never fail the run
-            buf.append(Markdown(f"(chart unavailable: {e})"))
-        buf.append(metrics_table(records))
 
 
 if __name__ == "__main__":

@@ -178,6 +178,31 @@ def _load_synthetic_lm(
     )
 
 
+def resolve_text_path(
+    data_dir: str | None = None, text_path: str | None = None
+) -> str | None:
+    """The ONE source of truth for which file 'lm_text' trains on:
+    explicit ``text_path`` → ``TPUFLOW_TEXT_FILE`` env → first ``*.txt``
+    under the data dir → None (synthetic stand-in). Exposed so flows can
+    record the resolved path (plus a content hash) as a run artifact and
+    consumers can pin the identical corpus instead of re-resolving in a
+    possibly different environment."""
+    import glob as _glob
+
+    explicit = text_path or os.environ.get("TPUFLOW_TEXT_FILE")
+    if explicit:
+        if not os.path.exists(explicit):
+            # An explicitly requested file must never silently degrade to
+            # the synthetic stand-in (a typo'd path would otherwise train
+            # on fake data while claiming real text).
+            raise FileNotFoundError(
+                f"lm_text: requested text file does not exist: {explicit}"
+            )
+        return explicit
+    txts = sorted(_glob.glob(os.path.join(data_dir or _DEFAULT_DIR, "*.txt")))
+    return txts[0] if txts else None
+
+
 def _load_text_lm(
     data_dir: str, seq_len: int, text_path: str | None = None
 ) -> Dataset:
@@ -192,21 +217,7 @@ def _load_text_lm(
     file present, a deterministic byte-pattern corpus stands in
     (``synthetic=True``), mirroring the image datasets' fallback policy.
     """
-    import glob as _glob
-
-    explicit = text_path or os.environ.get("TPUFLOW_TEXT_FILE")
-    if explicit:
-        if not os.path.exists(explicit):
-            # An explicitly requested file must never silently degrade to
-            # the synthetic stand-in (a typo'd path would otherwise train
-            # on fake data while claiming real text).
-            raise FileNotFoundError(
-                f"lm_text: requested text file does not exist: {explicit}"
-            )
-        path = explicit
-    else:
-        txts = sorted(_glob.glob(os.path.join(data_dir, "*.txt")))
-        path = txts[0] if txts else None
+    path = resolve_text_path(data_dir, text_path)
     if path is None:
         # No file anywhere: the deterministic stand-in, shifted into the
         # printable-byte range (reuses the lm_synth generator, one pattern
@@ -294,11 +305,15 @@ def _load_cifar10(data_dir: str) -> Dataset:
 
 def _load_synthetic_imagenet(size: int) -> Dataset:
     """ImageNet-shaped synthetic data (224x224x3, 1000 classes) for the
-    ResNet-50 acceptance config; sized down by default to fit dev machines."""
+    ResNet-50 acceptance config; sized down by default to fit dev machines.
+    TPUFLOW_SYNTH_TRAIN_N/TPUFLOW_SYNTH_TEST_N override, same knobs as the
+    other synthetic fallbacks."""
     train, test = _synth_classification(
         seed=40,
-        n_train=size,
-        n_test=max(size // 10, 100),
+        n_train=int(os.environ.get("TPUFLOW_SYNTH_TRAIN_N", size)),
+        n_test=int(
+            os.environ.get("TPUFLOW_SYNTH_TEST_N", max(size // 10, 100))
+        ),
         shape=(224, 224, 3),
         num_classes=1000,
     )

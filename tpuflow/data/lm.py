@@ -1,0 +1,124 @@
+"""LM-family data plumbing shared by the GPT flows (train AND eval).
+
+Flows stay ~reference-sized shells (reference train_flow.py is a 100-line
+wrapper over its library stack); the corpus sizing, loader construction,
+and source provenance for the language-model datasets live here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any
+
+from tpuflow.data.datasets import load_dataset, resolve_text_path
+from tpuflow.data.loader import ShardedLoader
+
+
+def lm_corpus_size(batch_size: int, steps: int) -> int:
+    """Docs in the lm_synth corpus for a run's parameters — ONE source of
+    truth shared by the loader and the ``synthetic_size_used`` artifact an
+    eval flow mirrors to see the identical test split."""
+    return max(batch_size * steps, batch_size)
+
+
+def text_source_record(
+    text_path: str | None = None, data_dir: str | None = None
+) -> dict[str, Any]:
+    """Resolve the 'lm_text' source and fingerprint it: ``{"path", "sha256",
+    "bytes"}`` (path None = synthetic stand-in). Training records this as a
+    run artifact; eval passes the recorded path back and errors on a hash
+    mismatch — the corpus can't silently differ between the two flows."""
+    path = resolve_text_path(data_dir, text_path)
+    if path is None:
+        return {"path": None, "sha256": None, "bytes": 0}
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+            n += len(chunk)
+    return {"path": os.path.abspath(path), "sha256": h.hexdigest(), "bytes": n}
+
+
+def check_text_source(record: dict[str, Any]) -> None:
+    """Verify a recorded text source still has the recorded content.
+    Raises with a precise message on a missing file or changed bytes —
+    never lets an eval silently score against a different corpus."""
+    path = record.get("path")
+    if path is None:
+        # Training used the synthetic stand-in; if resolution NOW finds a
+        # real file, scoring it would silently compare apples to oranges.
+        found = resolve_text_path()
+        if found is not None:
+            raise ValueError(
+                f"training used the synthetic lm_text stand-in but {found} "
+                "resolves now; unset TPUFLOW_TEXT_FILE / clean the data dir "
+                "or re-train on the file"
+            )
+        return
+    current = text_source_record(text_path=path)
+    if current["sha256"] != record.get("sha256"):
+        raise ValueError(
+            f"lm_text corpus changed since training: {path} now hashes "
+            f"{current['sha256']} (recorded {record.get('sha256')}); "
+            "re-train or point TPUFLOW_TEXT_FILE at the original file"
+        )
+
+
+def make_lm_loaders(
+    batch_size: int,
+    steps: int,
+    seq_len: int,
+    vocab: int,
+    dataset: str = "lm_synth",
+    text_path: str | None = None,
+) -> tuple[ShardedLoader, ShardedLoader]:
+    """Sharded train/val LM loaders (D4/D16 for the GPT family): yield
+    ``{'x': tokens[:, :-1], 'y': tokens[:, 1:]}`` with the same seeded
+    per-epoch reshuffle semantics as the image loaders (set_epoch ↔
+    reference my_ray_module.py:149-151). 'lm_synth' is the deterministic
+    stand-in; 'lm_text' trains byte-level on a local text file (drop a
+    .txt into $TPUFLOW_DATA_DIR or point TPUFLOW_TEXT_FILE at one).
+
+    Epoch length honors ``steps`` (keeping the LR decay horizon,
+    epochs*steps, truthful) via max_batches: each epoch's reshuffle ranges
+    over the WHOLE corpus, so successive epochs see different windows of a
+    large file. The held-out loader pads+masks its ragged tail so every
+    test window counts in the validation perplexity.
+    """
+    if dataset == "lm_text":
+        ds = load_dataset("lm_text", seq_len=seq_len, text_path=text_path)
+        if vocab < 256:
+            raise ValueError(
+                f"lm_text is byte-level (vocab 256) but the model's "
+                f"vocab_size is {vocab}"
+            )
+        if ds.train.images.shape[0] < batch_size:
+            raise ValueError(
+                f"lm_text corpus yields only {ds.train.images.shape[0]} "
+                f"windows of seq_len+1 bytes — fewer than one batch of "
+                f"{batch_size}; use a bigger file or smaller batch size"
+            )
+    elif dataset == "lm_synth":
+        ds = load_dataset(
+            "lm_synth",
+            synthetic_size=lm_corpus_size(batch_size, steps),
+            seq_len=seq_len,
+            vocab_size=vocab,
+        )
+    else:
+        raise ValueError(
+            f"unknown dataset {dataset!r}; available: lm_synth, lm_text"
+        )
+    train = ShardedLoader(
+        ds.train, batch_size=batch_size, shuffle=True, max_batches=steps
+    )
+    val = ShardedLoader(
+        ds.test,
+        batch_size=batch_size,
+        shuffle=False,
+        pad_tail=True,
+        drop_last=False,
+    )
+    return train, val

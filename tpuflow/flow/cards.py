@@ -125,3 +125,70 @@ class CardBuffer:
             "table{font-size:13px}</style></head>"
             f"<body>{body}</body></html>"
         )
+
+
+def training_curve_card(buf, records: Sequence[dict]) -> None:
+    """Training-curve card (D14): per-epoch loss chart + metrics table +
+    final-perplexity headline — the train-side sibling of the eval flows'
+    error-analysis card, shared so every training flow renders the same
+    report. Chart style follows the dataviz method: one axis (both series
+    are token-level loss in nats — perplexity stays in the table),
+    categorical slots 1-2 of the validated reference palette, 2px lines,
+    recessive grid, legend for two series. Appends into ``buf``
+    (``current.card``); cards must never fail the run, so chart errors
+    degrade to a note."""
+    if not records:
+        return
+    buf.append(Markdown("# Training curves"))
+    last = records[-1]
+    if "ppl" in last:
+        buf.append(
+            Markdown(
+                f"Final **val perplexity {last['ppl']:.2f}** "
+                f"(val loss {last['val_loss']:.4f}) after "
+                f"{len(records)} epoch(s)."
+            )
+        )
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(6, 3.2), facecolor="#fcfcfb")
+        ax.set_facecolor("#fcfcfb")
+        xs = [r["epoch"] for r in records]
+        ax.plot(
+            xs,
+            [r["train_loss"] for r in records],
+            color="#2a78d6",
+            linewidth=2,
+            marker="o",
+            markersize=4,
+            label="train loss",
+        )
+        if "val_loss" in last:
+            ax.plot(
+                xs,
+                [r["val_loss"] for r in records],
+                color="#eb6834",
+                linewidth=2,
+                marker="o",
+                markersize=4,
+                label="val loss",
+            )
+            ax.legend(frameon=False)
+        from matplotlib.ticker import MaxNLocator
+
+        ax.xaxis.set_major_locator(MaxNLocator(integer=True))
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss (nats/token)")
+        ax.grid(True, color="#e5e4e0", linewidth=0.5)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        fig.tight_layout()
+        buf.append(Image.from_matplotlib(fig))
+        plt.close(fig)
+    except Exception as e:  # cards must never fail the run
+        buf.append(Markdown(f"(chart unavailable: {e})"))
+    buf.append(metrics_table(records))

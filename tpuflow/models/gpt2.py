@@ -66,6 +66,45 @@ class GPT2Config:
         kw = {"n_embd": 1024, "n_layer": 24, "n_head": 16, **kw}
         return cls(**kw)
 
+    @classmethod
+    def from_preset(
+        cls,
+        preset: str,
+        *,
+        attn_impl: str = "xla",
+        seq_len: int = 64,
+        stage_axis: int = 1,
+        n_experts: int = 0,
+    ) -> "GPT2Config":
+        """The flows' preset table: ``test`` (tiny, fast CPU compile),
+        ``gpt2`` (124M), ``medium`` (355M). Full-size presets scan the
+        layer stack (compile time independent of depth) and rematerialize
+        blocks (activation memory independent of depth) — the TPU-first
+        defaults for real training."""
+        if preset == "medium":
+            return cls.medium(
+                attn_impl=attn_impl, scan_layers=True, remat=True,
+                n_experts=n_experts,
+            )
+        if preset == "gpt2":
+            return cls(
+                attn_impl=attn_impl, scan_layers=True, remat=True,
+                n_experts=n_experts,
+            )
+        if preset == "test":
+            return cls.small_test(
+                attn_impl=attn_impl,
+                n_ctx=max(128, seq_len),
+                # Pipeline parallelism requires the scan-stacked block
+                # layout (one leading layer axis to shard over 'stage').
+                scan_layers=stage_axis > 1,
+                n_layer=max(2, stage_axis),
+                n_experts=n_experts,
+            )
+        raise ValueError(
+            f"unknown preset {preset!r}; available: test, gpt2, medium"
+        )
+
 
 def _masked_attention(q, k, v, valid):
     """Masked softmax attention, float32 statistics (bf16-safe), static

@@ -1,5 +1,6 @@
 """Distributed training runtime (Trainer, configs, context, Result)."""
 
+from tpuflow.train.gpt import GptTrainConfig, GptTrainResult, train_gpt
 from tpuflow.train.optim import make_optimizer, make_schedule
 from tpuflow.train.step import (
     TrainState,
@@ -22,6 +23,8 @@ from tpuflow.train.trainer import (
 
 __all__ = [
     "CheckpointConfig",
+    "GptTrainConfig",
+    "GptTrainResult",
     "Result",
     "RunConfig",
     "ScalingConfig",

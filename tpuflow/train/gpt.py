@@ -1,0 +1,498 @@
+"""GPT-family training recipes: FSDP (+ tensor/sequence/expert parallel)
+and GPipe pipeline legs, as library functions.
+
+The flows stay reference-sized shells (reference train_flow.py is a
+~100-line wrapper over its library stack; its counterpart here just binds
+CLI parameters to ``GptTrainConfig`` and records artifacts) — everything
+a new model family or dataset would want to reuse lives in this module:
+mesh/sharding setup, resume, the epoch loop with held-out validation,
+checkpointing with retention/best, EMA, and post-train sampling.
+
+Covers BASELINE.md config 5 ("GPT-2-medium FSDP → pjit fully-sharded
+checkpoint, multi-host v5e-32") with the framework's idioms: parameters
+and optimizer state born sharded over ('fsdp','data') (optionally
+tensor-parallel over 'tensor', sequence-parallel over 'seq',
+expert-parallel over 'expert'), per-epoch async sharded checkpoints, and
+full-state resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import time
+from typing import Any
+
+
+@dataclasses.dataclass
+class GptTrainConfig:
+    """Everything the GPT training recipes need; flows bind CLI parameters
+    straight onto this (defaults match the flow defaults)."""
+
+    preset: str = "test"            # test | gpt2 | medium
+    epochs: int = 2
+    steps_per_epoch: int = 16
+    batch_size: int = 8             # global
+    seq_len: int = 64
+    learning_rate: float = 3e-4
+    data_axis: int = 2
+    fsdp_axis: int = 2
+    tensor_axis: int = 1
+    seq_axis: int = 1
+    expert_axis: int = 1
+    experts: int = 0                # Switch-MoE experts per block (0=dense)
+    stage_axis: int = 1             # >1 = GPipe pipeline mode
+    microbatches: int = 2
+    attn_impl: str = "xla"          # xla | flash | ring | ulysses
+    dataset: str = "lm_synth"       # lm_synth | lm_text
+    text_path: str | None = None    # pin the lm_text corpus file
+    sample_tokens: int = 0
+    accum_steps: int = 1
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    grad_clip: float = 0.0
+    weight_decay: float = 1e-4
+    ema_decay: float = 0.0
+    ckpt_dtype: str | None = None
+    decay_steps: int = 0            # 0 = this run's epochs*steps
+
+    def model_config(self):
+        from tpuflow.models.gpt2 import GPT2Config
+
+        return GPT2Config.from_preset(
+            self.preset,
+            attn_impl=self.attn_impl,
+            seq_len=self.seq_len,
+            stage_axis=self.stage_axis,
+            n_experts=self.experts,
+        )
+
+    def optimizer(self):
+        from tpuflow.train.optim import make_optimizer
+
+        total = self.epochs * self.steps_per_epoch
+        return make_optimizer(
+            self.learning_rate,
+            optimizer="adamw",
+            weight_decay=self.weight_decay,
+            grad_clip_norm=self.grad_clip or None,
+            warmup_steps=self.warmup_steps,
+            decay_steps=self.decay_steps
+            or max(total - self.warmup_steps, 1),
+            schedule=self.lr_schedule,
+        )
+
+    def validate(self) -> None:
+        """Reject incoherent knob combinations with actionable messages."""
+        if self.stage_axis > 1:
+            # Pipeline composes with data parallelism only.
+            if (
+                self.tensor_axis > 1
+                or self.seq_axis > 1
+                or self.expert_axis > 1
+            ):
+                raise ValueError(
+                    "pipeline (stage_axis) composes with data_axis only"
+                )
+            if self.accum_steps > 1:
+                raise ValueError(
+                    "accum_steps applies to the FSDP/DP step only; the "
+                    "pipeline schedule already microbatches via "
+                    "microbatches"
+                )
+            if self.ema_decay > 0.0:
+                raise ValueError(
+                    "ema_decay is not supported in pipeline mode "
+                    "(stage_axis > 1); the pipeline step tracks no EMA"
+                )
+        if self.experts and self.experts % self.expert_axis:
+            raise ValueError(
+                f"experts {self.experts} must be divisible by "
+                f"expert_axis {self.expert_axis}"
+            )
+
+
+@dataclasses.dataclass
+class GptTrainResult:
+    checkpoint: Any                  # CheckpointHandle of the final save
+    loss_history: list[float]
+    metrics_history: list[dict]
+    sample: list[int] | None = None  # greedy tokens when sample_tokens > 0
+
+
+def _loaders(cfg: GptTrainConfig, vocab: int):
+    from tpuflow.data.lm import make_lm_loaders
+
+    return make_lm_loaders(
+        cfg.batch_size, cfg.steps_per_epoch, cfg.seq_len, vocab,
+        dataset=cfg.dataset, text_path=cfg.text_path,
+    )
+
+
+def train_gpt(
+    cfg: GptTrainConfig, ckpt_dir: str, resume_checkpoint=None,
+    log=print,
+) -> GptTrainResult:
+    """Run the configured GPT training leg end to end.
+
+    ``resume_checkpoint``: a CheckpointHandle to restore FULL state from
+    (step, params, opt_state, and — when ``ema_decay`` matches — the
+    averaged weights). Callers that know the handle early should
+    ``tpuflow.ckpt.prewarm_restore_handle`` it before calling, so the
+    restore's page backing overlaps the setup work here.
+    """
+    cfg.validate()
+    if cfg.stage_axis > 1:
+        if cfg.fsdp_axis > 1:
+            log(
+                "[gpt] note: fsdp_axis does not apply in pipeline mode; "
+                "params shard by layer slice over 'stage' instead"
+            )
+        return _train_pipeline(cfg, ckpt_dir, resume_checkpoint, log)
+    return _train_fsdp(cfg, ckpt_dir, resume_checkpoint, log)
+
+
+def _train_fsdp(
+    cfg: GptTrainConfig, ckpt_dir: str, resume_checkpoint, log
+) -> GptTrainResult:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow import dist
+    from tpuflow.ckpt import CheckpointManager
+    from tpuflow.models.gpt2 import GPT2
+    from tpuflow.parallel import create_sharded_state, gpt2_tensor_rules
+    from tpuflow.train import (
+        TrainState,
+        make_eval_step,
+        make_train_step,
+        run_validation,
+    )
+
+    model_cfg = cfg.model_config()
+    mesh = dist.make_mesh(
+        {
+            "data": cfg.data_axis,
+            "fsdp": cfg.fsdp_axis,
+            "tensor": cfg.tensor_axis,
+            "seq": cfg.seq_axis,
+            "expert": cfg.expert_axis,
+        }
+    )
+    log(f"[gpt] mesh {dict(mesh.shape)}, preset {cfg.preset}")
+    model = GPT2(model_cfg)
+    tx = cfg.optimizer()
+
+    def init_fn(rng):
+        params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    with mesh:
+        state, shardings = create_sharded_state(
+            init_fn,
+            mesh,
+            jax.random.PRNGKey(0),
+            fsdp=True,
+            # The rules carry BOTH tensor and expert placements and
+            # self-gate on axis sizes.
+            tensor_rules=gpt2_tensor_rules
+            if cfg.tensor_axis > 1 or cfg.expert_axis > 1
+            else None,
+        )
+        mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
+        )
+        if resume_checkpoint is not None:
+            from tpuflow.ckpt import restore_from_handle
+
+            abstract = jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh
+                ),
+                jax.eval_shape(init_fn, jax.random.PRNGKey(0)),
+                shardings,
+            )
+            tmpl = {
+                "step": abstract.step,
+                "params": abstract.params,
+                "opt_state": abstract.opt_state,
+            }
+            if cfg.ema_decay > 0.0:
+                # EMA runs save/restore the averaged weights too; the
+                # resume run must pass the same ema_decay (the checkpoint's
+                # leaf structure includes them).
+                tmpl["ema_params"] = abstract.params
+            restored = restore_from_handle(
+                resume_checkpoint, abstract_state=tmpl
+            )
+            state = state.replace(
+                step=restored["step"],
+                params=restored["params"],
+                opt_state=restored["opt_state"],
+                # Present exactly when the template asked for it (the raw
+                # restore errors on any structure mismatch).
+                ema_params=restored.get("ema_params", {}),
+            )
+            log("[gpt] full sharded state restored")
+
+        loader, val_loader = _loaders(cfg, model_cfg.vocab_size)
+        seq_spec = "seq" if cfg.seq_axis > 1 else None
+        batch_sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("data", "fsdp"), seq_spec)
+        )
+        if cfg.ema_decay > 0.0 and not state.ema_params:
+            # Seed EMA only on fresh starts — a resume above already
+            # restored the averaged weights.
+            from tpuflow.train import with_ema
+
+            state = with_ema(state)
+        train_step = make_train_step(
+            accum_steps=cfg.accum_steps,
+            ema_decay=cfg.ema_decay or None,
+        )
+        eval_step = make_eval_step()
+        rng = jax.random.PRNGKey(1)
+        history = []
+        epoch_records = []
+        for epoch in range(cfg.epochs):
+            t_epoch = time.monotonic()
+            loader.set_epoch(epoch)
+            losses = []
+            n_tokens = 0
+            for i, b in enumerate(loader):
+                batch = {
+                    "x": jax.device_put(b["x"], batch_sharding),
+                    "y": jax.device_put(b["y"], batch_sharding),
+                }
+                state, metrics = train_step(state, batch, rng)
+                losses.append(metrics["loss"])
+                if epoch == 0 and i == 0:
+                    # Fence out jit compilation so throughput numbers are
+                    # comparable across epochs; the first batch's tokens
+                    # are excluded from the rate accordingly.
+                    jax.block_until_ready(metrics["loss"])
+                    t_epoch = time.monotonic()
+                else:
+                    n_tokens += int(np.prod(b["y"].shape))
+            jax.block_until_ready(state.params)
+            epoch_s = time.monotonic() - t_epoch
+            tok_s = n_tokens / max(epoch_s, 1e-9) if n_tokens else None
+            epoch_loss = float(jnp.stack(losses).mean())
+            history.append(epoch_loss)
+            # Held-out validation: token-level loss -> perplexity over
+            # EVERY test window (padded tail masked out). The best/retention
+            # policy keys on real val loss, matching the reference's
+            # save-best-on-val semantics (my_ray_module.py:190-201), not
+            # the train loss.
+            val_loss = run_validation(
+                state,
+                val_loader,
+                eval_step,
+                place=lambda x: jax.device_put(x, batch_sharding),
+            )
+            ppl = math.exp(min(val_loss, 30.0))
+            epoch_records.append(
+                {
+                    "epoch": epoch,
+                    "train_loss": epoch_loss,
+                    "val_loss": val_loss,
+                    "ppl": ppl,
+                    "tokens_per_s": round(tok_s, 1) if tok_s else None,
+                }
+            )
+            rate = f" ({tok_s:.0f} tok/s)" if tok_s else ""
+            log(
+                f"[gpt] epoch {epoch}: loss={epoch_loss:.4f} "
+                f"val_loss={val_loss:.4f} ppl={ppl:.2f}{rate}"
+            )
+            payload = {
+                "step": state.step,
+                "params": state.params,
+                "opt_state": state.opt_state,
+            }
+            if cfg.ema_decay > 0.0:
+                payload["ema_params"] = state.ema_params
+            mgr.save(
+                int(state.step),
+                payload,
+                metrics={
+                    "val_loss": val_loss,
+                    "train_loss": epoch_loss,
+                    "ppl": ppl,
+                },
+            )
+        mgr.wait_until_finished()
+        result = GptTrainResult(
+            checkpoint=mgr.checkpoint(),
+            loss_history=history,
+            metrics_history=epoch_records,
+        )
+        mgr.close()
+        if cfg.sample_tokens > 0:
+            result.sample = _sample_greedy(cfg, model, state.params, log)
+    return result
+
+
+def _sample_greedy(cfg, model, params, log) -> list[int]:
+    """Demonstrate the LM inference surface on the trained model: greedy
+    KV-cache decode (tpuflow.infer.generate), sharded params and all —
+    GSPMD handles the gather under jit."""
+    import jax.numpy as jnp
+
+    from tpuflow.infer import generate, render_tokens
+
+    # Byte-level corpora get a readable prompt ("The ") and a text
+    # rendering of the sample; token corpora print ids.
+    byte_level = cfg.dataset == "lm_text"
+    prompt = (
+        jnp.asarray([list(b"The ")], jnp.int32)
+        if byte_level
+        else jnp.zeros((1, 4), jnp.int32)
+    )
+    toks = generate(
+        model, params, prompt,
+        max_new_tokens=cfg.sample_tokens, temperature=0.0,
+    )
+    sample = [int(t) for t in toks[0]]
+    log(
+        "[gpt] greedy sample: "
+        f"{render_tokens(sample, byte_level=byte_level)!r}"
+    )
+    return sample
+
+
+def _train_pipeline(
+    cfg: GptTrainConfig, ckpt_dir: str, resume_checkpoint, log
+) -> GptTrainResult:
+    """GPipe pipeline-parallel training over a ('data','stage') mesh:
+    scan-stacked blocks shard by layer slice (tpuflow.parallel.pipeline),
+    grads flow through the microbatch schedule, checkpoints carry the
+    pipeline-sharded state (the raw format's shard-ownership rule covers
+    any sharding, so resume works unchanged)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuflow import dist
+    from tpuflow.ckpt import CheckpointManager, restore_from_handle
+    from tpuflow.models.gpt2 import GPT2
+    from tpuflow.parallel import gpt2_pipeline_loss, gpt2_pipeline_shardings
+
+    model_cfg = cfg.model_config()
+    mesh = dist.make_mesh({"data": cfg.data_axis, "stage": cfg.stage_axis})
+    log(
+        f"[gpt] pipeline mesh {dict(mesh.shape)}, "
+        f"microbatches={cfg.microbatches}"
+    )
+    model = GPT2(model_cfg)
+    tx = cfg.optimizer()
+    loss_fn = gpt2_pipeline_loss(
+        model_cfg, mesh=mesh, n_microbatches=cfg.microbatches
+    )
+
+    def init_params(rng):
+        return model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    with mesh:
+        p_shapes = jax.eval_shape(init_params, jax.random.PRNGKey(0))
+        shardings = gpt2_pipeline_shardings(mesh, p_shapes)
+        # Params born sharded: init is jitted with the pipeline shardings
+        # as out_shardings, so no host ever materializes the full
+        # replicated tree.
+        params = jax.jit(init_params, out_shardings=shardings)(
+            jax.random.PRNGKey(0)
+        )
+        # Optimizer state mirrors the params tree (mu/nu under the same
+        # 'h' paths → 'stage'-sharded; counts are scalars → replicated),
+        # so the same path rule shards it.
+        opt_shape = jax.eval_shape(tx.init, p_shapes)
+        opt_shardings = gpt2_pipeline_shardings(mesh, opt_shape)
+        opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
+        start_step = 0
+
+        mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
+        )
+        if resume_checkpoint is not None:
+            abstract = {
+                "step": jax.ShapeDtypeStruct((), jnp.int32),
+                "params": jax.tree_util.tree_map(
+                    lambda s, sh: jax.ShapeDtypeStruct(
+                        s.shape, s.dtype, sharding=sh
+                    ),
+                    p_shapes,
+                    shardings,
+                ),
+                "opt_state": jax.tree_util.tree_map(
+                    lambda s, sh: jax.ShapeDtypeStruct(
+                        s.shape, s.dtype, sharding=sh
+                    ),
+                    opt_shape,
+                    opt_shardings,
+                ),
+            }
+            restored = restore_from_handle(
+                resume_checkpoint, abstract_state=abstract
+            )
+            # Normalize placement: scalar/replicated leaves may come back
+            # single-device; device_put onto the target shardings is
+            # idempotent for already-placed shards.
+            params = jax.device_put(restored["params"], shardings)
+            opt_state = jax.device_put(restored["opt_state"], opt_shardings)
+            start_step = int(restored["step"])
+            log("[gpt] pipeline-sharded state restored")
+        mgr.prewarm({"params": params, "opt_state": opt_state})
+
+        # Donated params/opt_state: old and new state never coexist in HBM
+        # (matches make_train_step's donate pattern; safe because mgr.save
+        # snapshots device buffers synchronously before its async writer
+        # starts, and the loop rebinds both every step).
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def pp_step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        loader, _ = _loaders(cfg, model_cfg.vocab_size)
+        data_sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("data")
+        )
+        history = []
+        global_step = start_step
+        for epoch in range(cfg.epochs):
+            loader.set_epoch(epoch)
+            losses = []
+            for b in loader:
+                params, opt_state, loss = pp_step(
+                    params,
+                    opt_state,
+                    jax.device_put(b["x"], data_sharding),
+                    jax.device_put(b["y"], data_sharding),
+                )
+                losses.append(loss)
+                global_step += 1
+            jax.block_until_ready(params)
+            epoch_loss = float(jnp.stack(losses).mean())
+            history.append(epoch_loss)
+            log(f"[gpt] pipeline epoch {epoch}: loss={epoch_loss:.4f}")
+            mgr.save(
+                global_step,
+                {
+                    "step": jnp.int32(global_step),
+                    "params": params,
+                    "opt_state": opt_state,
+                },
+                metrics={"val_loss": epoch_loss},
+            )
+        mgr.wait_until_finished()
+        result = GptTrainResult(
+            checkpoint=mgr.checkpoint(),
+            loss_history=history,
+            metrics_history=[
+                {"epoch": i, "train_loss": l} for i, l in enumerate(history)
+            ],
+        )
+        mgr.close()
+    return result
