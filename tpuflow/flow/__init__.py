@@ -12,8 +12,16 @@ from tpuflow.flow.cards import (
     Markdown,
     Table,
     metrics_table,
+    training_curve_card,
 )
-from tpuflow.flow.client import Run, Task, namespace
+from tpuflow.flow.client import (
+    Flow,
+    Run,
+    Task,
+    default_namespace,
+    get_namespace,
+    namespace,
+)
 from tpuflow.flow.decorators import (
     card,
     device_profile,
@@ -28,7 +36,10 @@ from tpuflow.flow.spec import FlowSpec, Parameter, current, step
 
 __all__ = [
     "CardBuffer",
+    "Flow",
     "FlowSpec",
+    "default_namespace",
+    "get_namespace",
     "Image",
     "Markdown",
     "Parameter",
@@ -37,6 +48,7 @@ __all__ = [
     "Task",
     "card",
     "metrics_table",
+    "training_curve_card",
     "current",
     "device_profile",
     "kubernetes",

@@ -12,15 +12,61 @@ from typing import Any
 
 from tpuflow.flow import store
 
-_NAMESPACE: str | None = None
+# Sentinel distinguishing "never set" (default user namespace) from an
+# explicit namespace(None) (global — resolve everything), matching the
+# reference client's semantics (eval_flow.py:32-36: a namespace parameter
+# scopes which runs the client resolves; empty string = global).
+_UNSET = object()
+_NAMESPACE: Any = _UNSET
+
+
+def default_namespace() -> str:
+    """The namespace runs are produced under when none is set explicitly:
+    ``TPUFLOW_NAMESPACE`` env, else ``user:<login>`` (the Metaflow
+    convention)."""
+    ns = os.environ.get("TPUFLOW_NAMESPACE")
+    if ns:
+        return ns
+    import getpass
+
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        user = f"uid{os.getuid()}"
+    return f"user:{user}"
+
+
+def get_namespace() -> str | None:
+    """The active namespace: explicit ``namespace(...)`` value if one was
+    set this process (None = global), else the default user namespace."""
+    if _NAMESPACE is _UNSET:
+        return default_namespace()
+    return _NAMESPACE
 
 
 def namespace(ns: str | None) -> str | None:
-    """↔ metaflow.namespace(...) (eval_flow.py:36): recorded for API parity;
-    the local datastore is single-namespace, so this only tags reads."""
+    """↔ metaflow.namespace(...) (eval_flow.py:36). Scopes which runs the
+    client resolves: ``Run``/``Task``/``Flow`` raise on objects produced
+    under a different namespace. ``namespace(None)`` switches to the
+    global namespace (everything resolves)."""
     global _NAMESPACE
     _NAMESPACE = ns
     return ns
+
+
+def _check_visible(kind: str, pathspec: str, produced_ns: str | None) -> None:
+    """Raise when an object lies outside the active namespace. Runs from
+    before namespace recording (no ``namespace`` key in run.json) stay
+    visible everywhere."""
+    active = get_namespace()
+    if active is None or produced_ns is None:
+        return
+    if produced_ns != active:
+        raise KeyError(
+            f"{kind} {pathspec} belongs to namespace {produced_ns!r}, not "
+            f"the active {active!r}; call namespace({produced_ns!r}) to "
+            "read it, or namespace(None) for the global namespace"
+        )
 
 
 class _DataNamespace:
@@ -60,6 +106,11 @@ class Task:
             store.task_dir(self.flow, self.run_id, self.step, self.task_id)
         ):
             raise KeyError(f"no such task: {pathspec}")
+        try:
+            meta = store.read_run_meta(self.flow, self.run_id)
+        except (OSError, ValueError):  # missing or mid-write run.json
+            meta = {}
+        _check_visible("task", pathspec, meta.get("namespace"))
 
     @property
     def data(self) -> _DataNamespace:
@@ -81,10 +132,27 @@ class Run:
         self.pathspec = pathspec
         if not os.path.isdir(store.run_dir(self.flow, self.run_id)):
             raise KeyError(f"no such run: {pathspec}")
+        try:
+            # Cached for .meta/.successful: one read serves the namespace
+            # check and the common read-a-finished-run pattern (the
+            # latest-successful scan would otherwise parse run.json three
+            # times per candidate). .meta refreshes while non-terminal.
+            self._meta = store.read_run_meta(self.flow, self.run_id)
+        except (OSError, ValueError):  # missing or mid-write run.json
+            self._meta = {}
+        _check_visible("run", pathspec, self._meta.get("namespace"))
 
     @property
     def meta(self) -> dict:
-        return store.read_run_meta(self.flow, self.run_id)
+        # A finished run's metadata is immutable — serve the cached read.
+        # While the run is still in flight, refresh so status/steps track
+        # the live run.json (atomic replace on the writer side).
+        if self._meta.get("status") not in ("success", "failed"):
+            try:
+                self._meta = store.read_run_meta(self.flow, self.run_id)
+            except (OSError, ValueError):
+                pass
+        return self._meta
 
     @property
     def successful(self) -> bool:
@@ -108,3 +176,50 @@ class Run:
                     f"{self.flow}/{self.run_id}/{step}/{rec['head_task']}"
                 )
         raise KeyError(f"step {step!r} not found in {self.pathspec}")
+
+
+class Flow:
+    """Handle to a flow's run history: ``Flow("TpuGptTrain")`` — the
+    namespace-scoped resolution surface (↔ metaflow.Flow: the reference's
+    client resolves latest/successful runs within the active namespace,
+    eval_flow.py:32-36)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        if not os.path.isdir(store.flow_dir(name)):
+            raise KeyError(f"no such flow: {name}")
+
+    def runs(self) -> list[Run]:
+        """All resolvable runs in the ACTIVE namespace, newest first.
+        Out-of-namespace runs are skipped (not raised): enumeration is a
+        filter, only direct pathspec access is an error."""
+        out = []
+        for entry in sorted(
+            (e for e in os.listdir(store.flow_dir(self.name)) if e.isdigit()),
+            key=int,
+            reverse=True,
+        ):
+            try:
+                out.append(Run(f"{self.name}/{entry}"))
+            except KeyError:
+                continue  # other namespace, or not a run dir
+        return out
+
+    @property
+    def latest_run(self) -> Run:
+        for run in self.runs():
+            return run
+        raise KeyError(
+            f"flow {self.name} has no runs in namespace "
+            f"{get_namespace()!r}"
+        )
+
+    @property
+    def latest_successful_run(self) -> Run:
+        for run in self.runs():
+            if run.successful:
+                return run
+        raise KeyError(
+            f"flow {self.name} has no successful runs in namespace "
+            f"{get_namespace()!r}"
+        )

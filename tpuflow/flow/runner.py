@@ -121,10 +121,18 @@ class FlowRunner:
         run_id = run_id if run_id is not None else store.new_run_id(self.flow_name)
         rdir = store.run_dir(self.flow_name, run_id)
         os.makedirs(rdir, exist_ok=True)
+        from tpuflow.flow.client import default_namespace, get_namespace
+
         meta = {
             "flow": self.flow_name,
             "run_id": run_id,
             "status": "running",
+            # Runs are produced under the active namespace; the client
+            # resolves only same-namespace runs (flow.client._check_visible
+            # ↔ reference eval_flow.py:32-36). A run is always produced
+            # under a CONCRETE namespace — the global (None) scope is
+            # read-only, so it falls back to the user default.
+            "namespace": get_namespace() or default_namespace(),
             "params": {k: _jsonable(v) for k, v in params.items()},
             "started": time.time(),
             "steps": [],

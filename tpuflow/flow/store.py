@@ -78,8 +78,13 @@ def latest_run_id(flow: str) -> int | None:
 def write_run_meta(flow: str, run_id, meta: dict) -> None:
     d = run_dir(flow, run_id)
     os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "run.json"), "w") as f:
+    # Atomic replace: the client reads run.json concurrently (namespace
+    # check, latest-successful scans) and must never see a truncated file.
+    path = os.path.join(d, "run.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         json.dump(meta, f, indent=1, default=str)
+    os.replace(tmp, path)
 
 
 def read_run_meta(flow: str, run_id) -> dict:
