@@ -57,6 +57,12 @@ class TpuTrain(FlowSpec):
         help="run pathspec Flow/run to warm-start the model from",
     )
     dataset = Parameter("dataset", default="fashion_mnist", help="dataset name")
+    model = Parameter(
+        "model",
+        default="mlp",
+        help="mlp | resnet18 | resnet50 (BASELINE configs 1-2 run the "
+        "resnets through this same flow)",
+    )
 
     @step
     def start(self):
@@ -81,9 +87,11 @@ class TpuTrain(FlowSpec):
         if checkpoint is not None:
             print(f"[train_flow] warm-starting from checkpoint {checkpoint.path}")
 
-        self.result = my_tpu_module.train_fashion_mnist(
+        self.result = my_tpu_module.train_model(
             num_workers=None,  # all devices of the gang's world
             use_tpu=True,
+            model=self.model,
+            num_classes=1000 if self.dataset == "imagenet_synth" else 10,
             checkpoint_storage_path=current.tpu_storage_path,
             global_batch_size=self.batch_size,
             lr=self.learning_rate,

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Run the two medium acceptance configs FOR REAL (VERDICT r2 #8) and
+write a committed run log.
+
+- Config 5 shape: GPT-2-medium (355M params) through flows/gpt_flow.py —
+  fresh run with a sharded checkpoint, then a --from-run full-state
+  resume. Proves the medium preset compiles, checkpoints, and resumes at
+  its real parameter count (CPU, tiny step counts: this is a
+  compile/checkpoint/resume proof, not a throughput claim).
+- Config 2 shape: ResNet-50 (25.6M params) + ImageNet-shaped data
+  (224x224x3, 1000 classes) through flows/train_flow.py, gang of
+  TPUFLOW_N_PARALLEL processes, then a --from-run warm start.
+
+Writes MEDIUM_RUNS.md at the repo root with wall-clocks, parameter
+counts, and checkpoint bytes, then leaves committing to the caller.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOME = os.environ.get("MEDIUM_RUNS_HOME", "/tmp/tpuflow_medium_runs")
+
+
+def run(cmd: list[str], env: dict, timeout: float = 3600):
+    t0 = time.monotonic()
+    p = subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    dt = time.monotonic() - t0
+    sys.stderr.write(p.stdout[-2000:] + p.stderr[-2000:])
+    if p.returncode != 0:
+        raise RuntimeError(f"{' '.join(cmd)} failed rc={p.returncode}")
+    return dt, p.stdout + p.stderr
+
+
+def du_bytes(path: str) -> int:
+    total = 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def newest_ckpt_dir(flow: str) -> str:
+    base = os.path.join(HOME, "flows", flow)
+    runs = sorted(
+        (d for d in os.listdir(base) if d.isdigit()), key=int
+    )
+    return os.path.join(base, runs[-1], "tpu_storage")
+
+
+def main() -> int:
+    import shutil
+
+    shutil.rmtree(HOME, ignore_errors=True)
+    env = {
+        **os.environ,
+        "TPUFLOW_FORCE_CPU": "1",
+        "TPUFLOW_HOME": HOME,
+        "TPUFLOW_DATA_DIR": "/tmp/tpuflow_medium_data",
+    }
+    lines = [
+        "# Medium-config run log (committed evidence for VERDICT r2 #8)",
+        "",
+        f"Host: 1-core dev VM, CPU platform (8 virtual devices), "
+        f"{time.strftime('%Y-%m-%d')}. Tiny step counts on purpose: these "
+        "runs prove compile + sharded checkpoint + resume at REAL "
+        "parameter counts, not throughput.",
+        "",
+    ]
+
+    # ---- GPT-2-medium (355M), FSDP mesh data=2 x fsdp=4 ----------------
+    gpt_cmd = [
+        sys.executable, "flows/gpt_flow.py", "run",
+        "--preset", "medium", "--epochs", "1", "--steps-per-epoch", "1",
+        "--batch-size", "2", "--seq-len", "128",
+        "--data-axis", "2", "--fsdp-axis", "4",
+    ]
+    dt, out = run(gpt_cmd, env, timeout=5400)
+    m = re.search(r"run (TpuGptTrain/\d+) succeeded", out)
+    if not m:
+        raise RuntimeError("gpt medium run did not succeed")
+    gpt_run = m.group(1)
+    ppl = re.search(r"val_loss=([0-9.]+)", out)
+    ck = newest_ckpt_dir("TpuGptTrain")
+    ck_bytes = du_bytes(ck)
+    lines += [
+        "## GPT-2-medium (acceptance config 5 shape, CPU)",
+        "",
+        f"- fresh run `{' '.join(gpt_cmd[1:])}` -> {gpt_run}:",
+        f"  wall {dt:.0f}s, val_loss {ppl.group(1) if ppl else 'n/a'}",
+        f"- checkpoint: {ck_bytes / 2**30:.2f} GiB on disk "
+        "(355M params f32 + adamw moments, fully sharded over the "
+        "2x4 data/fsdp mesh)",
+    ]
+    dt2, out2 = run(
+        [sys.executable, "flows/gpt_flow.py", "run",
+         "--preset", "medium", "--epochs", "1", "--steps-per-epoch", "1",
+         "--batch-size", "2", "--seq-len", "128",
+         "--data-axis", "2", "--fsdp-axis", "4",
+         "--from-run", gpt_run, "--decay-steps", "4"],
+        env, timeout=5400,
+    )
+    if "full sharded state restored" not in out2:
+        raise RuntimeError("gpt medium resume did not restore full state")
+    m2 = re.search(r"run (TpuGptTrain/\d+) succeeded", out2)
+    lines += [
+        f"- `--from-run {gpt_run}` resume -> {m2.group(1)}: wall {dt2:.0f}s, "
+        "full sharded state (step + params + opt_state) restored",
+        "",
+    ]
+
+    # ---- ResNet-50 / ImageNet-shaped (config 2), 2-process gang --------
+    env_rn = {
+        **env,
+        "TPUFLOW_N_PARALLEL": "2",
+        "TPUFLOW_GANG_LOCAL_DEVICES": "4",
+        "TPUFLOW_SYNTH_TRAIN_N": "16",
+        "TPUFLOW_SYNTH_TEST_N": "8",
+    }
+    rn_cmd = [
+        sys.executable, "flows/train_flow.py", "run",
+        "--model", "resnet50", "--dataset", "imagenet_synth",
+        "--epochs", "1", "--batch-size", "8",
+    ]
+    dt3, out3 = run(rn_cmd, env_rn, timeout=5400)
+    m3 = re.search(r"run (TpuTrain/\d+) succeeded", out3)
+    if not m3:
+        raise RuntimeError("resnet50 run did not succeed")
+    rn_run = m3.group(1)
+    ck_rn = newest_ckpt_dir("TpuTrain")
+    lines += [
+        "## ResNet-50 / ImageNet-shaped (acceptance config 2 shape, CPU)",
+        "",
+        f"- fresh run `{' '.join(rn_cmd[1:])}` (2-process gang x 4 devices, "
+        f"batch 224x224x3, 1000 classes) -> {rn_run}: wall {dt3:.0f}s",
+        f"- checkpoint: {du_bytes(ck_rn) / 2**20:.0f} MiB on disk "
+        "(25.6M params + SGD momentum)",
+    ]
+    dt4, out4 = run(
+        rn_cmd + ["--from-run", rn_run], env_rn, timeout=5400
+    )
+    if "warm-start" not in out4:
+        raise RuntimeError("resnet50 resume did not warm-start")
+    m4 = re.search(r"run (TpuTrain/\d+) succeeded", out4)
+    lines += [
+        f"- `--from-run {rn_run}` warm start -> {m4.group(1)}: "
+        f"wall {dt4:.0f}s, best weights restored into the gang",
+        "",
+    ]
+
+    with open(os.path.join(REPO, "MEDIUM_RUNS.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
