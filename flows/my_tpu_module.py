@@ -82,7 +82,9 @@ def _build_model(config: dict):
     configs name ResNet-18/50 beyond the reference's MLP, BASELINE.md)."""
     name = config.get("model", "mlp")
     kwargs = dict(config.get("model_kwargs") or {})
-    kwargs.setdefault("num_classes", config.get("num_classes", 10))
+    # None = size the head from the dataset registry (the worker resolves
+    # it off the loader before building the model).
+    kwargs.setdefault("num_classes", config.get("num_classes") or 10)
     if name in ("resnet18", "resnet50"):
         # CIFAR-sized inputs use the 3x3 stem unless told otherwise.
         kwargs.setdefault("small_inputs", config.get("dataset") != "imagenet_synth")
@@ -135,6 +137,13 @@ def train_func_per_worker(config: dict) -> None:
             weights_only=config.get("resume") != "full",
         )
 
+    if not config.get("num_classes"):
+        # Size the head from the dataset registry (carried on the loader)
+        # instead of a per-call-site dataset-name table.
+        config = {
+            **config,
+            "num_classes": getattr(train_loader, "num_classes", 10),
+        }
     model = _build_model(config)
     tx = optax.sgd(lr, momentum=0.9)  # parity: my_ray_module.py:142
     sample = np.zeros(
@@ -243,7 +252,7 @@ def train_model(
     *,
     model: str = "mlp",
     model_kwargs: dict | None = None,
-    num_classes: int = 10,
+    num_classes: int | None = None,  # None = from the dataset registry
     checkpoint_storage_path: str | None = None,
     global_batch_size: int = 32,
     lr: float = 1e-3,

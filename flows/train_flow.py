@@ -90,8 +90,7 @@ class TpuTrain(FlowSpec):
         self.result = my_tpu_module.train_model(
             num_workers=None,  # all devices of the gang's world
             use_tpu=True,
-            model=self.model,
-            num_classes=1000 if self.dataset == "imagenet_synth" else 10,
+            model=self.model,  # head sized from the dataset registry
             checkpoint_storage_path=current.tpu_storage_path,
             global_batch_size=self.batch_size,
             lr=self.learning_rate,

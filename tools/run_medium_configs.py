@@ -24,7 +24,12 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-HOME = os.environ.get("MEDIUM_RUNS_HOME", "/tmp/tpuflow_medium_runs")
+HOME = os.environ.get(
+    "MEDIUM_RUNS_HOME",
+    "/dev/shm/tpuflow_medium_runs"
+    if os.path.isdir("/dev/shm")
+    else "/tmp/tpuflow_medium_runs",
+)
 
 
 def run(cmd: list[str], env: dict, timeout: float = 3600):
@@ -83,7 +88,7 @@ def main() -> int:
     gpt_cmd = [
         sys.executable, "flows/gpt_flow.py", "run",
         "--preset", "medium", "--epochs", "1", "--steps-per-epoch", "1",
-        "--batch-size", "2", "--seq-len", "128",
+        "--batch-size", "8", "--seq-len", "64",
         "--data-axis", "2", "--fsdp-axis", "4",
     ]
     dt, out = run(gpt_cmd, env, timeout=5400)
@@ -106,7 +111,7 @@ def main() -> int:
     dt2, out2 = run(
         [sys.executable, "flows/gpt_flow.py", "run",
          "--preset", "medium", "--epochs", "1", "--steps-per-epoch", "1",
-         "--batch-size", "2", "--seq-len", "128",
+         "--batch-size", "8", "--seq-len", "64",
          "--data-axis", "2", "--fsdp-axis", "4",
          "--from-run", gpt_run, "--decay-steps", "4"],
         env, timeout=5400,
@@ -114,11 +119,17 @@ def main() -> int:
     if "full sharded state restored" not in out2:
         raise RuntimeError("gpt medium resume did not restore full state")
     m2 = re.search(r"run (TpuGptTrain/\d+) succeeded", out2)
+    if not m2:
+        raise RuntimeError("gpt medium resume run did not succeed")
     lines += [
         f"- `--from-run {gpt_run}` resume -> {m2.group(1)}: wall {dt2:.0f}s, "
         "full sharded state (step + params + opt_state) restored",
         "",
     ]
+    # The GPT run dirs hold ~3.4 GiB of sharded state each on tmpfs —
+    # reclaim before the ResNet leg so the script can't exhaust /dev/shm.
+    shutil.rmtree(os.path.join(HOME, "flows", "TpuGptTrain"),
+                  ignore_errors=True)
 
     # ---- ResNet-50 / ImageNet-shaped (config 2), 2-process gang --------
     env_rn = {
@@ -153,11 +164,14 @@ def main() -> int:
     if "warm-start" not in out4:
         raise RuntimeError("resnet50 resume did not warm-start")
     m4 = re.search(r"run (TpuTrain/\d+) succeeded", out4)
+    if not m4:
+        raise RuntimeError("resnet50 warm-start run did not succeed")
     lines += [
         f"- `--from-run {rn_run}` warm start -> {m4.group(1)}: "
         f"wall {dt4:.0f}s, best weights restored into the gang",
         "",
     ]
+    shutil.rmtree(HOME, ignore_errors=True)  # reclaim tmpfs
 
     with open(os.path.join(REPO, "MEDIUM_RUNS.md"), "w") as f:
         f.write("\n".join(lines) + "\n")

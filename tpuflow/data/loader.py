@@ -213,6 +213,9 @@ def get_dataloaders(
         pad_tail=True,
         drop_last=False,
     )
+    # The registry's class count rides on the loaders so trainers can size
+    # model heads from the data instead of re-deriving per dataset name.
+    val.num_classes = ds.num_classes
     if val_only:
         return val
     train = ShardedLoader(
@@ -223,4 +226,5 @@ def get_dataloaders(
         shard_index=shard_index,
         num_shards=num_shards,
     )
+    train.num_classes = ds.num_classes
     return train, val

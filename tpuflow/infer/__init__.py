@@ -1,12 +1,17 @@
 """Batch inference engine (replaces Ray Data map_batches actor inference)
 plus autoregressive KV-cache generation for the LM family."""
 
-from tpuflow.infer.engine import BatchPredictor, map_batches
+from tpuflow.infer.engine import (
+    BatchPredictor,
+    GenerationPredictor,
+    map_batches,
+)
 from tpuflow.infer.generate import generate, pad_ragged, render_tokens
 from tpuflow.infer.score import best_of_n, sequence_logprob
 
 __all__ = [
     "BatchPredictor",
+    "GenerationPredictor",
     "best_of_n",
     "generate",
     "map_batches",

@@ -100,6 +100,117 @@ class BatchPredictor:
         }
 
 
+class GenerationPredictor:
+    """Stateful LM generation actor for ``map_batches``: weights loaded
+    once, each batch of (possibly ragged) prompt rows decodes in ONE
+    KV-cache program (tpuflow.infer.generate with ``prompt_lens``).
+
+    The LM-family completion of the engine parity: the reference's
+    ``map_batches`` takes ragged rows (eval_flow.py:85-90) because Ray
+    moves Python objects; under XLA the raggedness is absorbed here by
+    left-pad + mask, token-exactly (pinned against per-row dense calls).
+
+    ``pad_to`` fixes the padded prompt width across batches so XLA
+    compiles one program for the whole stream; default pads each batch to
+    its own max length (one compile per distinct width).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        eos_id: int | None = None,
+        pad_id: int = 0,
+        pad_to: int | None = None,
+        rng=None,
+    ):
+        self.model = model
+        self.params = params
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.pad_to = pad_to
+        # Advanced per __call__ (split): batches sample independently; the
+        # same construction-time seed still reproduces the whole stream.
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    @classmethod
+    def from_checkpoint(
+        cls, checkpoint: Checkpoint, model, *, subtree=None,
+        zero_copy: bool = False, **kw,
+    ) -> "GenerationPredictor":
+        """Weights-only restore at construction (↔ the stateful-actor
+        load-once semantics, my_ray_module.py:268-273); ``subtree``
+        selects e.g. ``("ema_params",)``."""
+        params = restore_from_handle(
+            checkpoint, weights_only=True, subtree=subtree,
+            zero_copy=zero_copy,
+        )
+        return cls(model, params, **kw)
+
+    def __call__(self, batch: dict) -> dict:
+        from tpuflow.infer.generate import generate, pad_ragged
+
+        tokens = batch["tokens"]
+        if isinstance(tokens, np.ndarray) and tokens.ndim == 2:
+            # A batch whose rows HAPPEN to be equal-length still honors
+            # pad_to below (lens = full width per row), so the stream-wide
+            # single-program contract holds for it too.
+            prompt = tokens.astype(np.int32)
+            lens = None
+        else:
+            prompt, lens = pad_ragged(tokens, pad_id=self.pad_id)
+        if self.pad_to is not None:
+            if prompt.shape[1] > self.pad_to:
+                raise ValueError(
+                    f"a prompt of length {prompt.shape[1]} exceeds "
+                    f"pad_to={self.pad_to}"
+                )
+            extra = self.pad_to - prompt.shape[1]
+            if extra:
+                if lens is None:
+                    lens = np.full(
+                        (prompt.shape[0],), prompt.shape[1], np.int32
+                    )
+                prompt = np.concatenate(
+                    [np.full((prompt.shape[0], extra), self.pad_id, np.int32),
+                     prompt],
+                    axis=1,
+                )
+        self._rng, sub = jax.random.split(self._rng)
+        out = generate(
+            self.model,
+            self.params,
+            prompt,
+            prompt_lens=lens,
+            max_new_tokens=self.max_new_tokens,
+            temperature=self.temperature,
+            top_k=self.top_k,
+            top_p=self.top_p,
+            eos_id=self.eos_id,
+            pad_id=self.pad_id,
+            rng=sub,
+        )
+        return {"generated": np.asarray(out, np.int32)}
+
+
+def _collate(vals: list) -> object:
+    """Stack same-shape row values into one array; keep ragged values as a
+    list (a ragged-aware predictor — GenerationPredictor — left-pads)."""
+    arrays = [np.asarray(v) for v in vals]
+    if len({a.shape for a in arrays}) == 1:
+        return np.stack(arrays)
+    return arrays
+
+
 def map_batches(
     rows: Sequence[dict],
     predictor: Callable[[dict], dict],
@@ -112,7 +223,8 @@ def map_batches(
 
     The final ragged batch is padded up to ``batch_size`` by repeating its
     last row, then the outputs are trimmed — the jitted forward sees a single
-    static shape.
+    static shape. Rows whose values differ in shape (ragged token prompts)
+    are passed to the predictor as lists instead of stacked arrays.
     """
     rows = list(rows)
     if not rows:
@@ -124,7 +236,7 @@ def map_batches(
         n = len(chunk)
         if n < batch_size:
             chunk = chunk + [chunk[-1]] * (batch_size - n)
-        batch = {k: np.stack([np.asarray(r[k]) for r in chunk]) for k in keys}
+        batch = {k: _collate([r[k] for r in chunk]) for k in keys}
         out = predictor(batch)
         for i in range(n):
             out_rows.append({k: np.asarray(v)[i] for k, v in out.items()})
