@@ -57,31 +57,34 @@ class TpuEval(FlowSpec):
         "eval_namespace", default="", help="namespace to read artifacts from"
     )
     batch_size = Parameter("batch_size", default=512, help="inference batch size")
-    dataset = Parameter("dataset", default="fashion_mnist", help="dataset name")
+    dataset = Parameter(
+        "dataset",
+        default="",
+        help="dataset name (default: the producing run's dataset_used)",
+    )
 
-    def _get_checkpoint(self):
+    def _get_source(self):
         """↔ eval_flow.py:40-54: trigger run first, then explicit pathspecs,
         else raise.
 
-        Returns ``(checkpoint, producer_finished)`` — when the producing run
-        has succeeded, no process can still be writing/recycling its
-        checkpoint directory, which licenses the zero-copy (mmap) weight
-        load in the predictor.
+        Returns ``(run, checkpoint, producer_finished)`` — the producing
+        run handle carries the model/dataset artifacts this flow rebuilds
+        from; when the run has succeeded, no process can still be
+        writing/recycling its checkpoint directory, which licenses the
+        zero-copy (mmap) weight load in the predictor.
         """
         if current.trigger is not None and current.trigger.run is not None:
             run = current.trigger.run
-            return run.data.result.best_checkpoint, run.successful
+            return run, run.data.result.best_checkpoint, run.successful
         if self.eval_namespace:
             namespace(self.eval_namespace)  # ↔ eval_flow.py:32-36
         if self.checkpoint_task_pathspec:
             task = Task(self.checkpoint_task_pathspec)
-            return (
-                task.data.result.best_checkpoint,
-                Run(f"{task.flow}/{task.run_id}").successful,
-            )
+            run = Run(f"{task.flow}/{task.run_id}")
+            return run, task.data.result.best_checkpoint, run.successful
         if self.checkpoint_run_pathspec:
             run = Run(self.checkpoint_run_pathspec)
-            return run.data.result.best_checkpoint, run.successful
+            return run, run.data.result.best_checkpoint, run.successful
         raise ValueError(
             "no checkpoint source: run with --triggered after a TpuTrain run, "
             "or pass --checkpoint-run-pathspec / --checkpoint-task-pathspec"
@@ -97,19 +100,38 @@ class TpuEval(FlowSpec):
 
         import my_tpu_module
 
-        checkpoint, producer_finished = self._get_checkpoint()
-        print(f"[eval_flow] evaluating checkpoint {checkpoint.path}")
+        run, checkpoint, producer_finished = self._get_source()
+        # Model/dataset come from the producing run's artifacts (older
+        # runs without them default to the reference pair).
+        model_name = getattr(run.data, "model_used", "mlp")
+        dataset = self.dataset or getattr(
+            run.data, "dataset_used", "fashion_mnist"
+        )
+        self.dataset_used = dataset
+        print(
+            f"[eval_flow] evaluating checkpoint {checkpoint.path} "
+            f"(model={model_name}, dataset={dataset})"
+        )
+        from tpuflow.data.datasets import dataset_info
+
+        info = dataset_info(dataset)
 
         # Test set as rows (↔ get_dataloaders(val_only=True, as_ray_ds=True),
         # eval_flow.py:83) → stateful predictor over fixed batches
         # (↔ map_batches, eval_flow.py:85-90).
         rows = my_tpu_module.get_dataloaders(
-            self.batch_size, dataset=self.dataset, as_rows=True
+            self.batch_size, dataset=dataset, as_rows=True
         )
         # zero_copy weight load is sound only once the producing run is
         # finished (no writer can recycle its checkpoint files anymore).
         predictor = my_tpu_module.TpuPredictor(
-            checkpoint, zero_copy=producer_finished
+            checkpoint,
+            zero_copy=producer_finished,
+            model=my_tpu_module.build_model(
+                model_name, dataset=dataset,
+                num_classes=info["num_classes"],
+            ),
+            sample_shape=info["shape"],
         )
         outputs = my_tpu_module.map_batches(
             rows, predictor, batch_size=self.batch_size
@@ -130,7 +152,7 @@ class TpuEval(FlowSpec):
         )
 
         # Error-analysis card (↔ eval_flow.py:96-139).
-        labels_map = my_tpu_module.get_labels_map(self.dataset)
+        labels_map = my_tpu_module.get_labels_map(dataset)
         current.card.append(Markdown("# Error analysis"))
         current.card.append(
             Markdown(
@@ -152,15 +174,27 @@ class TpuEval(FlowSpec):
                 features = np.asarray(rows[idx]["features"])
                 logits = np.asarray(outputs[idx]["logits"], dtype=np.float32)
                 fig_img, ax = plt.subplots(figsize=(1.6, 1.6))
-                ax.imshow(features.reshape(28, 28), cmap="gray")
+                img_arr = (
+                    features if features.ndim >= 2 else features.reshape(28, 28)
+                )
+                if img_arr.ndim == 3:  # RGB: rescale normalized floats
+                    lo, hi = float(img_arr.min()), float(img_arr.max())
+                    img_arr = (img_arr - lo) / max(hi - lo, 1e-6)
+                ax.imshow(img_arr, cmap=None if img_arr.ndim == 3 else "gray")
                 ax.axis("off")
                 img = Image.from_matplotlib(fig_img)
                 plt.close(fig_img)
+                # Wide heads (e.g. 1000 classes) chart only their top-10
+                # logits; 10-class heads keep the full reference chart.
+                if len(logits) > 16:
+                    top = np.argsort(logits)[-10:]
+                else:
+                    top = np.arange(len(logits))
                 fig_bar, ax = plt.subplots(figsize=(3.2, 1.6))
-                ax.barh(range(len(logits)), logits)
-                ax.set_yticks(range(len(logits)))
+                ax.barh(range(len(top)), logits[top])
+                ax.set_yticks(range(len(top)))
                 ax.set_yticklabels(
-                    [labels_map[i] for i in range(len(logits))], fontsize=5
+                    [labels_map[int(i)] for i in top], fontsize=5
                 )
                 bar = Image.from_matplotlib(fig_bar)
                 plt.close(fig_bar)

@@ -77,6 +77,21 @@ def set_weights_from_checkpoint(state, checkpoint: Checkpoint):
     return state.replace(params=params)
 
 
+def build_model(name: str = "mlp", *, dataset: str = "fashion_mnist",
+                num_classes: int | None = None, **model_kwargs):
+    """Public model rebuild for consumers outside the worker loop (the
+    eval flow reconstructs the producing run's model from its artifacts).
+    Same pluggable zoo as training (↔ acceptance configs, BASELINE.md)."""
+    return _build_model(
+        {
+            "model": name,
+            "dataset": dataset,
+            "num_classes": num_classes,
+            "model_kwargs": model_kwargs or None,
+        }
+    )
+
+
 def _build_model(config: dict):
     """Models are pluggable behind the same trainer API (the acceptance
     configs name ResNet-18/50 beyond the reference's MLP, BASELINE.md)."""
@@ -332,6 +347,7 @@ class TpuPredictor:
 
 __all__ = [
     "TpuPredictor",
+    "build_model",
     "get_dataloaders",
     "get_labels_map",
     "map_batches",

@@ -86,7 +86,14 @@ class TpuTrain(FlowSpec):
             checkpoint = Run(self.from_run).data.result.checkpoint
         if checkpoint is not None:
             print(f"[train_flow] warm-starting from checkpoint {checkpoint.path}")
+        # Recorded so consumers (and the medium-config evidence script) can
+        # verify a warm start without scraping gang-subprocess stdout.
+        self.warm_started = checkpoint is not None
 
+        # Cross-flow handoff artifacts: the eval flow rebuilds THIS model
+        # for THIS dataset (the checkpoint handle alone carries neither).
+        self.model_used = self.model
+        self.dataset_used = self.dataset
         self.result = my_tpu_module.train_model(
             num_workers=None,  # all devices of the gang's world
             use_tpu=True,

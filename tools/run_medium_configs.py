@@ -24,12 +24,25 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-HOME = os.environ.get(
-    "MEDIUM_RUNS_HOME",
-    "/dev/shm/tpuflow_medium_runs"
-    if os.path.isdir("/dev/shm")
-    else "/tmp/tpuflow_medium_runs",
-)
+
+
+def _default_home() -> str:
+    """tmpfs when it can actually hold the runs (~7 GiB of GPT-2-medium
+    sharded state at peak, fresh + resume dirs coexisting), else /tmp —
+    containers commonly mount a 64 MiB /dev/shm."""
+    try:
+        import shutil as _sh
+
+        if os.path.isdir("/dev/shm") and (
+            _sh.disk_usage("/dev/shm").free > 24 * 2**30
+        ):
+            return "/dev/shm/tpuflow_medium_runs"
+    except OSError:
+        pass
+    return "/tmp/tpuflow_medium_runs"
+
+
+HOME = os.environ.get("MEDIUM_RUNS_HOME", _default_home())
 
 
 def run(cmd: list[str], env: dict, timeout: float = 3600):
@@ -161,11 +174,22 @@ def main() -> int:
     dt4, out4 = run(
         rn_cmd + ["--from-run", rn_run], env_rn, timeout=5400
     )
-    if "warm-start" not in out4:
-        raise RuntimeError("resnet50 resume did not warm-start")
     m4 = re.search(r"run (TpuTrain/\d+) succeeded", out4)
     if not m4:
         raise RuntimeError("resnet50 warm-start run did not succeed")
+    # The warm-start print happens inside a gang subprocess (not in the
+    # CLI's stdout); check the recorded artifact instead.
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from tpuflow.flow import Run; "
+         f"print(bool(Run({m4.group(1)!r}).data.warm_started))"],
+        env=env_rn, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    if probe.stdout.strip() != "True":
+        raise RuntimeError(
+            f"resnet50 resume did not warm-start: {probe.stdout!r} "
+            f"{probe.stderr[-500:]!r}"
+        )
     lines += [
         f"- `--from-run {rn_run}` warm start -> {m4.group(1)}: "
         f"wall {dt4:.0f}s, best weights restored into the gang",

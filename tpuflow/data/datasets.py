@@ -46,6 +46,28 @@ FASHION_MNIST_CLASSES = [
 ]
 
 
+# Canonical per-dataset spec: the loaders below AND out-of-band consumers
+# (eval flows, predictors sizing a model before touching rows) read from
+# this one table — add a dataset here first.
+_DATASET_SPECS = {
+    "fashion_mnist": {"shape": (28, 28), "num_classes": 10},
+    "mnist": {"shape": (28, 28), "num_classes": 10},
+    "cifar10": {"shape": (32, 32, 3), "num_classes": 10},
+    "imagenet_synth": {"shape": (224, 224, 3), "num_classes": 1000},
+}
+
+
+def dataset_info(name: str) -> dict:
+    """Registry metadata without materializing the data: sample shape and
+    class count."""
+    if name not in _DATASET_SPECS:
+        raise KeyError(
+            f"no registry metadata for dataset {name!r}; known: "
+            f"{sorted(_DATASET_SPECS)}"
+        )
+    return _DATASET_SPECS[name]
+
+
 def get_labels_map(dataset: str = "fashion_mnist") -> dict[int, str]:
     """class-id → human name for card rendering (parity:
     my_ray_module.py:79-91 get_labels_map)."""
@@ -68,6 +90,9 @@ def get_labels_map(dataset: str = "fashion_mnist") -> dict[int, str]:
                 ]
             )
         )
+    if dataset == "imagenet_synth":
+        # Synthetic classes have no human names; ids render as class_<i>.
+        return {i: f"class_{i}" for i in range(1000)}
     raise KeyError(dataset)
 
 
@@ -297,10 +322,12 @@ def _load_cifar10(data_dir: str) -> Dataset:
         )
     n_train = int(os.environ.get("TPUFLOW_SYNTH_TRAIN_N", 50_000))
     n_test = int(os.environ.get("TPUFLOW_SYNTH_TEST_N", 10_000))
+    spec = _DATASET_SPECS["cifar10"]
     train, test = _synth_classification(
-        seed=30, n_train=n_train, n_test=n_test, shape=(32, 32, 3), num_classes=10
+        seed=30, n_train=n_train, n_test=n_test, shape=spec["shape"],
+        num_classes=spec["num_classes"],
     )
-    return Dataset("cifar10", train, test, 10, synthetic=True)
+    return Dataset("cifar10", train, test, spec["num_classes"], synthetic=True)
 
 
 def _load_synthetic_imagenet(size: int) -> Dataset:
@@ -308,16 +335,19 @@ def _load_synthetic_imagenet(size: int) -> Dataset:
     ResNet-50 acceptance config; sized down by default to fit dev machines.
     TPUFLOW_SYNTH_TRAIN_N/TPUFLOW_SYNTH_TEST_N override, same knobs as the
     other synthetic fallbacks."""
+    spec = _DATASET_SPECS["imagenet_synth"]
     train, test = _synth_classification(
         seed=40,
         n_train=int(os.environ.get("TPUFLOW_SYNTH_TRAIN_N", size)),
         n_test=int(
             os.environ.get("TPUFLOW_SYNTH_TEST_N", max(size // 10, 100))
         ),
-        shape=(224, 224, 3),
-        num_classes=1000,
+        shape=spec["shape"],
+        num_classes=spec["num_classes"],
     )
-    return Dataset("imagenet_synth", train, test, 1000, synthetic=True)
+    return Dataset(
+        "imagenet_synth", train, test, spec["num_classes"], synthetic=True
+    )
 
 
 def load_dataset(

@@ -35,13 +35,22 @@ class BatchPredictor:
     ``{"logits": float32, "predicted_values": argmax}``.
     """
 
-    def __init__(self, model, params, *, mesh=None):
+    def __init__(self, model, params, *, batch_stats=None, mesh=None):
         self.model = model
         self.params = params
+        self.batch_stats = batch_stats or None
         self.mesh = mesh if mesh is not None else dist.make_mesh()
-        self._forward = jax.jit(
-            lambda params, x: model.apply({"params": params}, x, train=False)
-        )
+
+        def fwd(params, batch_stats, x):
+            variables = {"params": params}
+            if batch_stats is not None:
+                # BatchNorm models infer with their RUNNING statistics
+                # (train=False selects them); without the collection the
+                # apply would fail — see from_checkpoint's subtree restore.
+                variables["batch_stats"] = batch_stats
+            return model.apply(variables, x, train=False)
+
+        self._forward = jax.jit(fwd)
 
     @classmethod
     def from_checkpoint(
@@ -70,20 +79,49 @@ class BatchPredictor:
         """
         mesh = mesh if mesh is not None else dist.make_mesh()
         abstract = None
+        abstract_stats = None
+        var_shapes = None
         if sample_input is not None:
-            shapes = jax.eval_shape(
+            var_shapes = jax.eval_shape(
                 model.init, jax.random.PRNGKey(0), sample_input
-            )["params"]
-            sharding = dist.replicated(mesh)
-            abstract = jax.tree_util.tree_map(
-                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
-                shapes,
             )
+            sharding = dist.replicated(mesh)
+
+            def _abs(s):
+                return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding)
+
+            abstract = jax.tree_util.tree_map(_abs, var_shapes["params"])
+            if var_shapes.get("batch_stats"):
+                abstract_stats = jax.tree_util.tree_map(
+                    _abs, var_shapes["batch_stats"]
+                )
         params = restore_from_handle(
             checkpoint, weights_only=True, abstract_state=abstract,
             zero_copy=zero_copy,
         )
-        return cls(model, params, mesh=mesh)
+        # BatchNorm running statistics live beside the weights in the
+        # checkpoint (my_tpu_module._state_tree); restore them when the
+        # model has the collection. A KeyError = the checkpoint carries no
+        # batch_stats subtree: fatal when the model is KNOWN to need it
+        # (inference without running stats would fail later, worse-labeled,
+        # inside model.apply), tolerated only when no sample_input told us
+        # the model's collections. Other errors (format, corruption)
+        # propagate untouched.
+        batch_stats = None
+        if var_shapes is None or var_shapes.get("batch_stats"):
+            try:
+                batch_stats = restore_from_handle(
+                    checkpoint, subtree=("batch_stats",),
+                    abstract_state=abstract_stats, zero_copy=zero_copy,
+                )
+            except KeyError:
+                if var_shapes is not None:
+                    raise KeyError(
+                        "model has a batch_stats collection (BatchNorm) but "
+                        f"checkpoint {checkpoint.path} carries no "
+                        "batch_stats subtree — it cannot serve inference"
+                    ) from None
+        return cls(model, params, batch_stats=batch_stats, mesh=mesh)
 
     def __call__(self, batch: dict) -> dict:
         x = np.asarray(batch["features"])
@@ -92,7 +130,7 @@ class BatchPredictor:
         while x.ndim > 0 and x.shape[0] == 1 and x.ndim > 3:
             x = x[0]
         placed = dist.shard_batch({"x": x}, self.mesh)
-        logits = self._forward(self.params, placed["x"])
+        logits = self._forward(self.params, self.batch_stats, placed["x"])
         logits = np.asarray(logits, dtype=np.float32)
         return {
             "logits": logits,
