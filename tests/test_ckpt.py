@@ -511,3 +511,51 @@ def test_save_dtype_halves_bytes_and_restores_to_template(tmp_path):
     assert half.restore_metadata(1)["save_dtype"] == "bfloat16"
     full.close()
     half.close()
+
+
+def test_concurrent_restores_are_serialized_and_correct(tmp_path):
+    """Two threads restoring DIFFERENT checkpoints concurrently (with a
+    prewarm for one issued mid-flight) must both get exact bytes — the
+    process-wide restore lock + landed-only arena cleanup (ADVICE r2 #4)
+    protect the global RestoreArena hand-off."""
+    import threading
+
+    import numpy as np
+
+    from tpuflow.ckpt import CheckpointManager
+
+    rng = np.random.default_rng(7)
+    payloads, mgrs = [], []
+    for i in range(2):
+        state = {"w": rng.standard_normal((64, 1024)).astype(np.float32)}
+        mgr = CheckpointManager(str(tmp_path / f"ck{i}"), max_to_keep=1)
+        mgr.save(1, state)
+        mgr.wait_until_finished()
+        payloads.append(state)
+        mgrs.append(mgr)
+
+    results: dict[int, np.ndarray] = {}
+    errors: list[BaseException] = []
+
+    def restore(i: int):
+        try:
+            mgrs[i].prewarm_restore(1, background=True)
+            out = mgrs[i].restore(1)
+            results[i] = np.asarray(out["w"])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=restore, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i in range(2):
+        np.testing.assert_array_equal(results[i], payloads[i]["w"])
+    for m in mgrs:
+        m.close()
+    # Terminal reclamation: nothing left pinned in the process arena.
+    from tpuflow.ckpt import raw as raw_fmt
+
+    assert raw_fmt._ARENA._buffers == {}

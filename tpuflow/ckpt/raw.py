@@ -347,6 +347,10 @@ class RestoreArena:
         self._buffers: dict[int, list[np.ndarray]] = {}
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
+        # Serializes background-prewarm spawns: without it two concurrent
+        # prewarm() calls can race on self._thread and join a thread that
+        # was created but not yet started.
+        self._spawn_lock = threading.Lock()
 
     def prewarm(self, sizes: list[int], *, background: bool = True) -> None:
         """Allocate + page-back one buffer per entry of ``sizes``."""
@@ -364,20 +368,31 @@ class RestoreArena:
                     self._buffers.setdefault(s, []).append(buf)
 
         if background:
-            self.prewarm_wait()  # one prewarm in flight at a time
-            self._thread = threading.Thread(
-                target=_run, name="tpuflow-restore-arena", daemon=True
-            )
-            self._thread.start()
+            with self._spawn_lock:  # one prewarm in flight at a time
+                prev = self._thread
+                if prev is not None:
+                    prev.join()
+                t = threading.Thread(
+                    target=_run, name="tpuflow-restore-arena", daemon=True
+                )
+                t.start()  # started BEFORE publication: joiners never see
+                self._thread = t  # an unstarted thread
         else:
             _run()
 
     def prewarm_wait(self, timeout: float | None = None) -> None:
-        t = self._thread
+        with self._spawn_lock:
+            t = self._thread
         if t is not None:
             t.join(timeout)
             if not t.is_alive():
-                self._thread = None
+                with self._spawn_lock:
+                    # Compare-and-swap: never clobber a spawn published
+                    # after our read — losing the only reference to an
+                    # in-flight prewarm would let clear() skip its join
+                    # and leak the buffers it lands afterwards.
+                    if self._thread is t:
+                        self._thread = None
 
     def take(self, nbytes: int) -> np.ndarray | None:
         """Pop a pre-backed buffer of exactly ``nbytes``, else None."""
