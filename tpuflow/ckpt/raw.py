@@ -368,15 +368,24 @@ class RestoreArena:
                     self._buffers.setdefault(s, []).append(buf)
 
         if background:
-            with self._spawn_lock:  # one prewarm in flight at a time
-                prev = self._thread
-                if prev is not None:
-                    prev.join()
-                t = threading.Thread(
-                    target=_run, name="tpuflow-restore-arena", daemon=True
-                )
-                t.start()  # started BEFORE publication: joiners never see
-                self._thread = t  # an unstarted thread
+            # One prewarm in flight at a time. The join of the previous
+            # thread happens OUTSIDE the lock (it can last a multi-GB
+            # page-touch), so prewarm_wait's brief locked read stays
+            # bounded; the loop re-checks after joining because another
+            # spawner may have won the slot meanwhile.
+            while True:
+                with self._spawn_lock:
+                    prev = self._thread
+                    if prev is None or not prev.is_alive():
+                        t = threading.Thread(
+                            target=_run,
+                            name="tpuflow-restore-arena",
+                            daemon=True,
+                        )
+                        t.start()  # started BEFORE publication: joiners
+                        self._thread = t  # never see an unstarted thread
+                        return
+                prev.join()
         else:
             _run()
 

@@ -1,6 +1,7 @@
 """Batch inference engine (replaces Ray Data map_batches actor inference)
 plus autoregressive KV-cache generation for the LM family."""
 
+from tpuflow.infer.beam import beam_search
 from tpuflow.infer.engine import (
     BatchPredictor,
     GenerationPredictor,
@@ -12,6 +13,7 @@ from tpuflow.infer.score import best_of_n, sequence_logprob
 __all__ = [
     "BatchPredictor",
     "GenerationPredictor",
+    "beam_search",
     "best_of_n",
     "generate",
     "map_batches",

@@ -1,0 +1,227 @@
+"""Beam-search decoding: deterministic width-K search over the KV cache.
+
+The deterministic sibling of ``best_of_n`` (sampling + rerank): at every
+step each batch row keeps its K highest-scoring continuations. TPU shape:
+beams ride the batch dimension (B*K rows through the same one-program
+cached decode as ``generate``), the per-step beam reorder is a gather on
+the cache's batch axis, and the whole search — prefill, cache tiling,
+scan of (forward, top-k, reorder) steps, backtrack — compiles to ONE XLA
+program. The reference has no generation path at all (its predictor is a
+single classifier forward, my_ray_module.py:275-284); this completes the
+LM inference surface next to sampling (generate), scoring
+(sequence_logprob), and reranking (best_of_n).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _cache_batch_axis(model) -> int:
+    """Axis of the batch dimension in cache leaves: 0 normally, 1 under
+    ``scan_layers`` (nn.scan stacks a leading layer axis onto every cache
+    variable — a shape heuristic would silently tile the LAYER axis
+    whenever n_layer happened to equal the batch size)."""
+    return 1 if getattr(model.config, "scan_layers", False) else 0
+
+
+def _tile_cache(cache, k: int, batch: int, axis: int):
+    """Repeat cache leaves K-fold along the batch axis (B -> B*K); leaves
+    without that axis (scalar/per-layer indices) pass through untouched."""
+    return jax.tree_util.tree_map(
+        lambda c: jnp.repeat(c, k, axis=axis)
+        if c.ndim > axis and c.shape[axis] == batch
+        else c,
+        cache,
+    )
+
+
+def _gather_beams(cache, flat_parent, rows: int, axis: int):
+    """Reorder cache rows to the chosen parents (beam switch)."""
+    return jax.tree_util.tree_map(
+        lambda c: jnp.take(c, flat_parent, axis=axis)
+        if c.ndim > axis and c.shape[axis] == rows
+        else c,
+        cache,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("beam_size", "max_new_tokens", "eos_id", "pad_id"),
+)
+def _beam_jit(
+    model,
+    params,
+    prompt,
+    pad_lens=None,
+    *,
+    beam_size: int,
+    max_new_tokens: int,
+    eos_id: int | None,
+    pad_id: int,
+    length_penalty: float = 1.0,
+):
+    B, T = prompt.shape
+    K = beam_size
+
+    # Prefill ONCE at width B, then tile the cache K-fold — K x cheaper
+    # than prefilling B*K identical prompts.
+    logits, vars_out = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"],
+        pad_lens=pad_lens,
+    )
+    axis = _cache_batch_axis(model)
+    cache = _tile_cache(vars_out["cache"], K, B, axis)
+    tiled_pad_lens = (
+        jnp.repeat(pad_lens, K, axis=0) if pad_lens is not None else None
+    )
+
+    logprobs = jax.nn.log_softmax(logits[:, -1, :].astype(jnp.float32))
+    V = logprobs.shape[-1]
+    # Step 0: the top-K first tokens seed the beams.
+    scores, tok0 = jax.lax.top_k(logprobs, K)          # (B, K)
+    tok0 = tok0.astype(jnp.int32)
+    done = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B, K), bool)
+    lengths = jnp.ones((B, K), jnp.int32)
+
+    def step(carry, _):
+        cache, tok, scores, done, lengths = carry
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache},
+            tok.reshape(B * K)[:, None],
+            decode=True,
+            mutable=["cache"],
+            pad_lens=tiled_pad_lens,
+        )
+        cache = vars_out["cache"]
+        lp = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32)
+        ).reshape(B, K, V)
+        # Finished beams extend ONLY with pad at zero cost — they keep
+        # their score and stay comparable against live beams.
+        if eos_id is not None:
+            frozen = jnp.full((V,), _NEG).at[pad_id].set(0.0)
+            lp = jnp.where(done[..., None], frozen[None, None, :], lp)
+        total = scores[..., None] + lp                  # (B, K, V)
+        flat = total.reshape(B, K * V)
+        scores, idx = jax.lax.top_k(flat, K)            # (B, K)
+        parent = (idx // V).astype(jnp.int32)
+        token = (idx % V).astype(jnp.int32)
+        flat_parent = (
+            jnp.arange(B, dtype=jnp.int32)[:, None] * K + parent
+        ).reshape(-1)
+        cache = _gather_beams(cache, flat_parent, B * K, axis)
+        done = jnp.take_along_axis(done, parent, axis=1)
+        lengths = jnp.take_along_axis(lengths, parent, axis=1) + jnp.where(
+            done, 0, 1
+        )
+        if eos_id is not None:
+            done = done | (token == eos_id)
+        token = jnp.where(done & (token != eos_id), pad_id, token)
+        return (cache, token, scores, done, lengths), (parent, token)
+
+    if max_new_tokens > 1:
+        (cache, tok, scores, done, lengths), (parents, tokens) = jax.lax.scan(
+            step,
+            (cache, tok0, scores, done, lengths),
+            None,
+            length=max_new_tokens - 1,
+        )
+        # Backtrack: follow each surviving beam's parent chain from the
+        # last step to the first (reverse scan), then prepend step 0.
+        def back(beam_idx, y):
+            parent, token = y
+            t = jnp.take_along_axis(token, beam_idx, axis=1)
+            return jnp.take_along_axis(parent, beam_idx, axis=1), t
+
+        root, toks_rev = jax.lax.scan(
+            back,
+            jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K)),
+            (parents, tokens),
+            reverse=True,
+        )
+        first = jnp.take_along_axis(tok0, root, axis=1)  # (B, K)
+        seqs = jnp.concatenate(
+            [first[None], toks_rev], axis=0
+        )  # (M, B, K)
+        seqs = jnp.moveaxis(seqs, 0, 2)  # (B, K, M)
+    else:
+        seqs = tok0[..., None]
+
+    # Rank by length-normalized score (GNMT-style penalty; 1.0 = plain
+    # mean-free total logprob over real tokens).
+    norm = jnp.power(lengths.astype(jnp.float32), length_penalty)
+    ranked = scores / jnp.maximum(norm, 1.0)
+    best = jnp.argmax(ranked, axis=1)
+    rows = jnp.arange(B)
+    return (
+        seqs[rows, best],            # (B, max_new_tokens)
+        ranked[rows, best],          # (B,)
+        seqs,                        # (B, K, M) all beams
+        ranked,                      # (B, K)
+    )
+
+
+def beam_search(
+    model,
+    params,
+    prompt,
+    *,
+    beam_size: int,
+    max_new_tokens: int,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    length_penalty: float = 1.0,
+    prompt_lens=None,
+    return_all: bool = False,
+):
+    """Deterministic beam-search continuation of ``prompt`` (B, T) int32.
+
+    Returns ``(tokens (B, max_new_tokens), scores (B,))`` — the best beam
+    per row under a GNMT-style length penalty (``scores`` are total token
+    logprob / length**penalty; eos-frozen tails contribute nothing) — or,
+    with ``return_all``, ``(tokens, scores, all_tokens (B, K, M),
+    all_scores (B, K))``. ``beam_size=1`` equals greedy decoding exactly.
+    Ragged prompts ride ``prompt_lens`` exactly as in ``generate``.
+    """
+    from tpuflow.infer.generate import prompt_lens_to_pad_lens
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if length_penalty < 0:
+        raise ValueError(
+            f"length_penalty must be >= 0, got {length_penalty} (negative "
+            "penalties would be silently neutralized by the norm clamp)"
+        )
+    n_ctx = model.config.n_ctx
+    if T + max_new_tokens > n_ctx:
+        raise ValueError(
+            f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
+            f"the model's n_ctx={n_ctx} (the KV cache size)"
+        )
+    pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
+    best, best_scores, all_seqs, all_scores = _beam_jit(
+        model,
+        params,
+        prompt,
+        pad_lens,
+        beam_size=beam_size,
+        max_new_tokens=max_new_tokens,
+        eos_id=eos_id,
+        pad_id=pad_id,
+        length_penalty=length_penalty,
+    )
+    if return_all:
+        return best, best_scores, all_seqs, all_scores
+    return best, best_scores

@@ -70,6 +70,7 @@ def _sample(
     static_argnums=(0,),
     static_argnames=(
         "max_new_tokens", "greedy", "top_k", "use_top_p", "eos_id", "pad_id",
+        "prefill_chunk",
     ),
 )
 def _generate_jit(
@@ -87,17 +88,40 @@ def _generate_jit(
     use_top_p: bool,
     eos_id: int | None,
     pad_id: int,
+    prefill_chunk: int | None = None,
 ):
     # pad_lens None-vs-array is itself a jit specialization boundary (pytree
     # structure), so dense batches compile the fast T x T prefill path.
     B, T = prompt.shape
 
-    # Prefill: one pass over the prompt initializes + fills the caches.
-    logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"],
-        pad_lens=pad_lens,
-    )
-    cache = vars_out["cache"]
+    if prefill_chunk is None or prefill_chunk >= T:
+        # Prefill: one pass over the prompt initializes + fills the caches.
+        logits, vars_out = model.apply(
+            {"params": params}, prompt, decode=True, mutable=["cache"],
+            pad_lens=pad_lens,
+        )
+        cache = vars_out["cache"]
+    else:
+        # Chunked prefill for long prompts: fixed-size slices stream into
+        # the cache (static chunk count — at most two distinct widths
+        # compile), bounding the largest attention-score tensor to
+        # (B, H, chunk, n_ctx) instead of (B, H, T, T). Chunks after the
+        # first hit the warm cache at start > 0, which the model computes
+        # exactly (masked full-cache attention behind the lax.cond in
+        # Block._cached_attention).
+        cache = None
+        for start in range(0, T, prefill_chunk):
+            chunk = prompt[:, start:start + prefill_chunk]
+            variables = (
+                {"params": params}
+                if cache is None
+                else {"params": params, "cache": cache}
+            )
+            logits, vars_out = model.apply(
+                variables, chunk, decode=True, mutable=["cache"],
+                pad_lens=pad_lens,
+            )
+            cache = vars_out["cache"]
     rng, sub = jax.random.split(rng)
     # Left-padding puts every row's last REAL token in the last column, so
     # logits[:, -1] is the right next-token distribution for dense and
@@ -152,6 +176,29 @@ def render_tokens(ids, *, byte_level: bool = False) -> str:
     return " ".join(str(t) for t in ids)
 
 
+def prompt_lens_to_pad_lens(prompt_lens, batch: int, width: int):
+    """Validate a ``prompt_lens`` (B,) array against a LEFT-padded batch of
+    ``width`` columns and return the pad-count tensor the model consumes
+    (``None`` passes through). One validator shared by every inference
+    entry point (generate / beam_search / sequence_logprob) so the
+    contract can't drift between them."""
+    if prompt_lens is None:
+        return None
+    import numpy as np
+
+    lens = np.asarray(prompt_lens, np.int32)
+    if lens.shape != (batch,):
+        raise ValueError(
+            f"prompt_lens shape {lens.shape} != (batch,) = ({batch},)"
+        )
+    if (lens < 1).any() or (lens > width).any():
+        raise ValueError(
+            f"prompt_lens must be in [1, {width}], got "
+            f"[{lens.min()}, {lens.max()}]"
+        )
+    return jnp.asarray(width - lens, jnp.int32)
+
+
 def pad_ragged(prompts, *, pad_id: int = 0):
     """LEFT-pad a list of variable-length token sequences to one (B, Tmax)
     int32 array. Returns ``(prompt, prompt_lens)`` — pass both to
@@ -187,6 +234,7 @@ def generate(
     pad_id: int = 0,
     rng=None,
     prompt_lens=None,
+    prefill_chunk: int | None = None,
 ):
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, T) int32.
 
@@ -202,6 +250,11 @@ def generate(
     positions are row-shifted, so mixed-length batches decode token-exactly
     vs per-row dense calls (parity bar: the reference's engine takes ragged
     rows, reference eval_flow.py:85-90).
+
+    ``prefill_chunk`` streams the prompt into the cache in fixed-size
+    slices (long-context prefill: peak attention memory drops from
+    O(T^2) to O(chunk x n_ctx) per layer, exactness unchanged — chunks
+    after the first run masked full-cache attention).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
@@ -218,21 +271,9 @@ def generate(
             f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
             f"the model's n_ctx={n_ctx} (the KV cache size)"
         )
-    pad_lens = None
-    if prompt_lens is not None:
-        import numpy as np
-
-        lens = np.asarray(prompt_lens, np.int32)
-        if lens.shape != (B,):
-            raise ValueError(
-                f"prompt_lens shape {lens.shape} != (batch,) = ({B},)"
-            )
-        if (lens < 1).any() or (lens > T).any():
-            raise ValueError(
-                f"prompt_lens must be in [1, {T}], got "
-                f"[{lens.min()}, {lens.max()}]"
-            )
-        pad_lens = jnp.asarray(T - lens, jnp.int32)
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(
@@ -249,4 +290,5 @@ def generate(
         use_top_p=top_p is not None,
         eos_id=eos_id,
         pad_id=pad_id,
+        prefill_chunk=prefill_chunk,
     )

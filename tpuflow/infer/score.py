@@ -138,15 +138,11 @@ def sequence_logprob(
     if prompt_lens is not None:
         if pad_lens is not None:
             raise ValueError("pass prompt_lens or pad_lens, not both")
-        import numpy as np
+        from tpuflow.infer.generate import prompt_lens_to_pad_lens
 
-        lens = np.asarray(prompt_lens, np.int32)
-        if (lens < 1).any() or (lens > T).any():
-            raise ValueError(
-                f"prompt_lens must be in [1, {T}], got "
-                f"[{lens.min()}, {lens.max()}]"
-            )
-        pad_lens = T - lens
+        pad_lens = prompt_lens_to_pad_lens(
+            prompt_lens, tokens.shape[0], T
+        )
     elif pad_lens is not None:
         import numpy as np
 
