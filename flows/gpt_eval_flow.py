@@ -50,6 +50,11 @@ class TpuGptEval(FlowSpec):
     sample_tokens = Parameter(
         "sample_tokens", default=32, help="tokens to generate per sample"
     )
+    beam_size = Parameter(
+        "beam_size",
+        default=1,
+        help="add a width-K beam-search sample to the card (1 = off)",
+    )
     weights = Parameter(
         "weights",
         default="raw",
@@ -198,6 +203,20 @@ class TpuGptEval(FlowSpec):
             )
             for t in (0.7, 1.0)
         ]
+        if int(self.beam_size) > 1:
+            from tpuflow.infer import beam_search
+
+            toks, score = beam_search(
+                model, params, prompt, beam_size=int(self.beam_size),
+                max_new_tokens=n_new,
+            )
+            self.samples.append(
+                (
+                    f"beam K={int(self.beam_size)} "
+                    f"({float(score[0]):.3f} nats/tok)",
+                    render(toks),
+                )
+            )
         for name, text in self.samples:
             print(f"[gpt_eval] sample ({name}): {text!r}")
 

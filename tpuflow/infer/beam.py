@@ -191,7 +191,10 @@ def beam_search(
     all_scores (B, K))``. ``beam_size=1`` equals greedy decoding exactly.
     Ragged prompts ride ``prompt_lens`` exactly as in ``generate``.
     """
-    from tpuflow.infer.generate import prompt_lens_to_pad_lens
+    from tpuflow.infer.generate import (
+        check_cache_capacity,
+        prompt_lens_to_pad_lens,
+    )
 
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
@@ -204,12 +207,7 @@ def beam_search(
             f"length_penalty must be >= 0, got {length_penalty} (negative "
             "penalties would be silently neutralized by the norm clamp)"
         )
-    n_ctx = model.config.n_ctx
-    if T + max_new_tokens > n_ctx:
-        raise ValueError(
-            f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
-            f"the model's n_ctx={n_ctx} (the KV cache size)"
-        )
+    check_cache_capacity(model, T, max_new_tokens)
     pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
     best, best_scores, all_seqs, all_scores = _beam_jit(
         model,

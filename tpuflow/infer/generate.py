@@ -176,6 +176,17 @@ def render_tokens(ids, *, byte_level: bool = False) -> str:
     return " ".join(str(t) for t in ids)
 
 
+def check_cache_capacity(model, width: int, max_new_tokens: int) -> None:
+    """Shared n_ctx guard for every decode entry point: prompt + new
+    tokens must fit the model's fixed KV-cache size."""
+    n_ctx = model.config.n_ctx
+    if width + max_new_tokens > n_ctx:
+        raise ValueError(
+            f"prompt length {width} + max_new_tokens {max_new_tokens} "
+            f"exceeds the model's n_ctx={n_ctx} (the KV cache size)"
+        )
+
+
 def prompt_lens_to_pad_lens(prompt_lens, batch: int, width: int):
     """Validate a ``prompt_lens`` (B,) array against a LEFT-padded batch of
     ``width`` columns and return the pad-count tensor the model consumes
@@ -258,7 +269,6 @@ def generate(
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
-    n_ctx = model.config.n_ctx
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
@@ -266,13 +276,13 @@ def generate(
             f"top_p must be in (0, 1], got {top_p} (<= 0 would mask every "
             "token)"
         )
-    if T + max_new_tokens > n_ctx:
-        raise ValueError(
-            f"prompt length {T} + max_new_tokens {max_new_tokens} exceeds "
-            f"the model's n_ctx={n_ctx} (the KV cache size)"
-        )
+    check_cache_capacity(model, T, max_new_tokens)
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    if prefill_chunk is not None and prefill_chunk >= T:
+        # Same program as unchunked — normalize so the jit cache doesn't
+        # hold duplicate compilations keyed on a no-op chunk width.
+        prefill_chunk = None
     pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
     if rng is None:
         rng = jax.random.PRNGKey(0)
