@@ -60,8 +60,8 @@ class TpuTrain(FlowSpec):
     model = Parameter(
         "model",
         default="mlp",
-        help="mlp | resnet18 | resnet50 (BASELINE configs 1-2 run the "
-        "resnets through this same flow)",
+        help="mlp | resnet18 | resnet50 | vit | vit_tiny | vit_small "
+        "(BASELINE configs 1-2 run the resnets through this same flow)",
     )
 
     @step

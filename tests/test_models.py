@@ -256,3 +256,46 @@ def test_moe_aux_loss_survives_scan_layers():
             state, batch, jax.random.PRNGKey(2)
         )
     assert np.asarray(metrics["loss"]).shape == ()  # scalar despite stack
+
+
+def test_vit_forward_and_train_step():
+    """ViT family: grayscale 28x28 through the attention-stack classifier —
+    forward shape, a finite train step, loss decreases over a few steps on
+    a learnable batch (pure params: no batch_stats)."""
+    model = get_model(
+        "vit", num_classes=10, n_embd=64, n_layer=2, n_head=2, patch_size=4
+    )
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)), optax.adam(1e-3)
+    )
+    assert not state.batch_stats  # LayerNorm-only
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.normal(size=(16, 28, 28)).astype(np.float32),
+        "y": (np.arange(16) % 10).astype(np.int32),
+    }
+    step = make_train_step(donate=False)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch, jax.random.PRNGKey(1))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # memorizes a fixed batch
+
+    logits = model.apply({"params": state.params}, batch["x"][:3])
+    assert logits.shape == (3, 10)
+
+
+def test_vit_registry_presets_and_validation():
+    import pytest as _pytest
+
+    from tpuflow.models.vit import ViT
+
+    tiny = get_model("vit_tiny", num_classes=7)
+    assert tiny.n_embd == 192 and tiny.patch_size == 16 and tiny.num_classes == 7
+    small = get_model("vit_small")
+    assert small.n_embd == 384 and small.n_head == 6
+    with _pytest.raises(ValueError, match="patch_size"):
+        ViT(patch_size=5).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 28, 28))
+        )

@@ -481,12 +481,34 @@ def _dtype_str(d) -> str:
 
 
 def _gather_host(tree):
-    """Synchronous device→host stage: (path, full_shape, dtype, shards).
+    """Device→host stage: (path, full_shape, dtype, shards).
 
     Every process lists every leaf (the pytree is global), each with only
-    its locally-owned shards — possibly none on this process."""
+    its locally-owned shards — possibly none on this process.
+
+    All owned shards start their device→host copies ASYNC up front, then
+    materialize in order: on real accelerators the DMA of shard N+1
+    overlaps the numpy materialization of shard N instead of each
+    ``np.asarray`` paying a serial round trip (a no-op on the CPU
+    backend, where the buffers are already host-resident)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    prefetch = True
+    for _, leaf in leaves:
+        if not prefetch:
+            break
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            for shard in leaf.addressable_shards:
+                if shard.replica_id == 0:
+                    try:
+                        shard.data.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        # Platform without async D2H: abandon the whole
+                        # prefetch (not just this leaf) — the sync path
+                        # below handles everything.
+                        prefetch = False
+                        break
     out = []
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+    for path, leaf in leaves:
         shards = _leaf_shards(leaf)
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
             shape, dtype = list(leaf.shape), _dtype_str(leaf.dtype)

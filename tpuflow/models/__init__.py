@@ -27,6 +27,21 @@ def get_model(name: str, **kwargs):
         if name != "gpt2":
             kwargs.setdefault("config", GPT2Config.medium())
         return GPT2(**kwargs)
+    if name in ("vit", "vit_tiny", "vit_small"):
+        from tpuflow.models.vit import ViT
+
+        if name == "vit_tiny":  # ViT-Ti/16
+            for k, v in dict(
+                n_embd=192, n_layer=12, n_head=3, patch_size=16
+            ).items():
+                kwargs.setdefault(k, v)
+        elif name == "vit_small":  # ViT-S/16
+            for k, v in dict(
+                n_embd=384, n_layer=12, n_head=6, patch_size=16
+            ).items():
+                kwargs.setdefault(k, v)
+        return ViT(**kwargs)
     raise KeyError(
-        f"unknown model {name!r}; available: mlp, resnet18, resnet50, gpt2, gpt2_medium"
+        f"unknown model {name!r}; available: mlp, resnet18, resnet50, "
+        "gpt2, gpt2_medium, vit, vit_tiny, vit_small"
     )

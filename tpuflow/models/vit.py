@@ -1,0 +1,121 @@
+"""Vision Transformer (ViT) classifier — the attention-stack image family.
+
+Beyond the reference's model layer (a fixed MLP, my_ray_module.py:94-112)
+and the convolutional zoo: patches embed with one strided conv (an MXU
+matmul), the encoder reuses the same pluggable attention dispatch as the
+LM family (``tpuflow.ops.attention`` — xla | Pallas flash | ring |
+ulysses), and classification reads a learned CLS token. LayerNorm-only
+(no BatchNorm state), so checkpoints are pure params and the model
+composes with every trainer/eval path unchanged.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.ops import attention
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer encoder block (bidirectional attention)."""
+
+    n_embd: int
+    n_head: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        B, T, C = x.shape
+        head_dim = self.n_embd // self.n_head
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * self.n_embd, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, self.n_head, head_dim)
+        k = k.reshape(B, T, self.n_head, head_dim)
+        v = v.reshape(B, T, self.n_head, head_dim)
+        a = attention(q, k, v, causal=False, impl=self.attn_impl)
+        a = a.reshape(B, T, self.n_embd)
+        a = nn.Dense(self.n_embd, dtype=self.dtype, name="proj")(a)
+        a = nn.Dropout(self.dropout, deterministic=not train)(a)
+        x = x + a
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        h = nn.Dense(
+            self.mlp_ratio * self.n_embd, dtype=self.dtype, name="mlp_fc"
+        )(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.n_embd, dtype=self.dtype, name="mlp_proj")(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Images (B, H, W[, C]) → logits (B, num_classes).
+
+    ``patch_size`` must divide H and W. Defaults are a small config that
+    trains on the bundled 28/32-pixel datasets; pass ``n_embd``/``n_layer``
+    /``n_head``/``patch_size`` for standard sizes (ViT-S/16 = 384/12/6
+    at patch 16).
+    """
+
+    num_classes: int = 10
+    patch_size: int = 4
+    n_embd: int = 192
+    n_layer: int = 6
+    n_head: int = 3
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        if x.ndim == 3:  # (B, H, W) grayscale → add channel dim
+            x = x[..., None]
+        B, H, W, C = x.shape
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(
+                f"patch_size {p} must divide the image size ({H}x{W})"
+            )
+        # Patch embedding: one strided conv = a (p*p*C -> n_embd) matmul
+        # per patch, MXU-shaped.
+        x = nn.Conv(
+            self.n_embd, (p, p), strides=(p, p), dtype=self.dtype,
+            name="patch_embed",
+        )(x.astype(self.dtype))
+        x = x.reshape(B, -1, self.n_embd)
+        n_tok = x.shape[1]
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.n_embd), jnp.float32
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (B, 1, self.n_embd)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, n_tok + 1, self.n_embd),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.n_layer):
+            x = EncoderBlock(
+                self.n_embd,
+                self.n_head,
+                mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                attn_impl=self.attn_impl,
+                name=f"block{i}",
+            )(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # Classify from the CLS token; float32 logits for a stable softmax.
+        return nn.Dense(self.num_classes, name="head")(
+            x[:, 0].astype(jnp.float32)
+        )
