@@ -61,7 +61,10 @@ class TpuTrain(FlowSpec):
         "model",
         default="mlp",
         help="mlp | resnet18 | resnet50 | vit | vit_tiny | vit_small "
-        "(BASELINE configs 1-2 run the resnets through this same flow)",
+        "(BASELINE configs 1-2 run the resnets through this same flow; "
+        "the vit_tiny/vit_small patch-16 presets need images patch-16 "
+        "divides, e.g. imagenet_synth — use 'vit' for the 28/32-pixel "
+        "datasets)",
     )
 
     @step
