@@ -664,3 +664,22 @@ def test_beam_search_scan_layers_matches_greedy():
         model, params, prompt, beam_size=3, max_new_tokens=4, return_all=True
     )
     assert (np.diff(np.asarray(all_s), axis=1) <= 1e-6).all()
+
+
+def test_eos_while_loop_path_matches_scan_path():
+    """The data-dependent while_loop decode (eos set) must emit exactly the
+    scan decode's tokens when the eos never fires — the two code paths may
+    only differ in trip count, never content."""
+    model, params = _model()
+    prompt = np.arange(2 * 5, dtype=np.int32).reshape(2, 5) % 512
+    plain = np.asarray(
+        generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+    )
+    absent = next(t for t in range(512) if t not in set(plain.ravel()))
+    with_eos = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=8, temperature=0.0,
+            eos_id=absent,
+        )
+    )
+    np.testing.assert_array_equal(with_eos, plain)

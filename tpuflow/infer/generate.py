@@ -6,10 +6,14 @@ is the TPU-native shape of that loop:
 
 - **Prefill** runs the whole prompt through the model once in decode mode,
   filling every block's fixed-size KV cache (one compile, MXU-batched).
-- **Decode** is a ``lax.scan`` over single-token steps — cache, current
-  token, rng, and done-mask ride the carry, so the entire generation is ONE
-  jitted XLA program: no per-token Python dispatch, no dynamic shapes, no
-  host↔device chatter until the final tokens come back.
+- **Decode** loops single-token steps — cache, current token, rng, and
+  done-mask ride the carry, so the entire generation is ONE jitted XLA
+  program: no per-token Python dispatch, no dynamic shapes, no host↔device
+  chatter until the final tokens come back. Without an eos it is a
+  ``lax.scan`` (static trip count); with ``eos_id`` it is a
+  ``lax.while_loop`` that exits as soon as every row has finished (the
+  output buffer stays statically shaped, unreached positions hold
+  ``pad_id``).
 - Sampling is temperature / top-k / top-p categorical (greedy at
   temperature=0),
   with an EOS done-mask that freezes finished rows to ``pad_id``.
@@ -136,8 +140,7 @@ def _generate_jit(
         tok == eos_id if eos_id is not None else jnp.zeros((B,), bool)
     )
 
-    def step(carry, _):
-        cache, tok, rng, done = carry
+    def decode_one(cache, tok, rng, done):
         logits, vars_out = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
@@ -153,14 +156,43 @@ def _generate_jit(
         nxt = jnp.where(done, pad_id, sampled)
         if eos_id is not None:
             done = done | (sampled == eos_id)
-        return (vars_out["cache"], nxt, rng, done), tok
+        return vars_out["cache"], nxt, rng, done
 
     if max_new_tokens == 1:
         return tok[:, None]
-    (_, last, _, _), toks = jax.lax.scan(
-        step, (cache, tok, rng, done), None, length=max_new_tokens - 1
+
+    if eos_id is None:
+        def step(carry, _):
+            cache, tok, rng, done = carry
+            new_cache, nxt, rng, done = decode_one(cache, tok, rng, done)
+            return (new_cache, nxt, rng, done), tok
+
+        (_, last, _, _), toks = jax.lax.scan(
+            step, (cache, tok, rng, done), None, length=max_new_tokens - 1
+        )
+        return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+    # With an eos the trip count is data-dependent: a while_loop exits as
+    # soon as EVERY row has finished, instead of burning the full
+    # max_new_tokens steps (output identical — unreached positions stay
+    # pad_id, exactly what the frozen rows would have emitted).
+    out0 = jnp.full((B, max_new_tokens), pad_id, jnp.int32)
+    out0 = jax.lax.dynamic_update_slice(out0, tok[:, None], (0, 0))
+
+    def cond(state):
+        i, _, _, _, _, done = state
+        return (i < max_new_tokens) & ~jnp.all(done)
+
+    def body(state):
+        i, out, cache, tok, rng, done = state
+        cache, nxt, rng, done = decode_one(cache, tok, rng, done)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        return i + 1, out, cache, nxt, rng, done
+
+    _, out, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(1), out0, cache, tok, rng, done)
     )
-    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return out
 
 
 def render_tokens(ids, *, byte_level: bool = False) -> str:
