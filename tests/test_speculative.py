@@ -1,0 +1,150 @@
+"""Prompt-lookup speculative decoding (tpuflow.infer.speculative).
+
+The load-bearing assert: speculative greedy decode must be TOKEN-EXACT vs
+plain generate(temperature=0) on every input — repetitive, random, batched,
+eos-terminated — regardless of how good the drafts are (drafts only change
+how many forwards it takes, never the tokens)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.infer import generate, speculative_generate
+from tpuflow.models.gpt2 import GPT2, GPT2Config
+
+
+def _model(**kw):
+    cfg = GPT2Config.small_test(n_ctx=256, dropout=0.0, **kw)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.mark.parametrize("max_new", [1, 7, 20])
+def test_token_exact_vs_greedy(max_new):
+    model, params = _model()
+    rng = np.random.default_rng(0)
+    cases = [
+        np.tile(np.array([5, 6, 7, 8], np.int32), (2, 8)),   # repetitive
+        rng.integers(0, 512, size=(2, 24)).astype(np.int32),  # random
+        rng.integers(0, 512, size=(3, 10)).astype(np.int32),  # odd batch
+    ]
+    for prompt in cases:
+        want = np.asarray(
+            generate(
+                model, params, prompt, max_new_tokens=max_new,
+                temperature=0.0,
+            )
+        )
+        got = np.asarray(
+            speculative_generate(
+                model, params, prompt, max_new_tokens=max_new
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_token_exact_with_scan_layers_and_draft_sweep():
+    """Exactness holds for every draft_len/ngram (they only change the
+    iteration count) and under the scan_layers cache layout (per-layer
+    index vectors reset by the rewind)."""
+    model, params = _model(scan_layers=True)
+    prompt = np.tile(np.array([9, 10, 11], np.int32), (2, 5))
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=11, temperature=0.0)
+    )
+    for draft_len, ngram in ((1, 2), (4, 3), (10, 4)):
+        got = np.asarray(
+            speculative_generate(
+                model, params, prompt, max_new_tokens=11,
+                draft_len=draft_len, ngram=ngram,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_eos_semantics_match_generate():
+    model, params = _model()
+    prompt = np.ones((2, 6), np.int32)
+    first = int(
+        np.asarray(
+            generate(model, params, prompt, max_new_tokens=1, temperature=0.0)
+        )[0, 0]
+    )
+    want = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=10, temperature=0.0,
+            eos_id=first, pad_id=0,
+        )
+    )
+    got = np.asarray(
+        speculative_generate(
+            model, params, prompt, max_new_tokens=10, eos_id=first, pad_id=0
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_validation_errors():
+    model, params = _model()
+    prompt = np.ones((1, 8), np.int32)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        speculative_generate(model, params, prompt, max_new_tokens=0)
+    with pytest.raises(ValueError, match="draft_len"):
+        speculative_generate(
+            model, params, prompt, max_new_tokens=4, draft_len=0
+        )
+    with pytest.raises(ValueError, match="ngram"):
+        speculative_generate(
+            model, params, prompt, max_new_tokens=4, ngram=1
+        )
+    with pytest.raises(ValueError, match="n_ctx"):
+        speculative_generate(model, params, prompt, max_new_tokens=512)
+    with pytest.raises(ValueError, match="match key"):
+        speculative_generate(
+            model, params, prompt[:, :1], max_new_tokens=4, ngram=3
+        )
+
+
+def test_heterogeneous_eos_rows_finish_at_different_steps():
+    """Rows that hit eos at DIFFERENT iterations — the per-row done
+    freeze (a_row=K override), min-advance under a mixed done mask, and
+    pad emission for long-done rows must all match generate() exactly."""
+    model, params = _model()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 512, size=(2, 12)).astype(np.int32)
+    M = 14
+    plain = np.asarray(
+        generate(model, params, prompt, max_new_tokens=M, temperature=0.0)
+    )
+
+    def first_pos(row, tok):
+        hits = np.nonzero(row == tok)[0]
+        return int(hits[0]) if len(hits) else M + 99
+
+    # Find an eos whose first occurrence differs across the two rows
+    # (one row finishes earlier — possibly much earlier — than the other).
+    eos = None
+    best_gap = 0
+    for tok in set(plain.ravel().tolist()):
+        gap = abs(first_pos(plain[0], tok) - first_pos(plain[1], tok))
+        if gap > best_gap:
+            best_gap, eos = gap, int(tok)
+    assert eos is not None and best_gap >= 1, (
+        "degenerate model output; pick another seed"
+    )
+    want = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=M, temperature=0.0,
+            eos_id=eos, pad_id=0,
+        )
+    )
+    got = np.asarray(
+        speculative_generate(
+            model, params, prompt, max_new_tokens=M, eos_id=eos, pad_id=0
+        )
+    )
+    np.testing.assert_array_equal(got, want)

@@ -9,6 +9,7 @@ from tpuflow.infer.engine import (
 )
 from tpuflow.infer.generate import generate, pad_ragged, render_tokens
 from tpuflow.infer.score import best_of_n, sequence_logprob
+from tpuflow.infer.speculative import speculative_generate
 
 __all__ = [
     "BatchPredictor",
@@ -20,4 +21,5 @@ __all__ = [
     "pad_ragged",
     "render_tokens",
     "sequence_logprob",
+    "speculative_generate",
 ]

@@ -208,6 +208,15 @@ def render_tokens(ids, *, byte_level: bool = False) -> str:
     return " ".join(str(t) for t in ids)
 
 
+def after_first_true(flags):
+    """(…, T) bool → True at positions STRICTLY after the first True along
+    the last axis. The one eos-freeze mask shared by scoring, speculative
+    decoding, and rerank — the token-exactness contract between them
+    depends on all three using identical semantics."""
+    f = flags.astype(jnp.int32)
+    return (jnp.cumsum(f, axis=-1) - f) > 0
+
+
 def check_cache_capacity(model, width: int, max_new_tokens: int) -> None:
     """Shared n_ctx guard for every decode entry point: prompt + new
     tokens must fit the model's fixed KV-cache size."""

@@ -82,9 +82,11 @@ def best_of_n(
     cont_mask = jnp.ones((B * n, max_new_tokens), jnp.float32)
     if eos_id is not None:
         # Score through the first eos (inclusive); freeze-padded tail out.
-        is_eos = (conts == eos_id).astype(jnp.int32)
-        eos_strictly_before = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
-        cont_mask = jnp.where(eos_strictly_before, 0.0, cont_mask)
+        from tpuflow.infer.generate import after_first_true
+
+        cont_mask = jnp.where(
+            after_first_true(conts == eos_id), 0.0, cont_mask
+        )
     mask = jnp.concatenate(
         [jnp.zeros((B * n, T), jnp.float32), cont_mask], axis=1
     )

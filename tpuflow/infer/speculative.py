@@ -1,0 +1,232 @@
+"""Prompt-lookup speculative decoding: draft from the context, verify in
+one forward — token-exact greedy decoding at a fraction of the steps.
+
+No draft model: candidate continuations come from the sequence itself
+(the last (ngram-1)-gram is matched against the prompt + generated text,
+and the tokens that followed its most recent occurrence become the
+draft — byte-level and natural-language corpora repeat constantly).
+Each iteration then runs ONE cached forward over the draft_len+1 chunk
+(multi-token warm-cache attention is exact: Block._cached_attention's
+masked full-cache path), accepts the longest prefix on which the model's
+own argmax agrees, keeps the model's token at the first disagreement
+(the standard "bonus" token — so every iteration commits >= 1 token and
+exactness is unconditional), rewinds the shared cache index past the
+rejected tail (stale cache entries beyond the index are masked out of
+attention until overwritten), and repeats inside one jitted
+``lax.while_loop``.
+
+Batching: rows draft independently; the batch advances by the MINIMUM
+acceptance across live rows (the cache index is shared), so speedup is
+the batch's worst-case agreement — batch 1 gets the full win. Greedy
+only (sampling would need stochastic acceptance-rejection); dense
+prompts only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.infer.generate import after_first_true, check_cache_capacity
+
+
+def _reset_index(cache, value):
+    """Set every cache/pos index leaf to ``value`` (the rewind). Index
+    leaves are the integer counters named ``cache_index``/``pos_index``
+    (scalar, or (n_layer,) under scan_layers)."""
+    flat = jax.tree_util.tree_flatten_with_path(cache)
+    out = []
+    for path, leaf in flat[0]:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if any(str(n).endswith("_index") for n in names):
+            out.append(jnp.broadcast_to(value.astype(leaf.dtype), leaf.shape))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(flat[1], out)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("max_new_tokens", "draft_len", "ngram", "eos_id",
+                     "pad_id"),
+)
+def _spec_jit(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    draft_len: int,
+    ngram: int,
+    eos_id: int | None,
+    pad_id: int,
+):
+    B, T = prompt.shape
+    K = draft_len
+    G = ngram - 1  # match key length
+    L = max_new_tokens + K + 1  # output slack for the last overshoot write
+    W = T + L  # full history width (drafting searches this)
+
+    # Prefill the prompt, sample the first token (greedy).
+    logits, vars_out = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"]
+    )
+    cache = vars_out["cache"]
+    cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    # One buffer serves both drafting (full history) and output (the
+    # slice past the prompt) — committed tokens are written once.
+    hist = jnp.concatenate(
+        [prompt, jnp.full((B, L), pad_id, jnp.int32)], axis=1
+    )
+    done0 = (cur == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
+
+    def draft(hist, n_hist):
+        """Per-row prompt lookup: the K tokens that followed the most
+        recent earlier occurrence of the trailing (ngram-1)-gram.
+        ``n_hist`` = tokens valid in hist (prompt + committed + cur)."""
+        pos = jnp.arange(W)
+
+        def row(h):
+            tail = jax.vmap(
+                lambda o: jax.lax.dynamic_index_in_dim(h, o, keepdims=False)
+            )(n_hist - G + jnp.arange(G))
+            # windows[i] = h[i : i+G]; match where the whole window equals
+            # the tail AND the window ends strictly before the tail itself.
+            idx = pos[:, None] + jnp.arange(G)[None, :]
+            windows = h[jnp.clip(idx, 0, W - 1)]
+            ok = jnp.all(windows == tail[None, :], axis=1)
+            ok = ok & (pos + G < n_hist) & (pos + G + K <= W)
+            m = jnp.where(ok, pos, -1).max()  # most recent occurrence
+            found = m >= 0
+            start = jnp.where(found, m + G, 0)
+            cand = jax.lax.dynamic_slice(h, (start,), (K,))
+            # No match: propose the last token repeated (cheap, often
+            # right for byte-level runs; wrong drafts only cost speed).
+            last = jax.lax.dynamic_index_in_dim(
+                h, n_hist - 1, keepdims=False
+            )
+            return jnp.where(found, cand, jnp.full((K,), last))
+
+        return jax.vmap(row)(hist)
+
+    def cond(state):
+        n_out, _, _, _, done, _ = state
+        return (n_out < max_new_tokens) & ~jnp.all(done)
+
+    def body(state):
+        n_out, hist, cur, cache, done, c = state
+        # hist holds prompt + all committed tokens + cur at n_hist-1.
+        n_hist = T + n_out + 1
+        d = draft(hist, n_hist)  # (B, K)
+        x = jnp.concatenate([cur[:, None], d], axis=1)  # (B, K+1)
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache},
+            x,
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = vars_out["cache"]
+        am = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K+1)
+        # am[:, j] = model's token after (cur, d_0..d_{j-1}); acceptance =
+        # leading agreement with the draft.
+        match = am[:, :K] == d
+        a_row = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        a_row = jnp.where(done, K, a_row)  # frozen rows never constrain
+        a = jnp.min(a_row)  # shared cache index → batch-uniform advance
+
+        # Committed window (K+1 wide, a+1 valid): accepted draft prefix,
+        # then the model's token at the disagreement, then junk the next
+        # iteration overwrites.
+        j = jnp.arange(K + 1)
+        window = jnp.where(
+            j[None, :] < a, jnp.pad(d, ((0, 0), (0, 1))), am[
+                jnp.arange(B)[:, None], jnp.minimum(j[None, :], a)
+            ]
+        )
+        # eos freeze inside the window + already-done rows emit pad.
+        if eos_id is not None:
+            is_eos = (window == eos_id) & (j[None, :] <= a)
+            window = jnp.where(
+                after_first_true(is_eos) | done[:, None], pad_id, window
+            )
+            done = done | jnp.any(is_eos & ~done[:, None], axis=1)
+        else:
+            window = jnp.where(done[:, None], pad_id, window)
+
+        # cur itself is committed NOW (it was only sampled before).
+        hist = jax.lax.dynamic_update_slice(hist, cur[:, None], (0, T + n_out))
+        hist = jax.lax.dynamic_update_slice(hist, window, (0, T + n_out + 1))
+
+        new_cur = window[jnp.arange(B), a]
+        # Keys for cur, d_0..d_{a-1} (positions c..c+a) are valid; rewind
+        # the shared index past the rejected tail.
+        c = c + a + 1
+        cache = _reset_index(cache, c)
+        return n_out + a + 1, hist, new_cur, cache, done, c
+
+    init = (jnp.int32(0), hist, cur, cache, done0, jnp.int32(T))
+    n_out, hist, cur, cache, done, c = jax.lax.while_loop(cond, body, init)
+    # If the loop never ran (or exited right at the budget), the pending
+    # cur was never committed — flush it raw (the eos re-freeze below pads
+    # anything after a row's first eos; the eos itself is emitted).
+    hist = jax.lax.dynamic_update_slice(
+        hist, cur[:, None], (0, T + jnp.minimum(n_out, L - 1))
+    )
+    # Output = the history past the prompt; trim overshoot and re-freeze
+    # anything past each row's first eos (the uniform advance can
+    # overshoot a row's budgeted region).
+    out = hist[:, T:T + max_new_tokens]
+    if eos_id is not None:
+        out = jnp.where(after_first_true(out == eos_id), pad_id, out)
+    return out
+
+
+def speculative_generate(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    draft_len: int = 8,
+    ngram: int = 3,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+):
+    """Greedy decode via prompt-lookup speculation — token-exact vs
+    ``generate(..., temperature=0)``, committing up to ``draft_len + 1``
+    tokens per model forward when the context repeats.
+
+    ``prompt``: dense (B, T) int32 (ragged batches: decode rows
+    separately, or use ``generate``). ``ngram`` is the match-key length
+    + 1 (3 = match on the trailing 2-gram). Returns (B, max_new_tokens).
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    if ngram < 2:
+        raise ValueError(f"ngram must be >= 2, got {ngram}")
+    if T < ngram - 1:
+        raise ValueError(
+            f"prompt length {T} is shorter than the {ngram - 1}-token "
+            "match key; use generate() for such prompts"
+        )
+    # The uniform advance can run the cache up to draft_len+1 past the
+    # budget before the loop notices — reserve that slack in n_ctx.
+    check_cache_capacity(model, T, max_new_tokens + draft_len + 1)
+    return _spec_jit(
+        model,
+        params,
+        prompt,
+        max_new_tokens=max_new_tokens,
+        draft_len=draft_len,
+        ngram=ngram,
+        eos_id=eos_id,
+        pad_id=pad_id,
+    )
