@@ -148,3 +148,37 @@ def test_heterogeneous_eos_rows_finish_at_different_steps():
         )
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_with_fsdp_sharded_params(mesh8):
+    """Speculation under a device mesh: FSDP-sharded params, the whole
+    draft/verify/rewind loop jitted over GSPMD — tokens must equal the
+    unsharded greedy decode."""
+    import optax
+
+    from tpuflow.parallel import create_sharded_state, has_sharded_leaf
+    from tpuflow.train import TrainState
+
+    model, params = _model()
+    prompt = np.tile(np.array([7, 8, 9], np.int32), (2, 4))
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=6, temperature=0.0)
+    )
+
+    def init_fn(rng):
+        del rng
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(1e-3)
+        )
+
+    with mesh8:
+        state, shardings = create_sharded_state(
+            init_fn, mesh8, jax.random.PRNGKey(0), fsdp=True
+        )
+        assert has_sharded_leaf(shardings)
+        got = np.asarray(
+            speculative_generate(
+                model, state.params, prompt, max_new_tokens=6, draft_len=4
+            )
+        )
+    np.testing.assert_array_equal(got, want)

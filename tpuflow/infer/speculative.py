@@ -78,10 +78,14 @@ def _spec_jit(
     cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
     # One buffer serves both drafting (full history) and output (the
-    # slice past the prompt) — committed tokens are written once.
+    # slice past the prompt) — committed tokens are written once. cur
+    # lands at column T NOW so the very first draft's match key ends in
+    # the real sampled token, not a pad (the body re-writes it, which is
+    # idempotent).
     hist = jnp.concatenate(
         [prompt, jnp.full((B, L), pad_id, jnp.int32)], axis=1
     )
+    hist = jax.lax.dynamic_update_slice(hist, cur[:, None], (0, T))
     done0 = (cur == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
 
     def draft(hist, n_hist):
@@ -114,11 +118,11 @@ def _spec_jit(
         return jax.vmap(row)(hist)
 
     def cond(state):
-        n_out, _, _, _, done, _ = state
+        n_out, _, _, _, done = state
         return (n_out < max_new_tokens) & ~jnp.all(done)
 
     def body(state):
-        n_out, hist, cur, cache, done, c = state
+        n_out, hist, cur, cache, done = state
         # hist holds prompt + all committed tokens + cur at n_hist-1.
         n_hist = T + n_out + 1
         d = draft(hist, n_hist)  # (B, K)
@@ -162,14 +166,15 @@ def _spec_jit(
         hist = jax.lax.dynamic_update_slice(hist, window, (0, T + n_out + 1))
 
         new_cur = window[jnp.arange(B), a]
-        # Keys for cur, d_0..d_{a-1} (positions c..c+a) are valid; rewind
-        # the shared index past the rejected tail.
-        c = c + a + 1
-        cache = _reset_index(cache, c)
-        return n_out + a + 1, hist, new_cur, cache, done, c
+        # Keys for cur, d_0..d_{a-1} (cache positions T+n_out..T+n_out+a)
+        # are valid; rewind the shared index past the rejected tail. The
+        # cache index is always T + committed-count — derived, not carried,
+        # so the rewind can't desynchronize from the output count.
+        cache = _reset_index(cache, jnp.int32(T) + n_out + a + 1)
+        return n_out + a + 1, hist, new_cur, cache, done
 
-    init = (jnp.int32(0), hist, cur, cache, done0, jnp.int32(T))
-    n_out, hist, cur, cache, done, c = jax.lax.while_loop(cond, body, init)
+    init = (jnp.int32(0), hist, cur, cache, done0)
+    n_out, hist, cur, cache, done = jax.lax.while_loop(cond, body, init)
     # If the loop never ran (or exited right at the budget), the pending
     # cur was never committed — flush it raw (the eos re-freeze below pads
     # anything after a row's first eos; the eos itself is emitted).
