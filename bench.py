@@ -275,9 +275,10 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
         return rec
     try:
         # Speculative leg: prompt-lookup drafting on a REPETITIVE prompt
-        # (single row: the batch-min advance makes B=1 the honest headline)
-        # — decode is HBM-bound on real chips, so each accepted token
-        # amortizes a full weight stream. Token-exactness asserted.
+        # (single row: the batch-min advance makes B=1 the honest
+        # headline). A token mismatch records numerics_ok: false AND
+        # withholds the speedup — a broken result must not publish a
+        # performance headline.
         from tpuflow.infer import speculative_generate
 
         rep = np.tile(
@@ -300,12 +301,14 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
         np.asarray(generate(model, params, rep, max_new_tokens=n_new,
                             temperature=0.0))
         dt_plain1 = _time.monotonic() - t0
-        rec["speculative"] = {
-            "numerics_ok": bool((got == want).all()),
-            "tokens_per_s": round(n_new / dt_spec, 1),
-            "plain_tokens_per_s": round(n_new / dt_plain1, 1),
-            "speedup": round(dt_plain1 / dt_spec, 2),
-        }
+        ok = bool((got == want).all())
+        rec["speculative"] = {"numerics_ok": ok}
+        if ok:
+            rec["speculative"].update(
+                tokens_per_s=round(n_new / dt_spec, 1),
+                plain_tokens_per_s=round(n_new / dt_plain1, 1),
+                speedup=round(dt_plain1 / dt_spec, 2),
+            )
     except Exception as e:  # never erase the decode record
         rec["speculative"] = {"error": repr(e)[:200]}
     _log(f"[bench] decode: {rec}")

@@ -254,6 +254,7 @@ def map_batches(
     predictor: Callable[[dict], dict],
     *,
     batch_size: int = 512,
+    prefetch: bool = True,
 ) -> list[dict]:
     """Run ``predictor`` over ``rows`` in fixed-size batches; return one output
     row per input row, in order (↔ ds.map_batches(...).take_all(),
@@ -263,18 +264,44 @@ def map_batches(
     last row, then the outputs are trimmed — the jitted forward sees a single
     static shape. Rows whose values differ in shape (ragged token prompts)
     are passed to the predictor as lists instead of stacked arrays.
+
+    ``prefetch`` double-buffers batch assembly on a background thread: the
+    host-side stack/pad of batch N+1 overlaps the device execution of
+    batch N (the actor-pool pipelining of the original, expressed as one
+    producer thread; jitted predictors release the GIL while the device
+    runs).
     """
     rows = list(rows)
     if not rows:
         return []
     keys = rows[0].keys()
-    out_rows: list[dict] = []
-    for start in range(0, len(rows), batch_size):
+
+    def make_batch(start: int):
         chunk = rows[start : start + batch_size]
         n = len(chunk)
         if n < batch_size:
             chunk = chunk + [chunk[-1]] * (batch_size - n)
-        batch = {k: _collate([r[k] for r in chunk]) for k in keys}
+        return n, {k: _collate([r[k] for r in chunk]) for k in keys}
+
+    starts = range(0, len(rows), batch_size)
+    out_rows: list[dict] = []
+    if prefetch and len(starts) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            pending = ex.submit(make_batch, starts[0])
+            for i, _ in enumerate(starts):
+                n, batch = pending.result()
+                if i + 1 < len(starts):
+                    pending = ex.submit(make_batch, starts[i + 1])
+                out = predictor(batch)
+                for r in range(n):
+                    out_rows.append(
+                        {k: np.asarray(v)[r] for k, v in out.items()}
+                    )
+        return out_rows
+    for start in starts:
+        n, batch = make_batch(start)
         out = predictor(batch)
         for i in range(n):
             out_rows.append({k: np.asarray(v)[i] for k, v in out.items()})
