@@ -59,6 +59,27 @@ TPU_EVIDENCE_PATH = os.path.join(
 # Legs captured by THIS process (fresh, not cached) — lets main() avoid
 # labeling evidence measured moments ago as stale.
 _FRESH_LEGS: set[str] = set()
+_PROC_START = time.time()
+
+
+def _evidence_leg_is_fresh(leg: str) -> bool:
+    """True when the ledger's ``leg`` record was captured since this
+    process started. The train CHILD merges evidence directly (leg by
+    leg, surviving a mid-suite timeout), so after a child failure the
+    parent must consult the file's timestamps — its own ``_FRESH_LEGS``
+    memory only knows about merges the parent performed."""
+    import calendar
+
+    rec = (_evidence_read() or {}).get(leg)
+    if not isinstance(rec, dict):
+        return False
+    try:
+        t = calendar.timegm(
+            time.strptime(rec["recorded_at"], "%Y-%m-%dT%H:%M:%SZ")
+        )
+    except (KeyError, ValueError):
+        return False
+    return t >= _PROC_START - 120  # clock-skew slack
 
 
 def _evidence_read() -> dict | None:
@@ -84,14 +105,26 @@ def _evidence_merge(updates: dict) -> None:
 
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     commit = None
+    dirty = None
     try:
+        repo = os.path.dirname(TPU_EVIDENCE_PATH)
         proc = subprocess.run(
-            ["git", "-C", os.path.dirname(TPU_EVIDENCE_PATH), "rev-parse",
-             "--short", "HEAD"],
+            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
             capture_output=True, text=True, timeout=10,
         )
         if proc.returncode == 0 and proc.stdout.strip():
             commit = proc.stdout.strip()
+        # A watcher capture normally runs with a mid-round dirty tree, so
+        # the commit hash alone may not contain the code measured — record
+        # that honestly (ADVICE r3). Scoped to the MEASURED code: ledgers
+        # and progress logs churn constantly and would pin the flag true.
+        st = subprocess.run(
+            ["git", "-C", repo, "status", "--porcelain", "--",
+             "tpuflow", "bench.py"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if st.returncode == 0:
+            dirty = bool(st.stdout.strip())
     except Exception:
         pass
     with FileLock(TPU_EVIDENCE_PATH + ".lock"):
@@ -101,6 +134,8 @@ def _evidence_merge(updates: dict) -> None:
                 rec = {**rec, "recorded_at": stamp}
                 if commit:
                     rec["git_commit"] = commit
+                if dirty is not None:
+                    rec["git_dirty"] = dirty
             ev[leg] = rec
         tmp = f"{TPU_EVIDENCE_PATH}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
@@ -218,15 +253,23 @@ def bench_train() -> dict | None:
         "timed_steps": n_timed,
     }
     _log(f"[bench] train: {rec}")
+    # Evidence merges happen HERE, incrementally, leg by leg (VERDICT r3):
+    # if the tunnel flaps mid-flash or mid-decode, the train/MFU record —
+    # the most valuable leg — is already persisted. Ordering is by value:
+    # train+MFU first, flash correctness second, decode/speculative last.
+    if on_tpu:
+        _evidence_merge({"train": rec})
+        try:
+            rec["flash_attention"] = bench_flash()
+        except Exception as e:  # never let a kernel issue erase the train rec
+            rec["flash_attention"] = {"error": repr(e)[:300]}
+        _evidence_merge({"train": rec})
     try:
         rec["decode"] = bench_decode(model, state.params, cfg, on_tpu)
     except Exception as e:  # generation issues must not erase the train rec
         rec["decode"] = {"error": repr(e)[:300]}
     if on_tpu:
-        try:
-            rec["flash_attention"] = bench_flash()
-        except Exception as e:  # never let a kernel issue erase the train rec
-            rec["flash_attention"] = {"error": repr(e)[:300]}
+        _evidence_merge({"train": rec})
     return rec
 
 
@@ -274,44 +317,127 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
         _log(f"[bench] decode: {rec}")
         return rec
     try:
-        # Speculative leg: prompt-lookup drafting on a REPETITIVE prompt
-        # (single row: the batch-min advance makes B=1 the honest
-        # headline). A token mismatch records numerics_ok: false AND
+        # Speculative leg: prompt-lookup drafting on TWO prompts — a
+        # REPETITIVE one (drafting's best case; the original headline) and
+        # a NATURAL-text one (the honest case: prompt-lookup plausibly
+        # loses when the context doesn't repeat — VERDICT r3 weak #4).
+        # Single row each: the batch-min advance makes B=1 the honest
+        # headline. A token mismatch records numerics_ok: false AND
         # withholds the speedup — a broken result must not publish a
-        # performance headline.
-        from tpuflow.infer import speculative_generate
-
-        rep = np.tile(
-            np.arange(16, dtype=np.int32)[None, :] % cfg.vocab_size,
-            (1, max(T_prompt // 16, 2)),
-        )
-        want = np.asarray(
-            generate(model, params, rep, max_new_tokens=n_new,
-                     temperature=0.0)
-        )
-        np.asarray(speculative_generate(
-            model, params, rep, max_new_tokens=n_new, draft_len=8
-        ))  # compile
-        t0 = _time.monotonic()
-        got = np.asarray(speculative_generate(
-            model, params, rep, max_new_tokens=n_new, draft_len=8
-        ))
-        dt_spec = _time.monotonic() - t0
-        t0 = _time.monotonic()
-        np.asarray(generate(model, params, rep, max_new_tokens=n_new,
-                            temperature=0.0))
-        dt_plain1 = _time.monotonic() - t0
-        ok = bool((got == want).all())
-        rec["speculative"] = {"numerics_ok": ok}
-        if ok:
-            rec["speculative"].update(
-                tokens_per_s=round(n_new / dt_spec, 1),
-                plain_tokens_per_s=round(n_new / dt_plain1, 1),
-                speedup=round(dt_plain1 / dt_spec, 2),
-            )
+        # performance headline. Each path is timed 3x and the median
+        # reported (one-sample timing on a tunneled platform is noise,
+        # ADVICE r3).
+        rec["speculative"] = {
+            "repetitive": _bench_spec_prompt(
+                model, params,
+                np.tile(
+                    np.arange(16, dtype=np.int32)[None, :] % cfg.vocab_size,
+                    (1, max(T_prompt // 16, 2)),
+                ),
+                n_new,
+            ),
+            "natural": _bench_spec_prompt(
+                model, params, _natural_prompt(T_prompt, cfg.vocab_size),
+                n_new,
+            ),
+        }
     except Exception as e:  # never erase the decode record
         rec["speculative"] = {"error": repr(e)[:200]}
     _log(f"[bench] decode: {rec}")
+    return rec
+
+
+def _natural_prompt(n_tokens: int, vocab_size: int):
+    """A non-repetitive natural-English prompt as byte-level tokens: the
+    corpus file when one is present (tpuflow.data.resolve_text_path),
+    else an embedded paragraph — either way real prose, not np.tile."""
+    import numpy as np
+
+    text = None
+    try:
+        from tpuflow.data.datasets import resolve_text_path
+
+        path = resolve_text_path()
+        if path is not None:
+            with open(path, "rb") as f:
+                text = f.read(4 * n_tokens)
+    except Exception:
+        pass
+    if not text or len(text) < n_tokens:
+        # A corpus shorter than the prompt would make np.resize cycle it —
+        # re-creating exactly the periodic prompt this leg exists to avoid.
+        text = (
+            b"The checkpoint subsystem writes each shard to its own file "
+            b"so that restores can proceed in parallel across hosts. When "
+            b"a training run is interrupted, the newest retained step is "
+            b"located by scanning commit markers, and the optimizer state "
+            b"is reconstructed on whatever mesh the resumed job happens "
+            b"to have. This design keeps the storage layer independent of "
+            b"the device topology that produced the files in the first "
+            b"place, which is what makes elastic restarts possible."
+        )
+    buf = np.frombuffer(text, dtype=np.uint8).astype(np.int32)
+    assert len(buf) >= n_tokens  # embedded paragraph covers any bench T
+    return buf[None, :n_tokens] % vocab_size
+
+
+def _bench_spec_prompt(model, params, prompt, n_new: int) -> dict:
+    """Correctness + median-of-3 speedup + realized acceptance of
+    speculative_generate vs plain generate on one (1, T) prompt."""
+    import statistics
+    import time as _time
+
+    import numpy as np
+
+    from tpuflow.infer import generate, speculative_generate
+
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=n_new, temperature=0.0)
+    )
+
+    # Stats come from the warmup call only; the TIMED closure re-uses the
+    # same compiled stats variant but fetches JUST the tokens — matching
+    # the plain path's single fetch (no stat-scalar RTTs biasing the
+    # speedup low) without paying a second jit compile for a stats-free
+    # variant (with_stats is a static arg).
+    def spec():
+        return speculative_generate(
+            model, params, prompt, max_new_tokens=n_new, draft_len=8,
+            return_stats=True,
+        )
+
+    got_j, stats = spec()  # compile + correctness sample
+    got = np.asarray(got_j)
+    stats = {k: int(v) for k, v in stats.items()}
+
+    def timed(fn, n=3):
+        out = []
+        for _ in range(n):
+            t0 = _time.monotonic()
+            fn()
+            out.append(_time.monotonic() - t0)
+        return statistics.median(out)
+
+    dt_spec = timed(lambda: np.asarray(spec()[0]))
+    dt_plain = timed(
+        lambda: np.asarray(
+            generate(model, params, prompt, max_new_tokens=n_new,
+                     temperature=0.0)
+        )
+    )
+    ok = bool((got == want).all())
+    rec = {
+        "numerics_ok": ok,
+        "tokens_per_forward": round(
+            stats["n_committed"] / max(stats["n_forwards"], 1), 2
+        ),
+    }
+    if ok:
+        rec.update(
+            tokens_per_s=round(n_new / dt_spec, 1),
+            plain_tokens_per_s=round(n_new / dt_plain, 1),
+            speedup=round(dt_plain / dt_spec, 2),
+        )
     return rec
 
 
@@ -459,19 +585,29 @@ def run_train_bench() -> dict | None:
             _log(f"[bench] train child timed out (mode={mode})")
             for line in (e.stderr or b"").decode(errors="replace").splitlines():
                 _log(line)
+            if mode == "tpu" and _evidence_leg_is_fresh("train"):
+                # The child merged a real TPU train record before the flap
+                # killed it — that capture is fresh, not cached, even
+                # though this parent now degrades to the CPU smoke leg.
+                _FRESH_LEGS.add("train")
             continue
         if proc.stderr:
             for line in proc.stderr.splitlines():
                 _log(line)
         if proc.returncode != 0:
             _log(f"[bench] train child failed rc={proc.returncode} (mode={mode})")
+            if mode == "tpu" and _evidence_leg_is_fresh("train"):
+                _FRESH_LEGS.add("train")
             continue
         try:
             rec = json.loads(proc.stdout.strip().splitlines()[-1])
         except (ValueError, IndexError):
             continue
         if isinstance(rec, dict) and rec.get("platform") == "tpu":
-            _evidence_merge({"train": rec})
+            # The child already merged the evidence incrementally (leg by
+            # leg, surviving a mid-suite flap); just mark it fresh so
+            # main() doesn't label a seconds-old capture "cached".
+            _FRESH_LEGS.add("train")
         return rec
     return None
 

@@ -2,22 +2,25 @@
 """Opportunistic on-TPU evidence capturer.
 
 The dev-box TPU is reached through a tunnel that flaps: it can be healthy
-for hours mid-round and dead at round-end snapshot time, which previously
-erased all hardware validation (the round-end bench is the only recorded
-run). This watcher closes that gap: it probes the default JAX platform on
-an interval and, on the first healthy TPU probe, fires the full bench
-suite (train steps/s + MFU, flash fwd/bwd vs XLA, KV-cache decode — via
-``bench.py``'s train child — plus the device-path checkpoint leg), which
-persists every TPU-platform record to ``TPU_EVIDENCE.json``; the watcher
-then commits the evidence and exits.
+for minutes mid-round and dead at round-end snapshot time, which
+previously erased all hardware validation. This watcher probes the
+default JAX platform aggressively and, on the first healthy TPU probe,
+fires the evidence legs in VALUE ORDER, committing ``TPU_EVIDENCE.json``
+after each one so a tunnel flap mid-suite cannot strand what was already
+measured:
+
+  1. train child (``bench.py --train-child``): MFU train step → flash
+     kernel correctness+speed → decode/speculative. The child itself
+     merges the evidence ledger incrementally after each sub-leg.
+  2. device-path checkpoint tier (small payload; documents the tunnel).
 
 Run it in the background for a whole working session:
 
     python tools/tpu_watch.py >> tools/tpu_watch.log 2>&1 &
 
-Env knobs: TPU_WATCH_INTERVAL_S (probe cadence, default 600),
+Env knobs: TPU_WATCH_INTERVAL_S (probe cadence, default 45),
 TPU_WATCH_MAX_S (give up after, default 11h),
-TPU_WATCH_PROBE_TIMEOUT_S (per-probe hang bound, default 90).
+TPU_WATCH_PROBE_TIMEOUT_S (per-probe hang bound, default 75).
 """
 
 from __future__ import annotations
@@ -71,36 +74,88 @@ def probe(timeout_s: float) -> str | None:
     return out[-1] if out else None
 
 
-def run_bench(extra_env: dict[str, str], timeout_s: float = 3600) -> bool:
+def run_leg(argv: list[str], extra_env: dict[str, str],
+            timeout_s: float, label: str) -> bool:
     _drop_probe_cache()
     try:
         p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
+            [sys.executable] + argv,
             env=_clean_env(extra_env), timeout=timeout_s,
             capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
-        print("[tpu_watch] bench timed out", flush=True)
+        print(f"[tpu_watch] {label} timed out after {timeout_s:.0f}s",
+              flush=True)
         return False
     tail = "\n".join(p.stderr.splitlines()[-25:])
-    print(f"[tpu_watch] bench rc={p.returncode}\n{tail}", flush=True)
+    print(f"[tpu_watch] {label} rc={p.returncode}\n{tail}", flush=True)
     return p.returncode == 0
 
 
-def evidence_has_tpu_train() -> bool:
+def evidence_legs() -> dict:
     try:
         with open(EVIDENCE) as f:
-            return json.load(f).get("train", {}).get("platform") == "tpu"
+            return json.load(f)
     except (OSError, ValueError):
+        return {}
+
+
+def leg_fresh(rec: dict, since: float) -> bool:
+    """True when this leg is a TPU record captured after ``since`` (unix
+    time). A prior session's committed ledger must not satisfy THIS
+    session's capture gates — the watcher exists to produce fresh
+    evidence, not to re-discover old files."""
+    import calendar
+
+    if rec.get("platform") != "tpu":
         return False
+    try:
+        t = calendar.timegm(time.strptime(rec["recorded_at"],
+                                          "%Y-%m-%dT%H:%M:%SZ"))
+    except (KeyError, ValueError):
+        return False
+    return t >= since - 120  # 2 min skew slack
+
+
+def git_quiescent() -> bool:
+    """True when no rebase/merge/cherry-pick is mid-flight (ADVICE r3:
+    an unattended commit must not fire into one)."""
+    gitdir = os.path.join(REPO, ".git")
+    return not any(
+        os.path.exists(os.path.join(gitdir, p))
+        for p in ("rebase-merge", "rebase-apply", "MERGE_HEAD",
+                  "CHERRY_PICK_HEAD")
+    )
+
+
+def commit_evidence(note: str) -> None:
+    """Pathspec'd commit of ONLY the evidence file — never picks up files
+    another process staged mid-work; skipped entirely mid-rebase/merge
+    (the ledger is durable on disk either way; the round-end snapshot
+    commits whatever is left)."""
+    if not os.path.exists(EVIDENCE):
+        return
+    if not git_quiescent():
+        print("[tpu_watch] repo mid-rebase/merge — deferring evidence "
+              "commit (file persisted on disk)", flush=True)
+        return
+    subprocess.run(["git", "-C", REPO, "add", "TPU_EVIDENCE.json"])
+    subprocess.run([
+        "git", "-C", REPO, "commit", "-m",
+        f"Record on-TPU bench evidence ({note})",
+        "-m", "No-Verification-Needed: benchmark data capture only",
+        "--", "TPU_EVIDENCE.json",
+    ])
 
 
 def main() -> int:
-    interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", "600"))
-    probe_timeout = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "90"))
-    deadline = time.time() + float(
+    interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", "45"))
+    probe_timeout = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "75"))
+    started = time.time()
+    deadline = started + float(
         os.environ.get("TPU_WATCH_MAX_S", str(11 * 3600))
     )
+    bench_py = os.path.join(REPO, "bench.py")
     while time.time() < deadline:
         stamp = time.strftime("%H:%M:%S")
         backend = probe(probe_timeout)
@@ -109,42 +164,38 @@ def main() -> int:
                   f"reachable; retry in {interval:.0f}s", flush=True)
             time.sleep(interval)
             continue
-        print(f"[tpu_watch {stamp}] TPU healthy — firing bench suite",
+        print(f"[tpu_watch {stamp}] TPU healthy — capturing evidence legs",
               flush=True)
-        # Full suite: host-tier ckpt + TPU train/flash/decode legs. A longer
-        # train-child timeout than the round-end default: this run is the
-        # evidence capture, so give slow tunnel compiles room.
-        run_bench({"TPUFLOW_BENCH_TRAIN_TIMEOUT": "900"})
-        if not evidence_has_tpu_train():
-            print("[tpu_watch] bench ran but produced no TPU train record; "
-                  "will keep probing", flush=True)
+        # Leg 1: train child straight away (no host-tier ckpt suite in
+        # front of it — that is round-end business). The child merges the
+        # ledger after EACH sub-leg (train → flash → decode), so even a
+        # timeout here can leave a committed MFU record.
+        run_leg([bench_py, "--train-child"],
+                {"TPUFLOW_TRAIN_MODE": "tpu"},
+                timeout_s=1200, label="train child")
+        commit_evidence("train/MFU, flash kernels, decode")
+        have = evidence_legs()
+        if not leg_fresh(have.get("train", {}), started):
+            print("[tpu_watch] no FRESH TPU train record yet; will keep "
+                  "probing", flush=True)
             time.sleep(interval)
             continue
-        # Device-path checkpoint tier (small payload: the tunnel moves
-        # ~0.01 GB/s, this leg documents that path rather than racing it).
-        run_bench({
-            "TPUFLOW_BENCH_DEVICE": "1",
-            "TPUFLOW_BENCH_TRAIN": "0",
-            "TPUFLOW_BENCH_GB": "0.125",
-            "TPUFLOW_BENCH_DEVICES": "1",
-            # Device-path capture only: skip the disk tier (whose cold
-            # restore drops the machine's page cache) and the 3.4 GiB
-            # overlap leg — both already measured by the main suite run.
-            "TPUFLOW_BENCH_DISK": "0",
-            "TPUFLOW_BENCH_OVERLAP": "0",
-        }, timeout_s=1800)
-        # add makes the (possibly untracked) file known to git; the
-        # pathspec'd commit then includes ONLY it — never files another
-        # process staged mid-work.
-        subprocess.run(["git", "-C", REPO, "add", "TPU_EVIDENCE.json"])
-        subprocess.run([
-            "git", "-C", REPO, "commit", "-m",
-            "Record on-TPU bench evidence (train+MFU, flash kernels, decode, "
-            "device ckpt tier)",
-            "-m", "No-Verification-Needed: benchmark data capture only",
-            "--", "TPU_EVIDENCE.json",
-        ])
-        print("[tpu_watch] evidence committed; exiting", flush=True)
+        # Leg 2: device-path checkpoint tier (small payload: the tunnel
+        # moves ~0.01 GB/s, this leg documents that path rather than
+        # racing it). Disk tier + overlap leg stay OFF on every watcher
+        # run — the disk tier's cold restore drops the whole machine's
+        # page cache (ADVICE r3).
+        if not leg_fresh(have.get("ckpt_device", {}), started):
+            run_leg([bench_py], {
+                "TPUFLOW_BENCH_DEVICE": "1",
+                "TPUFLOW_BENCH_TRAIN": "0",
+                "TPUFLOW_BENCH_GB": "0.125",
+                "TPUFLOW_BENCH_DEVICES": "1",
+                "TPUFLOW_BENCH_DISK": "0",
+                "TPUFLOW_BENCH_OVERLAP": "0",
+            }, timeout_s=1800, label="device ckpt tier")
+            commit_evidence("device ckpt tier")
+        print("[tpu_watch] evidence captured; exiting", flush=True)
         return 0
     print("[tpu_watch] deadline reached without a healthy TPU window",
           flush=True)

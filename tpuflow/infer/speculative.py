@@ -51,7 +51,7 @@ def _reset_index(cache, value):
     jax.jit,
     static_argnums=(0,),
     static_argnames=("max_new_tokens", "draft_len", "ngram", "eos_id",
-                     "pad_id"),
+                     "pad_id", "with_stats"),
 )
 def _spec_jit(
     model,
@@ -63,6 +63,7 @@ def _spec_jit(
     ngram: int,
     eos_id: int | None,
     pad_id: int,
+    with_stats: bool = False,
 ):
     B, T = prompt.shape
     K = draft_len
@@ -118,11 +119,11 @@ def _spec_jit(
         return jax.vmap(row)(hist)
 
     def cond(state):
-        n_out, _, _, _, done = state
+        n_out, _, _, _, done, _ = state
         return (n_out < max_new_tokens) & ~jnp.all(done)
 
     def body(state):
-        n_out, hist, cur, cache, done = state
+        n_out, hist, cur, cache, done, n_fwd = state
         # hist holds prompt + all committed tokens + cur at n_hist-1.
         n_hist = T + n_out + 1
         d = draft(hist, n_hist)  # (B, K)
@@ -171,10 +172,10 @@ def _spec_jit(
         # cache index is always T + committed-count — derived, not carried,
         # so the rewind can't desynchronize from the output count.
         cache = _reset_index(cache, jnp.int32(T) + n_out + a + 1)
-        return n_out + a + 1, hist, new_cur, cache, done
+        return n_out + a + 1, hist, new_cur, cache, done, n_fwd + 1
 
-    init = (jnp.int32(0), hist, cur, cache, done0)
-    n_out, hist, cur, cache, done = jax.lax.while_loop(cond, body, init)
+    init = (jnp.int32(0), hist, cur, cache, done0, jnp.int32(0))
+    n_out, hist, cur, cache, done, n_fwd = jax.lax.while_loop(cond, body, init)
     # If the loop never ran (or exited right at the budget), the pending
     # cur was never committed — flush it raw (the eos re-freeze below pads
     # anything after a row's first eos; the eos itself is emitted).
@@ -187,6 +188,11 @@ def _spec_jit(
     out = hist[:, T:T + max_new_tokens]
     if eos_id is not None:
         out = jnp.where(after_first_true(out == eos_id), pad_id, out)
+    if with_stats:
+        # n_out counts committed tokens (>= 1 per forward); n_fwd counts
+        # verify forwards. tokens/forward = the realized acceptance:
+        # 1.0 means speculation bought nothing, draft_len+1 is the max.
+        return out, {"n_forwards": n_fwd, "n_committed": n_out}
     return out
 
 
@@ -200,14 +206,26 @@ def speculative_generate(
     ngram: int = 3,
     eos_id: int | None = None,
     pad_id: int = 0,
+    return_stats: bool = False,
 ):
-    """Greedy decode via prompt-lookup speculation — token-exact vs
-    ``generate(..., temperature=0)``, committing up to ``draft_len + 1``
-    tokens per model forward when the context repeats.
+    """Greedy decode via prompt-lookup speculation, committing up to
+    ``draft_len + 1`` tokens per model forward when the context repeats.
+
+    Token-exact vs ``generate(..., temperature=0)`` up to the numerics of
+    the batched verify forward: acceptance compares the model's argmax
+    over a (K+1)-token warm-cache chunk against single-token decode, and
+    on low-precision platforms (TPU bf16) the different contraction
+    shapes can in principle flip a near-tie argmax. Verified bit-exact
+    across 9 CPU scenarios (tests/test_speculative.py); the bench
+    withholds any speedup claim on mismatch rather than assuming.
 
     ``prompt``: dense (B, T) int32 (ragged batches: decode rows
     separately, or use ``generate``). ``ngram`` is the match-key length
-    + 1 (3 = match on the trailing 2-gram). Returns (B, max_new_tokens).
+    + 1 (3 = match on the trailing 2-gram). Returns (B, max_new_tokens);
+    with ``return_stats=True`` returns ``(tokens, stats)`` where stats
+    carries ``n_forwards`` (verify passes) and ``n_committed`` (tokens
+    the loop emitted, >= max_new_tokens means budget reached) — realized
+    acceptance is ``n_committed / n_forwards`` tokens per forward.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
@@ -234,4 +252,5 @@ def speculative_generate(
         ngram=ngram,
         eos_id=eos_id,
         pad_id=pad_id,
+        with_stats=return_stats,
     )
