@@ -636,3 +636,102 @@ def test_fuzz_random_pytrees_roundtrip_bit_exact(tmp_path, mesh8):
                 seed, wa.dtype, ra.dtype, wa.shape, ra.shape
             )
             assert wa.tobytes() == ra.tobytes(), (seed, wa.dtype, wa.shape)
+
+
+def test_prewarm_parks_on_starved_box(tmp_path, monkeypatch):
+    """With no spare core (TPUFLOW_PREWARM_THREADS=0), background prewarm
+    must not spawn work — it parks, runs only under an explicit blocking
+    wait, and is dropped by cancel/clear (BENCH_r03 prewarm_overlap
+    measured the old always-spawn behavior actively harmful: -16 s)."""
+    from tpuflow.ckpt.raw import RecyclePool, RestoreArena
+
+    monkeypatch.setenv("TPUFLOW_PREWARM_THREADS", "0")
+    size = 1 << 20
+    pool = RecyclePool(str(tmp_path / "pool"))
+    pool.prewarm([size, size])
+    assert not pool._warm_threads  # no thread: parked
+    assert pool.take(size) is None  # nothing materialized
+    pool.prewarm_wait()  # blocking caller runs parked work itself
+    assert pool.take(size) is not None
+    assert pool.take(size) is not None
+
+    # cancel_prewarm drops parked work (and releases its promises so a
+    # later prewarm can re-book the sizes).
+    pool2 = RecyclePool(str(tmp_path / "pool2"))
+    pool2.prewarm([size])
+    pool2.cancel_prewarm()
+    pool2.prewarm_wait()
+    assert pool2.take(size) is None
+    assert not pool2._warm_promised
+    pool2.prewarm([size])  # re-book works after the cancel
+    pool2.prewarm_wait()
+    assert pool2.take(size) is not None
+
+    arena = RestoreArena()
+    try:
+        arena.prewarm([size])
+        assert arena.take(size) is None  # parked
+        arena.prewarm_wait()
+        assert arena.take(size) is not None
+        arena.prewarm([size])
+        arena.clear()  # drops parked work without executing it
+        arena.prewarm_wait()
+        assert arena.take(size) is None
+    finally:
+        arena.clear()
+
+
+def test_prewarm_background_when_spare_cores(tmp_path, monkeypatch):
+    """With spare cores the background thread path still materializes the
+    pool without the caller blocking for it."""
+    from tpuflow.ckpt.raw import RecyclePool, RestoreArena
+
+    monkeypatch.setenv("TPUFLOW_PREWARM_THREADS", "1")
+    size = 1 << 20
+    pool = RecyclePool(str(tmp_path / "pool"))
+    pool.prewarm([size])
+    pool.prewarm_wait()  # join the real background thread
+    assert pool.take(size) is not None
+
+    arena = RestoreArena()
+    try:
+        arena.prewarm([size])
+        arena.prewarm_wait()
+        assert arena.take(size) is not None
+    finally:
+        arena.clear()
+
+
+def test_arena_abandon_discards_in_flight(monkeypatch):
+    """abandon() (manager.close's terminal reclamation) must drop landed
+    + parked buffers AND make an in-flight background prewarm discard its
+    remaining work — without joining it (a multi-GB page-touch must never
+    block an unrelated manager's close)."""
+    import threading
+
+    from tpuflow.ckpt import raw as raw_fmt
+
+    monkeypatch.setenv("TPUFLOW_PREWARM_THREADS", "1")
+    arena = raw_fmt.RestoreArena()
+    size = 1 << 20
+    gate = threading.Event()
+    orig = raw_fmt._native.aligned_empty
+
+    def slow_alloc(n):
+        gate.wait(5)  # hold the background thread mid-_back
+        return orig(n)
+
+    try:
+        monkeypatch.setattr(raw_fmt._native, "aligned_empty", slow_alloc)
+        arena.prewarm([size])          # background thread blocks in alloc
+        arena.abandon()                # returns immediately, no join
+        gate.set()                     # thread resumes, must discard
+        arena.prewarm_wait()
+        assert arena.take(size) is None  # nothing landed post-abandon
+        # The arena recovers: a fresh prewarm on the new generation lands.
+        arena.prewarm([size])
+        arena.prewarm_wait()
+        assert arena.take(size) is not None
+    finally:
+        gate.set()
+        arena.clear()

@@ -169,11 +169,14 @@ def main() -> int:
         # Leg 1: train child straight away (no host-tier ckpt suite in
         # front of it — that is round-end business). The child merges the
         # ledger after EACH sub-leg (train → flash → decode), so even a
-        # timeout here can leave a committed MFU record.
-        run_leg([bench_py, "--train-child"],
-                {"TPUFLOW_TRAIN_MODE": "tpu"},
-                timeout_s=1200, label="train child")
-        commit_evidence("train/MFU, flash kernels, decode")
+        # timeout here can leave a committed MFU record. Skipped when a
+        # previous window of THIS session already landed it (a later flap
+        # retry must not re-spend 20 min re-proving the same leg).
+        if not leg_fresh(evidence_legs().get("train", {}), started):
+            run_leg([bench_py, "--train-child"],
+                    {"TPUFLOW_TRAIN_MODE": "tpu"},
+                    timeout_s=1200, label="train child")
+            commit_evidence("train/MFU, flash kernels, decode")
         have = evidence_legs()
         if not leg_fresh(have.get("train", {}), started):
             print("[tpu_watch] no FRESH TPU train record yet; will keep "
@@ -195,6 +198,16 @@ def main() -> int:
                 "TPUFLOW_BENCH_OVERLAP": "0",
             }, timeout_s=1800, label="device ckpt tier")
             commit_evidence("device ckpt tier")
+            if not leg_fresh(
+                evidence_legs().get("ckpt_device", {}), started
+            ):
+                # A flap killed the ckpt leg after the train leg landed —
+                # keep probing for another window rather than declaring
+                # victory on a half-captured suite.
+                print("[tpu_watch] ckpt_device leg not captured; will "
+                      "keep probing", flush=True)
+                time.sleep(interval)
+                continue
         print("[tpu_watch] evidence captured; exiting", flush=True)
         return 0
     print("[tpu_watch] deadline reached without a healthy TPU window",

@@ -478,12 +478,17 @@ class CheckpointManager:
         # Terminal arena reclamation: a prewarm_restore whose restore never
         # ran (step errored, caller aborted) must not pin pre-backed pages
         # for the process lifetime — restore_raw's own cleanup only drops
-        # LANDED buffers. Clearing the process-global arena here can at
-        # worst discard another manager's in-flight prewarm backing work
-        # (a lost optimization, never correctness).
+        # LANDED buffers. abandon (not clear): the arena is
+        # process-global, so a full clear() would first JOIN an unrelated
+        # manager's in-flight background prewarm — closing one manager
+        # must never block on another's multi-GB page-touch (ADVICE r3).
+        # The generation bump makes an in-flight prewarm discard instead
+        # of landing, so nothing stays pinned past this close; at worst
+        # another live manager's prewarm is discarded (a lost
+        # optimization, never correctness).
         from tpuflow.ckpt import raw as raw_fmt
 
-        raw_fmt._ARENA.clear()
+        raw_fmt._ARENA.abandon()
 
     # --------------------------------------------------------------- restore
     def _resolve_step(self, step: int | None, best: bool) -> int:

@@ -95,6 +95,27 @@ def _mmap_enabled() -> bool:
     return os.environ.get("TPUFLOW_CKPT_MMAP", "0") == "1"
 
 
+def _spare_cores() -> int:
+    """Cores available for BACKGROUND page-backing beyond the one the
+    host compute thread occupies. Background prewarm only wins when its
+    page touches run on cores compute isn't using; on a 1-core box it
+    steals the only core and measures actively harmful (BENCH_r03
+    prewarm_overlap: hidden_s -16.2 s, first save collapsed 8x). When
+    this returns 0, background prewarms PARK their work: it runs only if
+    a caller explicitly waits (prewarm_wait — that caller has nothing
+    better to do with the core), else it never runs and the first save /
+    restore pays exactly what it would have paid with no prewarm at all.
+    Override: TPUFLOW_PREWARM_THREADS (0 parks, >=1 forces background).
+    """
+    env = os.environ.get("TPUFLOW_PREWARM_THREADS")
+    if env is not None:
+        try:
+            return max(int(env), 0)
+        except ValueError:
+            pass
+    return max((os.cpu_count() or 1) - 1, 0)
+
+
 class RecyclePool:
     """Pool of retired shard files whose pages get reused by later saves.
 
@@ -116,6 +137,7 @@ class RecyclePool:
         self._warm_promised: dict[int, int] = {}
         self._warm_threads: list[threading.Thread] = []
         self._warm_cancel = threading.Event()
+        self._deferred: list[int] = []  # sizes parked on a starved box
         if os.path.isdir(directory):
             for name in os.listdir(directory):
                 path = os.path.join(directory, name)
@@ -245,6 +267,12 @@ class RecyclePool:
                     self._warm_promised[s] = self._warm_promised.get(s, 0) + 1
             if not todo:
                 return
+            if _spare_cores() < 1:
+                # Starved box: park the work instead of stealing the
+                # compute core (see _spare_cores). Promises stay: a
+                # repeated prewarm must not double-book the sizes.
+                self._deferred.extend(todo)
+                return
             t = threading.Thread(
                 target=self._prewarm_run, args=(todo,), daemon=True
             )
@@ -299,14 +327,28 @@ class RecyclePool:
                 self._release_promise(size)
 
     def prewarm_wait(self, timeout: float | None = None) -> None:
+        """Block until prewarmed files exist. ``timeout`` bounds the
+        background-thread joins ONLY: on a starved box, parked work (see
+        _spare_cores) executes in full on this caller's thread first,
+        regardless of timeout."""
         with self._lock:
             threads = list(self._warm_threads)
+            deferred, self._deferred = self._deferred, []
+        if deferred:
+            # The caller is blocking anyway — parked work (starved box,
+            # see _spare_cores) runs here on the caller's own core.
+            self._prewarm_run(sorted(deferred, reverse=True))
         for t in threads:
             t.join(timeout)
 
     def cancel_prewarm(self) -> None:
-        """Stop in-flight prewarm promptly and join its threads (close())."""
+        """Stop in-flight prewarm promptly and join its threads (close());
+        parked work is dropped, not executed."""
         self._warm_cancel.set()
+        with self._lock:
+            deferred, self._deferred = self._deferred, []
+            for s in deferred:
+                self._release_promise(s)
         self.prewarm_wait()
         self._warm_cancel.clear()
 
@@ -351,6 +393,11 @@ class RestoreArena:
         # prewarm() calls can race on self._thread and join a thread that
         # was created but not yet started.
         self._spawn_lock = threading.Lock()
+        self._deferred: list[int] = []  # sizes parked on a starved box
+        # Bumped by abandon(): an in-flight _back from an older generation
+        # discards instead of landing — terminal reclamation without the
+        # multi-GB join.
+        self._gen = 0
 
     def prewarm(self, sizes: list[int], *, background: bool = True) -> None:
         """Allocate + page-back one buffer per entry of ``sizes``."""
@@ -358,16 +405,19 @@ class RestoreArena:
         if not sizes:
             return
 
+        gen = self._gen
+
         def _run():
-            for s in sizes:
-                buf = _native.aligned_empty(s)
-                buf[::4096] = 0  # touch every page: back it now, not at read
-                if s % 4096:
-                    buf[-1] = 0
-                with self._lock:
-                    self._buffers.setdefault(s, []).append(buf)
+            self._back(sizes, gen)
 
         if background:
+            if _spare_cores() < 1:
+                # Starved box: park the work instead of stealing the
+                # compute core (see _spare_cores); it runs only if a
+                # caller explicitly blocks in prewarm_wait.
+                with self._lock:
+                    self._deferred.extend(sizes)
+                return
             # One prewarm in flight at a time. The join of the previous
             # thread happens OUTSIDE the lock (it can last a multi-GB
             # page-touch), so prewarm_wait's brief locked read stays
@@ -389,7 +439,32 @@ class RestoreArena:
         else:
             _run()
 
+    def _back(self, sizes: list[int], gen: int | None = None) -> None:
+        for s in sizes:
+            with self._lock:
+                if gen is not None and gen != self._gen:
+                    return  # abandon()ed mid-flight: discard, don't land
+            buf = _native.aligned_empty(s)
+            buf[::4096] = 0  # touch every page: back it now, not at read
+            if s % 4096:
+                buf[-1] = 0
+            with self._lock:
+                if gen is not None and gen != self._gen:
+                    return
+                self._buffers.setdefault(s, []).append(buf)
+
     def prewarm_wait(self, timeout: float | None = None) -> None:
+        """Block until prewarmed buffers have landed. ``timeout`` bounds
+        the background-thread join ONLY: on a starved box, parked work
+        (see _spare_cores) executes in full on this caller's thread
+        first, regardless of timeout."""
+        with self._lock:
+            deferred, self._deferred = self._deferred, []
+            gen = self._gen
+        if deferred:
+            # The caller is blocking anyway — parked work (starved box,
+            # see _spare_cores) runs here on the caller's own core.
+            self._back(deferred, gen)
         with self._spawn_lock:
             t = self._thread
         if t is not None:
@@ -410,13 +485,31 @@ class RestoreArena:
             return stack.pop() if stack else None
 
     def drop_present(self) -> None:
-        """Drop buffers that have LANDED, without joining an in-flight
-        background prewarm — its still-unlanded buffers survive (they
-        belong to the next restore)."""
+        """Drop buffers that have LANDED plus any parked (never-started)
+        work, without joining an in-flight background prewarm — its
+        still-unlanded buffers survive (they belong to the next
+        restore). End-of-restore cleanup uses this."""
         with self._lock:
             self._buffers.clear()
+            self._deferred.clear()
+
+    def abandon(self) -> None:
+        """Terminal reclamation without blocking: drop landed + parked
+        buffers AND make any in-flight background prewarm discard its
+        remaining work instead of landing it (generation bump — the
+        thread keeps running but appends nothing). Used by
+        CheckpointManager.close(): joining a possibly multi-GB page-touch
+        there would block one manager's close on another's prewarm, while
+        plain drop_present would let buffers landing moments later stay
+        pinned for the process lifetime."""
+        with self._lock:
+            self._gen += 1
+            self._buffers.clear()
+            self._deferred.clear()
 
     def clear(self) -> None:
+        with self._lock:
+            self._deferred.clear()  # drop parked work, don't execute it
         self.prewarm_wait()
         with self._lock:
             self._buffers.clear()
