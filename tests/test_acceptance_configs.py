@@ -171,3 +171,46 @@ def test_gpt_eval_flow_consumes_train_run(env):
     assert erun.successful
     assert erun.data.test_ppl == pytest.approx(train_ppl, rel=1e-4)
     assert len(erun.data.samples) == 3
+
+
+def test_gpt2_ema_resume_direct_state(env):
+    """EMA resume through the flow CLI: the resume path constructs
+    TrainState DIRECTLY from restored leaves (no init materialization —
+    create_sharded_state(materialize=False)), so the averaged weights
+    must come back through that construction and keep improving."""
+    gpt_flow = importlib.import_module("gpt_flow")
+    args = [
+        "run",
+        "--epochs", "1",
+        "--steps-per-epoch", "4",
+        "--batch-size", "8",
+        "--data-axis", "2",
+        "--fsdp-axis", "4",
+        "--ema-decay", "0.9",
+    ]
+    pathspec = gpt_flow.TpuGptTrain.main(args)
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    assert run.successful
+    first_loss = run.data.loss_history[0]
+    from tpuflow.ckpt import restore_from_handle
+
+    tree = restore_from_handle(run.data.result_checkpoint)
+    assert "ema_params" in tree  # averaged weights rode the checkpoint
+
+    pathspec2 = gpt_flow.TpuGptTrain.main(args + ["--from-run", pathspec])
+    run2 = Run(pathspec2)
+    assert run2.successful
+    assert run2.data.loss_history[0] < first_loss
+    tree2 = restore_from_handle(run2.data.result_checkpoint)
+    # The resumed run's EMA continued from the restored average (not
+    # re-seeded from params): it differs from both its params and the
+    # first run's EMA.
+    import jax
+
+    a = jax.tree_util.tree_leaves(tree["ema_params"])[0]
+    b = jax.tree_util.tree_leaves(tree2["ema_params"])[0]
+    p2 = jax.tree_util.tree_leaves(tree2["params"])[0]
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(b), np.asarray(p2))

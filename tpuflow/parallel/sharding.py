@@ -155,6 +155,7 @@ def create_sharded_state(
     *init_args,
     fsdp: bool = True,
     tensor_rules: Callable | None = None,
+    materialize: bool = True,
 ):
     """Initialize a TrainState (or any pytree) *born sharded*.
 
@@ -163,11 +164,25 @@ def create_sharded_state(
     materializes only its shard (the pjit initialization idiom; no
     host-memory spike for GPT-2-medium-sized states).
 
-    Returns (state, shardings).
+    Returns (state, shardings). With ``materialize=False`` the init is
+    ONLY shape-evaluated — ``state`` is the abstract pytree
+    (ShapeDtypeStructs carrying their shardings, so it serves directly
+    as a restore template) and nothing executes on devices. Checkpoint
+    resumes use this: running the real initializer just to overwrite
+    every leaf with restored values doubles startup for nothing (a
+    355M-param init materializes ~4 GiB of random weights + zeroed adamw
+    moments that the restore immediately discards).
     """
     abstract = jax.eval_shape(init_fn, *init_args)
     shardings = make_shardings(
         abstract, mesh, fsdp=fsdp, tensor_rules=tensor_rules
     )
+    if not materialize:
+        abstract = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            abstract,
+            shardings,
+        )
+        return abstract, shardings
     state = jax.jit(init_fn, out_shardings=shardings)(*init_args)
     return state, shardings

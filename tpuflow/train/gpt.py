@@ -190,6 +190,7 @@ def _train_fsdp(
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
     with mesh:
+        t_phase = time.monotonic()
         state, shardings = create_sharded_state(
             init_fn,
             mesh,
@@ -200,42 +201,52 @@ def _train_fsdp(
             tensor_rules=gpt2_tensor_rules
             if cfg.tensor_axis > 1 or cfg.expert_axis > 1
             else None,
+            # On resume the state is built ABSTRACTLY (shape eval only):
+            # materializing 355M random params + zeroed moments just to
+            # overwrite every leaf with the restore doubled resume wall
+            # time (MEDIUM_RUNS.md r3: fresh 103 s vs resume 206 s).
+            materialize=resume_checkpoint is None,
         )
+        log(f"[gpt] state {'template' if resume_checkpoint is not None else 'init'}:"
+            f" {time.monotonic() - t_phase:.1f}s")
         mgr = CheckpointManager(
             ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
         )
         if resume_checkpoint is not None:
             from tpuflow.ckpt import restore_from_handle
 
-            abstract = jax.tree_util.tree_map(
-                lambda s, sh: jax.ShapeDtypeStruct(
-                    s.shape, s.dtype, sharding=sh
-                ),
-                jax.eval_shape(init_fn, jax.random.PRNGKey(0)),
-                shardings,
-            )
+            # state IS the abstract template here (materialize=False
+            # returns sharding-annotated ShapeDtypeStructs).
             tmpl = {
-                "step": abstract.step,
-                "params": abstract.params,
-                "opt_state": abstract.opt_state,
+                "step": state.step,
+                "params": state.params,
+                "opt_state": state.opt_state,
             }
             if cfg.ema_decay > 0.0:
                 # EMA runs save/restore the averaged weights too; the
                 # resume run must pass the same ema_decay (the checkpoint's
                 # leaf structure includes them).
-                tmpl["ema_params"] = abstract.params
+                tmpl["ema_params"] = state.params
+            t_phase = time.monotonic()
             restored = restore_from_handle(
                 resume_checkpoint, abstract_state=tmpl
             )
-            state = state.replace(
+            jax.block_until_ready(restored)
+            # Direct construction — no init ran, there is no state to
+            # .replace() over. batch_stats: GPT has none.
+            state = TrainState(
                 step=restored["step"],
+                apply_fn=model.apply,
                 params=restored["params"],
+                tx=tx,
                 opt_state=restored["opt_state"],
+                batch_stats={},
                 # Present exactly when the template asked for it (the raw
                 # restore errors on any structure mismatch).
                 ema_params=restored.get("ema_params", {}),
             )
-            log("[gpt] full sharded state restored")
+            log(f"[gpt] full sharded state restored:"
+                f" {time.monotonic() - t_phase:.1f}s")
 
         loader, val_loader = _loaders(cfg, model_cfg.vocab_size)
         seq_spec = "seq" if cfg.seq_axis > 1 else None
@@ -398,19 +409,23 @@ def _train_pipeline(
     with mesh:
         p_shapes = jax.eval_shape(init_params, jax.random.PRNGKey(0))
         shardings = gpt2_pipeline_shardings(mesh, p_shapes)
-        # Params born sharded: init is jitted with the pipeline shardings
-        # as out_shardings, so no host ever materializes the full
-        # replicated tree.
-        params = jax.jit(init_params, out_shardings=shardings)(
-            jax.random.PRNGKey(0)
-        )
         # Optimizer state mirrors the params tree (mu/nu under the same
         # 'h' paths → 'stage'-sharded; counts are scalars → replicated),
         # so the same path rule shards it.
         opt_shape = jax.eval_shape(tx.init, p_shapes)
         opt_shardings = gpt2_pipeline_shardings(mesh, opt_shape)
-        opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
         start_step = 0
+
+        if resume_checkpoint is None:
+            # Params born sharded: init is jitted with the pipeline
+            # shardings as out_shardings, so no host ever materializes
+            # the full replicated tree. Resumes skip this entirely — the
+            # restore produces every leaf (materializing random weights
+            # just to overwrite them doubled resume wall time).
+            params = jax.jit(init_params, out_shardings=shardings)(
+                jax.random.PRNGKey(0)
+            )
+            opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
 
         mgr = CheckpointManager(
             ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
