@@ -105,6 +105,13 @@ class TpuGptTrain(FlowSpec):
         "explicitly when extending a run via --from-run so the restored "
         "step counter lands mid-schedule, not past it",
     )
+    remat_policy = Parameter(
+        "remat_policy",
+        default="",
+        help="selective-remat policy (jax.checkpoint_policies name, e.g. "
+        "dots_with_no_batch_dims_saveable); empty = full block remat on "
+        "the full-size presets",
+    )
 
     def _train_config(self):
         from tpuflow.train import GptTrainConfig
@@ -135,6 +142,7 @@ class TpuGptTrain(FlowSpec):
             ema_decay=float(self.ema_decay),
             ckpt_dtype=self.ckpt_dtype or None,
             decay_steps=int(self.decay_steps),
+            remat_policy=self.remat_policy,
         )
 
     @step

@@ -34,6 +34,14 @@ class GPT2Config:
     # intermediates, the standard HBM-for-FLOPs trade for long-context /
     # large-model training on TPU.
     remat: bool = False
+    # Selective remat: name of a jax.checkpoint_policies entry controlling
+    # WHICH intermediates the block saves vs recomputes. None = save only
+    # block inputs (max memory savings, most recompute). The TPU-standard
+    # middle ground is 'dots_with_no_batch_dims_saveable': matmul outputs
+    # (MXU work) are saved, elementwise/softmax (cheap VPU work, the bulk
+    # of activation bytes) recompute — most of the memory win at a
+    # fraction of the recompute cost.
+    remat_policy: str | None = None
     # Roll the layer stack into one nn.scan'd block: the transformer block is
     # traced/compiled ONCE instead of n_layer times (compile time stops
     # scaling with depth) and params stack along a leading layer axis, which
@@ -355,12 +363,24 @@ class GPT2(nn.Module):
             pe = wpe[:T]
         x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        def remat_wrap(mod):
+            import jax as _jax
+
+            policy = None
+            if cfg.remat_policy:
+                try:
+                    policy = getattr(
+                        _jax.checkpoint_policies, cfg.remat_policy
+                    )
+                except AttributeError:
+                    raise ValueError(
+                        f"unknown remat_policy {cfg.remat_policy!r}; valid "
+                        "names are the jax.checkpoint_policies attributes"
+                    ) from None
+            return nn.remat(mod, static_argnums=(2, 3), policy=policy)
+
         if cfg.scan_layers:
-            body = (
-                nn.remat(_ScanBlock, static_argnums=(2, 3))
-                if cfg.remat
-                else _ScanBlock
-            )
+            body = remat_wrap(_ScanBlock) if cfg.remat else _ScanBlock
             blocks = nn.scan(
                 body,
                 # 'losses' must be declared or nn.scan silently DROPS the
@@ -372,9 +392,7 @@ class GPT2(nn.Module):
             )
             x, _ = blocks(cfg, name="h")(x, train, decode, pad_lens)
         else:
-            block_cls = (
-                nn.remat(Block, static_argnums=(2, 3)) if cfg.remat else Block
-            )
+            block_cls = remat_wrap(Block) if cfg.remat else Block
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h{i}")(x, train, decode, pad_lens)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_f")(x)

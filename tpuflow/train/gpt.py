@@ -56,17 +56,40 @@ class GptTrainConfig:
     ema_decay: float = 0.0
     ckpt_dtype: str | None = None
     decay_steps: int = 0            # 0 = this run's epochs*steps
+    # Selective-remat policy for the full-size presets (which remat by
+    # default): '' = full remat, else a jax.checkpoint_policies name,
+    # e.g. 'dots_with_no_batch_dims_saveable' (save MXU outputs,
+    # recompute the cheap elementwise bulk).
+    remat_policy: str = ""
 
     def model_config(self):
         from tpuflow.models.gpt2 import GPT2Config
 
-        return GPT2Config.from_preset(
+        cfg = GPT2Config.from_preset(
             self.preset,
             attn_impl=self.attn_impl,
             seq_len=self.seq_len,
             stage_axis=self.stage_axis,
             n_experts=self.experts,
         )
+        if self.remat_policy:
+            import jax
+
+            if not hasattr(jax.checkpoint_policies, self.remat_policy):
+                # Fail at config time, not at first jit trace inside an
+                # already-provisioned training job.
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r}; valid "
+                    "names are the jax.checkpoint_policies attributes "
+                    "(e.g. dots_with_no_batch_dims_saveable)"
+                )
+            # A policy only means anything under remat — asking for one
+            # turns remat on (otherwise the knob is silently inert on
+            # presets that default remat off, like 'test').
+            cfg = dataclasses.replace(
+                cfg, remat=True, remat_policy=self.remat_policy
+            )
+        return cfg
 
     def optimizer(self):
         from tpuflow.train.optim import make_optimizer
