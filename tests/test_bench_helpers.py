@@ -31,7 +31,12 @@ def tiny_lm():
     return model, params, cfg
 
 
-def test_natural_prompt_shape_and_content():
+def test_natural_prompt_shape_and_content(monkeypatch):
+    # Pin the embedded-paragraph path: a developer's data/*.txt corpus
+    # (the normal lm_text workflow) must not change what this asserts.
+    from tpuflow.data import datasets
+
+    monkeypatch.setattr(datasets, "resolve_text_path", lambda *a, **k: None)
     p = bench._natural_prompt(64, 50257)
     assert p.shape == (1, 64)
     assert p.dtype == np.int32

@@ -280,3 +280,160 @@ def test_max_batches_caps_epoch_but_roams_the_corpus():
     ld.set_epoch(1)
     e1 = np.concatenate([b["y"] for b in ld])
     assert not np.array_equal(np.sort(e0), np.sort(e1))  # new rows seen
+
+
+def _idx_fixture_dir(root, n_train=8, n_test=4):
+    """Write the four Fashion-MNIST gz files into root/srv and return
+    (srv_path, {gz_name: md5_spec})."""
+    import hashlib
+
+    srv = root / "srv"
+    srv.mkdir()
+    rng = np.random.default_rng(7)
+    sums = {}
+    for split, n in (("train", n_train), ("t10k", n_test)):
+        imgs = rng.integers(0, 255, size=(n, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=n).astype(np.uint8)
+        blobs = {
+            f"{split}-images-idx3-ubyte.gz": struct.pack(
+                ">HBB3I", 0, 8, 3, n, 28, 28
+            ) + imgs.tobytes(),
+            f"{split}-labels-idx1-ubyte.gz": struct.pack(
+                ">HBB1I", 0, 8, 1, n
+            ) + labels.tobytes(),
+        }
+        for name, payload in blobs.items():
+            with gzip.open(srv / name, "wb") as f:
+                f.write(payload)
+            sums[name] = (
+                "md5:" + hashlib.md5((srv / name).read_bytes()).hexdigest()
+            )
+    return srv, sums
+
+
+def _serve(directory):
+    """Local HTTP fixture: returns (base_url, shutdown_fn)."""
+    import functools
+    import http.server
+    import threading
+
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(directory)
+    )
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return f"http://127.0.0.1:{httpd.server_address[1]}/", httpd.shutdown
+
+
+def test_fetch_idx_files_from_local_http(tmp_path, monkeypatch):
+    """D16: env-gated fetch downloads, checksum-verifies, and the loader
+    then consumes REAL bytes with no pre-placement."""
+    from tpuflow.data import fetch
+
+    srv, sums = _idx_fixture_dir(tmp_path)
+    base, stop = _serve(srv)
+    data_dir = tmp_path / "data"
+    try:
+        monkeypatch.setenv("TPUFLOW_FETCH", "1")
+        monkeypatch.setattr(fetch, "FASHION_MNIST_FILES", sums)
+        monkeypatch.setattr(fetch, "_FASHION_MNIST_BASE", base)
+        ds = load_dataset("fashion_mnist", data_dir=str(data_dir))
+    finally:
+        stop()
+    assert not ds.synthetic
+    assert ds.train.images.shape == (8, 28, 28)
+    assert ds.test.images.shape == (4, 28, 28)
+    # Idempotent: a second load finds the files, no server needed.
+    ds2 = load_dataset("fashion_mnist", data_dir=str(data_dir))
+    assert not ds2.synthetic
+
+
+def test_fetch_disabled_by_default(tmp_path, monkeypatch):
+    """Without TPUFLOW_FETCH=1 nothing touches the network: the loader
+    degrades to the labeled synthetic stand-in exactly as before."""
+    from tpuflow.data import fetch
+
+    monkeypatch.delenv("TPUFLOW_FETCH", raising=False)
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "16")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "8")
+
+    def boom(*a, **k):  # any network attempt fails the test
+        raise AssertionError("fetch attempted while disabled")
+
+    monkeypatch.setattr(fetch, "fetch_file", boom)
+    ds = load_dataset("fashion_mnist", data_dir=str(tmp_path / "d"))
+    assert ds.synthetic
+
+
+def test_fetch_checksum_mismatch_fails_loudly(tmp_path, monkeypatch):
+    """Wrong bytes must raise, not install: the .part file is cleaned up
+    and nothing lands at the destination."""
+    from tpuflow.data import fetch
+
+    srv, sums = _idx_fixture_dir(tmp_path)
+    base, stop = _serve(srv)
+    data_dir = tmp_path / "data2"
+    bad = {k: "md5:" + "0" * 32 for k in sums}
+    try:
+        monkeypatch.setenv("TPUFLOW_FETCH", "1")
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            fetch.fetch_idx_files(str(data_dir), bad, base)
+    finally:
+        stop()
+    left = [p for p in os.listdir(data_dir) if not p.startswith(".fetch")]
+    assert left == [], left
+
+
+def test_fetch_offline_degrades_gracefully(tmp_path, monkeypatch):
+    """Unreachable mirror: fetch_idx_files returns False without raising
+    (offline tolerance), and the loader path falls back to synthetic."""
+    from tpuflow.data import fetch
+
+    monkeypatch.setenv("TPUFLOW_FETCH", "1")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "16")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "8")
+    # RFC 5737 TEST-NET-1: guaranteed non-routable; short timeout keeps
+    # the failure fast whether it refuses or blackholes.
+    ok = fetch.fetch_idx_files(
+        str(tmp_path / "dl"), {"x.gz": "md5:" + "0" * 32},
+        "http://192.0.2.1:9/", timeout=2.0,
+    )
+    assert ok is False
+    # The loader sees the failed fetch as "no files" → synthetic, exactly
+    # the no-fetch behavior.
+    monkeypatch.setattr(
+        fetch, "maybe_fetch_fashion_mnist", lambda data_dir: False
+    )
+    ds = load_dataset("fashion_mnist", data_dir=str(tmp_path / "d"))
+    assert ds.synthetic
+
+
+def test_stale_synthetic_cache_rebuilt_when_fetch_enabled(tmp_path, monkeypatch):
+    """A synthetic npz cache from an offline run must not defeat a later
+    TPUFLOW_FETCH=1 run: the loader bypasses it, re-fetches, and serves
+    real bytes."""
+    from tpuflow.data import fetch
+
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "16")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "8")
+    data_dir = tmp_path / "d"
+    monkeypatch.delenv("TPUFLOW_FETCH", raising=False)
+    ds = load_dataset("fashion_mnist", data_dir=str(data_dir))
+    assert ds.synthetic  # offline run cached the stand-in
+
+    srv, sums = _idx_fixture_dir(tmp_path)
+    base, stop = _serve(srv)
+    try:
+        monkeypatch.setenv("TPUFLOW_FETCH", "1")
+        monkeypatch.setattr(fetch, "FASHION_MNIST_FILES", sums)
+        monkeypatch.setattr(fetch, "_FASHION_MNIST_BASE", base)
+        ds2 = load_dataset("fashion_mnist", data_dir=str(data_dir))
+    finally:
+        stop()
+    assert not ds2.synthetic
+    assert ds2.train.images.shape == (8, 28, 28)
+    # And the rebuilt cache now records real data for later offline runs.
+    monkeypatch.delenv("TPUFLOW_FETCH", raising=False)
+    ds3 = load_dataset("fashion_mnist", data_dir=str(data_dir))
+    assert not ds3.synthetic

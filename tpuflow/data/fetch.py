@@ -1,0 +1,136 @@
+"""Env-gated, checksum-verified dataset fetcher (closes SURVEY D16).
+
+TPU-native counterpart of the reference's FileLock'd FashionMNIST
+download (my_ray_module.py:41-67: torchvision fetches under
+``FileLock(".fashion_lock")`` so one gang worker downloads while the
+rest wait). Same pattern here, with two hard rules the reference leaves
+implicit:
+
+- **Opt-in only** (``TPUFLOW_FETCH=1``): the default behavior is
+  byte-identical to before — pre-placed IDX files or the labeled
+  synthetic stand-in. Training environments are commonly air-gapped;
+  nothing should ever touch the network unasked.
+- **Checksum-verified, atomic**: bytes land in ``<name>.part`` and are
+  renamed into place only after the digest matches, so a torn download
+  or a tampered mirror can never produce a silently-wrong dataset.
+
+Base URL override: ``TPUFLOW_FETCH_BASE_URL`` (e.g. an internal mirror;
+also how the unit tests point the fetcher at a local HTTP fixture).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import urllib.error
+import urllib.request
+
+from tpuflow.utils.locking import FileLock
+
+# Fashion-MNIST registry: gz filename -> (default source, digest). The
+# digests are the published torchvision ones (md5 — what upstream
+# distributes); the verifier accepts "md5:..." or "sha256:..." prefixes.
+_FASHION_MNIST_BASE = "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
+FASHION_MNIST_FILES: dict[str, str] = {
+    "train-images-idx3-ubyte.gz": "md5:8d4fb7e6c68d591d4c3dfef9ec88bf0d",
+    "train-labels-idx1-ubyte.gz": "md5:25c81989df183df01b3e8a0aad5dffbe",
+    "t10k-images-idx3-ubyte.gz": "md5:bef4ecab320f06d8554ea6380940ec79",
+    "t10k-labels-idx1-ubyte.gz": "md5:bb300cfdad3c16e7a12a480ee83cd310",
+}
+
+
+def fetch_enabled() -> bool:
+    return os.environ.get("TPUFLOW_FETCH") == "1"
+
+
+def _digest(path: str, spec: str) -> bool:
+    algo, _, want = spec.partition(":")
+    h = hashlib.new(algo)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == want.lower()
+
+
+def fetch_file(
+    url: str, dest: str, checksum: str | None = None, timeout: float = 60.0
+) -> str:
+    """Download ``url`` to ``dest`` atomically, verifying ``checksum``
+    ("algo:hex") before the rename. Raises on any failure; never leaves
+    a partial or unverified file at ``dest``."""
+    part = dest + ".part"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, open(
+            part, "wb"
+        ) as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        if checksum and not _digest(part, checksum):
+            raise ValueError(
+                f"{url}: checksum mismatch (expected {checksum}); refusing "
+                "to install the file — override the source via "
+                "TPUFLOW_FETCH_BASE_URL if the registry digest is stale"
+            )
+        os.replace(part, dest)
+        return dest
+    finally:
+        try:
+            os.remove(part)
+        except OSError:
+            pass
+
+
+def fetch_idx_files(
+    data_dir: str,
+    files: dict[str, str],
+    base_url: str,
+    *,
+    timeout: float = 60.0,
+) -> bool:
+    """Fetch every missing registry file into ``data_dir`` under ONE
+    FileLock (gang semantics: the winner downloads, the rest block and
+    then see the files). Returns True when all files are present
+    afterwards; False (with a log line, no raise) when the network is
+    unreachable — the caller falls back exactly as if fetching were
+    disabled."""
+    os.makedirs(data_dir, exist_ok=True)
+    base = os.environ.get("TPUFLOW_FETCH_BASE_URL", base_url)
+    if not base.endswith("/"):
+        base += "/"
+    with FileLock(os.path.join(data_dir, ".fetch.lock")):
+        for name, checksum in files.items():
+            dest = os.path.join(data_dir, name)
+            bare = dest[:-3] if name.endswith(".gz") else dest
+            if os.path.exists(dest) or os.path.exists(bare):
+                continue  # another worker (or a pre-placement) won
+            try:
+                fetch_file(base + name, dest, checksum, timeout=timeout)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # Offline tolerance: unreachable network degrades to the
+                # no-fetch behavior. A checksum mismatch is NOT caught —
+                # wrong bytes must fail loudly, not silently degrade.
+                print(
+                    f"[tpuflow.data] fetch of {name} failed ({e!r:.120}); "
+                    "falling back to pre-placed/synthetic data"
+                )
+                return False
+    return all(
+        os.path.exists(os.path.join(data_dir, n))
+        or os.path.exists(
+            os.path.join(data_dir, n[:-3] if n.endswith(".gz") else n)
+        )
+        for n in files
+    )
+
+
+def maybe_fetch_fashion_mnist(data_dir: str) -> bool:
+    """The D16 entry point ``_load_fashion_mnist`` calls when its files
+    are missing: no-op unless ``TPUFLOW_FETCH=1``."""
+    if not fetch_enabled():
+        return False
+    return fetch_idx_files(
+        data_dir, FASHION_MNIST_FILES, _FASHION_MNIST_BASE
+    )

@@ -189,10 +189,15 @@ def _spec_jit(
     if eos_id is not None:
         out = jnp.where(after_first_true(out == eos_id), pad_id, out)
     if with_stats:
-        # n_out counts committed tokens (>= 1 per forward); n_fwd counts
-        # verify forwards. tokens/forward = the realized acceptance:
-        # 1.0 means speculation bought nothing, draft_len+1 is the max.
-        return out, {"n_forwards": n_fwd, "n_committed": n_out}
+        # n_fwd counts verify forwards; committed tokens are clamped to
+        # the budget — the final iteration can overshoot max_new_tokens
+        # and the overshoot is trimmed from the output, so counting it
+        # would overstate realized acceptance (tokens/forward: 1.0 means
+        # speculation bought nothing, draft_len+1 is the max).
+        return out, {
+            "n_forwards": n_fwd,
+            "n_committed": jnp.minimum(n_out, max_new_tokens),
+        }
     return out
 
 
