@@ -170,6 +170,10 @@ def write_bytes(
         with open(path, mode, buffering=0) as f:
             f.write(memoryview(arr).cast("B"))
             f.truncate(arr.nbytes)
+            # Match the native writer's durability contract (write_impl
+            # fsyncs before close) — without this, the fallback measures
+            # and commits at page-cache speed while claiming durability.
+            os.fsync(f.fileno())
         return
     fn = L.ckptio_write_inplace if inplace else L.ckptio_write
     rc = fn(
