@@ -149,15 +149,25 @@ def _evidence_merge(updates: dict) -> None:
 # bf16 peak FLOP/s per chip for MFU accounting, matched (in order) against
 # jax.devices()[0].device_kind — which reads like 'TPU v5 lite', not 'v5e'.
 _PEAK_FLOPS = (
-    ("v6 lite", 918e12),  # v6e / Trillium
+    ("v6 lite", 918e12),   # v6e / Trillium
+    ("v6lite", 918e12),    # pod-slice spelling ('TPU v6litepod-…')
     ("v6e", 918e12),
-    ("v5 lite", 197e12),  # v5e
+    ("v5 lite", 197e12),   # v5e single chip reports 'TPU v5 lite'
+    ("v5lite", 197e12),    # pod-slice spelling ('TPU v5litepod-…')
     ("v5e", 197e12),
     ("v5p", 459e12),
     ("v5", 459e12),
     ("v4", 275e12),
 )
 _DEFAULT_PEAK = 197e12
+
+
+def _peak_flops_for(device_kind: str) -> float:
+    """bf16 peak FLOP/s for a ``jax.devices()[0].device_kind`` string —
+    ONE lookup shared by the MFU leg and its tests (first substring
+    match wins, so lite entries must precede their bare-version keys)."""
+    kind = device_kind.lower()
+    return next((v for k, v in _PEAK_FLOPS if k in kind), _DEFAULT_PEAK)
 
 
 def bench_train() -> dict | None:
@@ -239,8 +249,7 @@ def bench_train() -> dict | None:
     flops_per_s = 6.0 * n_params * tokens_per_s
     mfu = None
     if on_tpu:
-        kind = jax.devices()[0].device_kind.lower()
-        peak = next((v for k, v in _PEAK_FLOPS if k in kind), _DEFAULT_PEAK)
+        peak = _peak_flops_for(jax.devices()[0].device_kind)
         mfu = flops_per_s / (peak * len(jax.devices()))
     rec = {
         "platform": platform,
@@ -308,6 +317,11 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
         "tokens_per_s_per_seq": round(n_new / dt, 1),
         "compile_s": round(compile_s, 1),
     }
+    if on_tpu:
+        try:
+            rec["int8"] = _bench_int8_decode(model, params, prompt, n_new)
+        except Exception as e:  # never erase the decode record
+            rec["int8"] = {"error": repr(e)[:200]}
     if not on_tpu:
         # The speculative sub-leg only runs where it's a meaningful claim:
         # on the chip, decode is HBM-bound and each accepted token
@@ -345,6 +359,55 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
         rec["speculative"] = {"error": repr(e)[:200]}
     _log(f"[bench] decode: {rec}")
     return rec
+
+
+def _bench_int8_decode(model, params, prompt, n_new: int) -> dict:
+    """Weight-only int8 decode (tpuflow.infer.quant): decode streams the
+    full weight set per token, so int8 weights bound the HBM bytes at
+    1/4 (f32) or 1/2 (bf16) of the plain path. Tokens may legitimately
+    differ from full precision (the weights differ) — the record reports
+    the agreement fraction instead of asserting exactness, plus the
+    measured speedup vs the plain leg timed moments earlier."""
+    import statistics
+    import time as _time
+
+    import numpy as np
+
+    from tpuflow.infer import generate, quantize_model
+
+    qm, qp = quantize_model(model, params)
+
+    def plain():
+        return np.asarray(
+            generate(model, params, prompt, max_new_tokens=n_new,
+                     temperature=0.0)
+        )
+
+    def run():
+        return np.asarray(
+            generate(qm, qp, prompt, max_new_tokens=n_new, temperature=0.0)
+        )
+
+    want = plain()  # already compiled by the caller's decode leg
+    got = run()     # compile the int8 program
+
+    def timed(fn):
+        out = []
+        for _ in range(3):
+            t0 = _time.monotonic()
+            fn()
+            out.append(_time.monotonic() - t0)
+        return statistics.median(out)
+
+    dt_fp = timed(plain)
+    dt = timed(run)
+    B = prompt.shape[0]
+    return {
+        "tokens_per_s": round(B * n_new / dt, 1),
+        "fp_tokens_per_s": round(B * n_new / dt_fp, 1),
+        "speedup_vs_fp": round(dt_fp / dt, 2),
+        "token_agreement": round(float((got == want).mean()), 3),
+    }
 
 
 def _natural_prompt(n_tokens: int, vocab_size: int):
@@ -724,8 +787,12 @@ def probe_disk_ceiling(disk_dir: str, nbytes: int) -> dict:
     probe_dir = os.path.join(disk_dir, ".ceiling_probe")
     _sh.rmtree(probe_dir, ignore_errors=True)
     os.makedirs(probe_dir, exist_ok=True)
-    payload = np.random.default_rng(1).integers(
-        0, 256, size=nbytes, dtype=np.uint8
+    # The probe measures RATE, so its payload needn't match the tier's:
+    # cap it so the extra allocation on the balloon-constrained box stays
+    # bounded (the sharded bench state is still resident at this point).
+    nbytes = min(nbytes, 512 * 2**20)
+    payload = np.frombuffer(
+        np.random.default_rng(1).bytes(nbytes), np.uint8
     )
     combos = [(1, 8), (2, 4), (4, 2), (8, 1)]  # (streams, threads/file)
     best_w = (0.0, None)

@@ -1,0 +1,128 @@
+"""Weight-only int8 quantization (tpuflow.infer.quant): error bounds,
+memory shrink, and drop-in compatibility with every decode entry point."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.infer import (
+    QuantizedModel,
+    beam_search,
+    dequantize_params,
+    generate,
+    quantize_model,
+    quantize_params,
+    sequence_logprob,
+    speculative_generate,
+)
+from tpuflow.infer.quant import QuantLeaf, quantized_nbytes
+from tpuflow.models.gpt2 import GPT2, GPT2Config
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = GPT2Config(
+        vocab_size=256, n_ctx=128, n_embd=64, n_layer=2, n_head=2,
+        dropout=0.0, dtype=jnp.float32,
+    )
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )["params"]
+    return model, params, cfg
+
+
+def test_quantize_roundtrip_error_bound(lm):
+    """Per-channel symmetric int8: |w - dq(q(w))| <= scale/2 per element,
+    i.e. relative to the channel max, error <= 1/254."""
+    _, params, _ = lm
+    qp = quantize_params(params)
+    dq = dequantize_params(qp)
+    for w, r, q in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(dq),
+        jax.tree_util.tree_leaves(
+            qp, is_leaf=lambda x: isinstance(x, QuantLeaf)
+        ),
+    ):
+        w, r = np.asarray(w), np.asarray(r)
+        if not isinstance(q, QuantLeaf):
+            np.testing.assert_array_equal(w, r)  # small leaves exact
+            continue
+        assert q.q.dtype == jnp.int8 and q.q.shape == w.shape
+        axes = tuple(range(w.ndim - 1))
+        amax = np.abs(w).max(axis=axes, keepdims=True)
+        assert np.all(np.abs(w - r) <= amax / 127 / 2 + 1e-8)
+
+
+def test_quantized_tree_is_4x_smaller(lm):
+    _, params, _ = lm
+    fp = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
+    qb = quantized_nbytes(quantize_params(params))
+    # f32 -> int8 on the big leaves; scales + exact small leaves keep it
+    # from the theoretical 4.0x.
+    assert qb < 0.32 * fp, (qb, fp)
+
+
+def test_quantized_logits_close(lm):
+    model, params, cfg = lm
+    qm, qp = quantize_model(model, params)
+    x = np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % cfg.vocab_size
+    ref = np.asarray(model.apply({"params": params}, x), np.float32)
+    got = np.asarray(qm.apply({"params": qp}, x), np.float32)
+    # int8 weight noise perturbs logits but must stay small relative to
+    # the logit scale.
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(ref - got).max() / denom < 0.08
+
+
+def test_quantized_decode_all_entry_points(lm):
+    """The wrapper is a drop-in static-arg model for generate (dense +
+    ragged), beam, speculative, and scoring — everything compiles and
+    greedy tokens agree with the wrapper's own argmax reference."""
+    model, params, cfg = lm
+    qm, qp = quantize_model(model, params)
+    prompt = np.arange(2 * 12, dtype=np.int32).reshape(2, 12) % cfg.vocab_size
+
+    toks = np.asarray(
+        generate(qm, qp, prompt, max_new_tokens=6, temperature=0.0)
+    )
+    assert toks.shape == (2, 6)
+    beam_toks, beam_lp = beam_search(
+        qm, qp, prompt, beam_size=1, max_new_tokens=6
+    )
+    # beam_size=1 == greedy on the SAME quantized weights.
+    np.testing.assert_array_equal(np.asarray(beam_toks), toks)
+    spec = np.asarray(
+        speculative_generate(qm, qp, prompt, max_new_tokens=6, draft_len=3)
+    )
+    np.testing.assert_array_equal(spec, toks)
+    lp = np.asarray(sequence_logprob(qm, qp, prompt))
+    assert lp.shape == (2,) and np.all(np.isfinite(lp))
+
+
+def test_quantized_model_is_jit_static(lm):
+    """Two wrappers of the same model hash/compare equal, so jit reuses
+    the compiled program instead of retracing per wrapper instance."""
+    model, params, cfg = lm
+    a = QuantizedModel(model)
+    b = QuantizedModel(model)
+    assert a == b and hash(a) == hash(b)
+    assert a.config.n_ctx == cfg.n_ctx
+
+def test_generation_predictor_quantize(lm):
+    """engine integration: quantize='int8' at predictor construction."""
+    from tpuflow.infer import GenerationPredictor
+
+    model, params, cfg = lm
+    pred = GenerationPredictor(
+        model, params, max_new_tokens=4, temperature=0.0, quantize="int8"
+    )
+    out = pred({"tokens": [[1, 2, 3, 4], [5, 6]]})
+    assert np.asarray(out["generated"]).shape == (2, 4)
+    from tpuflow.infer.quant import QuantizedModel
+
+    assert isinstance(pred.model, QuantizedModel)
+    with pytest.raises(ValueError, match="unknown quantize"):
+        GenerationPredictor(model, params, max_new_tokens=4, quantize="fp4")

@@ -1140,9 +1140,12 @@ def _restore_raw_inner(
         if "TPUFLOW_IO_THREADS" not in os.environ:
             budget = max(budget, 4)
         workers = min(n_tasks, budget) or 1
-        # Each pooled task gets its slice of the native-reader thread budget
-        # so task-level parallelism doesn't multiply into oversubscription.
-        read_threads = max(1, _native.default_threads() // workers)
+        # Each pooled task gets its slice of the FLOORED budget (not the
+        # raw core count): a checkpoint with fewer shard files than the
+        # floor still drives the device at full width by striping each
+        # file over more native-reader threads — total inflight stays
+        # ~budget regardless of how the tree groups into files.
+        read_threads = max(1, budget // workers)
 
         def read_group(entry, tmpl, shard, devices):
             arr = _cast(

@@ -8,17 +8,27 @@ from tpuflow.infer.engine import (
     map_batches,
 )
 from tpuflow.infer.generate import generate, pad_ragged, render_tokens
+from tpuflow.infer.quant import (
+    QuantizedModel,
+    dequantize_params,
+    quantize_model,
+    quantize_params,
+)
 from tpuflow.infer.score import best_of_n, sequence_logprob
 from tpuflow.infer.speculative import speculative_generate
 
 __all__ = [
     "BatchPredictor",
     "GenerationPredictor",
+    "QuantizedModel",
     "beam_search",
     "best_of_n",
+    "dequantize_params",
     "generate",
     "map_batches",
     "pad_ragged",
+    "quantize_model",
+    "quantize_params",
     "render_tokens",
     "sequence_logprob",
     "speculative_generate",

@@ -166,7 +166,19 @@ class GenerationPredictor:
         pad_id: int = 0,
         pad_to: int | None = None,
         rng=None,
+        quantize: str | None = None,
     ):
+        if quantize is not None:
+            # Weight-only int8: decode is HBM-bound, int8 weights quarter
+            # the per-token stream (tpuflow.infer.quant). The wrapper is
+            # a drop-in static model; everything below is unchanged.
+            if quantize != "int8":
+                raise ValueError(
+                    f"unknown quantize mode {quantize!r}; supported: int8"
+                )
+            from tpuflow.infer.quant import quantize_model
+
+            model, params = quantize_model(model, params)
         self.model = model
         self.params = params
         self.max_new_tokens = max_new_tokens

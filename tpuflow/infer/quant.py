@@ -1,0 +1,137 @@
+"""Weight-only int8 quantization for the decode path.
+
+Autoregressive decode is HBM-bandwidth-bound: every emitted token
+streams the full weight set through the chip (the bench's decode leg is
+the memory-side complement of its MFU leg). Storing weights as int8
+with per-channel scales cuts that stream 4x vs f32 (2x vs bf16) — a
+direct decode-throughput lever on TPU, where the MXU natively consumes
+low-precision operands.
+
+Design (TPU/XLA-first):
+
+- **Quantize once, outside jit**: ``quantize_params`` walks the param
+  pytree and replaces big floating matrices with ``QuantLeaf(q, scale)``
+  — int8 values + a per-channel f32 scale (symmetric, max-abs / 127,
+  reduced over every axis but the last; biases, norms, and small leaves
+  stay exact).
+- **Dequantize inside the compiled program**: ``QuantizedModel`` wraps
+  any Flax model and rebuilds float weights *inside* ``apply`` — i.e.
+  inside the caller's jit trace — as ``q.astype(dtype) * scale``. XLA
+  fuses that convert+multiply into the consuming matmul's operand load,
+  so only int8 bytes cross HBM; nothing materializes a float copy of
+  the weights in device memory across steps.
+- **Zero integration surface**: the wrapper exposes ``apply`` and
+  ``config`` — exactly what ``generate`` / ``beam_search`` /
+  ``speculative_generate`` / ``score`` use — and is hashable, so it
+  rides the same ``static_argnums`` slot the raw model does. Every
+  decode feature (ragged prompts, chunked prefill, eos freezing, KV
+  cache) works unchanged.
+
+No parity counterpart in the reference (its engine serves f32 torch
+modules); this is a TPU-first capability on top of the D12 engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantLeaf(NamedTuple):
+    """int8 values + broadcastable per-channel scale (a pytree node:
+    checkpoints, device_put, and shardings see two ordinary arrays)."""
+
+    q: Any      # int8, original shape
+    scale: Any  # float, shape = original with all-but-last axes reduced
+
+
+def _is_quant(x) -> bool:
+    return isinstance(x, QuantLeaf)
+
+
+def quantize_params(
+    params,
+    *,
+    min_size: int = 4096,
+    scale_dtype=jnp.float32,
+):
+    """Replace large floating leaves (ndim >= 2, size >= ``min_size``)
+    with ``QuantLeaf``s. Symmetric per-channel quantization: the scale
+    is max-abs over every axis except the last, divided by 127 — for a
+    standard ``(in, out)`` kernel that is the per-output-channel scheme;
+    for the tied embedding ``(vocab, d)`` it is per-feature. Small
+    leaves (biases, LayerNorm, scalars) pass through exact."""
+
+    def one(leaf):
+        x = jnp.asarray(leaf)
+        if (
+            x.ndim < 2
+            or x.size < min_size
+            or not jnp.issubdtype(x.dtype, jnp.floating)
+        ):
+            return leaf
+        axes = tuple(range(x.ndim - 1))
+        amax = jnp.max(jnp.abs(x.astype(scale_dtype)), axis=axes,
+                       keepdims=True)
+        scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+        q = jnp.clip(jnp.round(x.astype(scale_dtype) / scale), -127, 127)
+        return QuantLeaf(q.astype(jnp.int8), scale.astype(scale_dtype))
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def dequantize_params(qparams, dtype=None):
+    """Rebuild float leaves from ``QuantLeaf``s. Call INSIDE jit (e.g.
+    via ``QuantizedModel.apply``) so XLA fuses the convert+scale into
+    the consuming matmul and only int8 crosses HBM."""
+
+    def one(leaf):
+        if not _is_quant(leaf):
+            return leaf
+        out_dtype = dtype or leaf.scale.dtype
+        return (leaf.q.astype(out_dtype) * leaf.scale.astype(out_dtype))
+
+    return jax.tree_util.tree_map(one, qparams, is_leaf=_is_quant)
+
+
+def quantized_nbytes(qparams) -> int:
+    """Device bytes of a (possibly partially) quantized tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(qparams):
+        total += leaf.nbytes
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedModel:
+    """Hashable shim exposing the two surfaces the decode stack uses
+    (``apply`` + ``config``), dequantizing inside the traced apply.
+
+    Use: ``qm, qp = quantize_model(model, params)`` then pass
+    ``(qm, qp)`` anywhere ``(model, params)`` went."""
+
+    model: Any
+    dtype: Any = None  # compute dtype for dequantized weights
+
+    def apply(self, variables, *args, **kwargs):
+        variables = dict(variables)
+        variables["params"] = dequantize_params(
+            variables["params"], self.dtype
+        )
+        return self.model.apply(variables, *args, **kwargs)
+
+    @property
+    def config(self):
+        return self.model.config
+
+
+def quantize_model(model, params, *, min_size: int = 4096, dtype=None):
+    """One-call form: returns ``(QuantizedModel, qparams)`` ready for
+    ``generate(qm, qp, ...)`` / ``BatchPredictor`` / beam / speculative."""
+    return (
+        QuantizedModel(model, dtype),
+        quantize_params(params, min_size=min_size),
+    )
