@@ -112,6 +112,12 @@ class TpuGptTrain(FlowSpec):
         "dots_with_no_batch_dims_saveable); empty = full block remat on "
         "the full-size presets",
     )
+    dtype = Parameter(
+        "dtype",
+        default="",
+        help="activation dtype: bfloat16 (TPU mixed precision; params and "
+        "optimizer stay f32) | float16 | float32 (default)",
+    )
 
     def _train_config(self):
         from tpuflow.train import GptTrainConfig
@@ -143,6 +149,7 @@ class TpuGptTrain(FlowSpec):
             ckpt_dtype=self.ckpt_dtype or None,
             decay_steps=int(self.decay_steps),
             remat_policy=self.remat_policy,
+            dtype=self.dtype,
         )
 
     @step

@@ -366,3 +366,40 @@ def test_gpt2_remat_cuts_peak_activation_memory():
     # measured 158 MiB).
     assert sel <= full, (sel, full)
     assert sel >= remat, (sel, remat)
+
+
+def test_gpt2_bf16_mixed_precision_contract():
+    """--dtype bfloat16 is the TPU recipe: bf16 activations/MXU operands,
+    f32 master params + optimizer state, f32 logits for the loss head.
+    Checkpoint payload dtypes are unchanged, so bf16 and f32 runs can
+    restore each other's checkpoints."""
+    from tpuflow.models.gpt2 import GPT2
+    from tpuflow.train import GptTrainConfig, TrainState
+
+    cfg = GptTrainConfig(preset="test", dtype="bfloat16").model_config()
+    assert cfg.dtype == jnp.bfloat16
+    model = GPT2(cfg)
+    tokens = np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % cfg.vocab_size
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    # Master weights stay f32 (flax param_dtype default).
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    # Logits come out f32 (stable softmax/CE head).
+    logits = model.apply({"params": params}, tokens)
+    assert logits.dtype == jnp.float32
+    # A train step runs and the optimizer state is f32 too.
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adamw(1e-3)
+    )
+    step = make_train_step()
+    batch = {"x": tokens, "y": np.roll(tokens, -1, axis=1)}
+    state, metrics = step(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unknown dtype"):
+        GptTrainConfig(preset="test", dtype="fp8").model_config()

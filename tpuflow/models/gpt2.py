@@ -83,21 +83,27 @@ class GPT2Config:
         seq_len: int = 64,
         stage_axis: int = 1,
         n_experts: int = 0,
+        dtype=None,
     ) -> "GPT2Config":
         """The flows' preset table: ``test`` (tiny, fast CPU compile),
         ``gpt2`` (124M), ``medium`` (355M). Full-size presets scan the
         layer stack (compile time independent of depth) and rematerialize
         blocks (activation memory independent of depth) — the TPU-first
-        defaults for real training."""
+        defaults for real training. ``dtype`` overrides the ACTIVATION
+        dtype (params/optimizer stay f32 — flax's param_dtype default):
+        ``jnp.bfloat16`` is the standard TPU mixed-precision recipe (MXU
+        operands in bf16, f32 master weights, f32 softmax/CE via the
+        model's float32 loss head)."""
+        extra = {} if dtype is None else {"dtype": dtype}
         if preset == "medium":
             return cls.medium(
                 attn_impl=attn_impl, scan_layers=True, remat=True,
-                n_experts=n_experts,
+                n_experts=n_experts, **extra,
             )
         if preset == "gpt2":
             return cls(
                 attn_impl=attn_impl, scan_layers=True, remat=True,
-                n_experts=n_experts,
+                n_experts=n_experts, **extra,
             )
         if preset == "test":
             return cls.small_test(
@@ -108,6 +114,7 @@ class GPT2Config:
                 scan_layers=stage_axis > 1,
                 n_layer=max(2, stage_axis),
                 n_experts=n_experts,
+                **extra,
             )
         raise ValueError(
             f"unknown preset {preset!r}; available: test, gpt2, medium"

@@ -61,16 +61,31 @@ class GptTrainConfig:
     # e.g. 'dots_with_no_batch_dims_saveable' (save MXU outputs,
     # recompute the cheap elementwise bulk).
     remat_policy: str = ""
+    # Activation dtype: '' = f32, 'bfloat16' = the standard TPU
+    # mixed-precision recipe (bf16 MXU operands, f32 master weights +
+    # optimizer state + loss head — checkpoints are unchanged).
+    dtype: str = ""
 
     def model_config(self):
+        import jax.numpy as jnp
+
         from tpuflow.models.gpt2 import GPT2Config
 
+        act_dtype = None
+        if self.dtype:
+            if self.dtype not in ("bfloat16", "float16", "float32"):
+                raise ValueError(
+                    f"unknown dtype {self.dtype!r}; supported: bfloat16, "
+                    "float16, float32"
+                )
+            act_dtype = jnp.dtype(self.dtype)
         cfg = GPT2Config.from_preset(
             self.preset,
             attn_impl=self.attn_impl,
             seq_len=self.seq_len,
             stage_axis=self.stage_axis,
             n_experts=self.experts,
+            dtype=act_dtype,
         )
         if self.remat_policy:
             import jax
