@@ -79,7 +79,11 @@ def _evidence_leg_is_fresh(leg: str) -> bool:
         )
     except (KeyError, ValueError):
         return False
-    return t >= _PROC_START - 120  # clock-skew slack
+    # Same host clock on both sides (recorded_at is written by this
+    # machine): no slack, or a record from a run killed moments before
+    # this one would be mislabeled as captured by this process. The
+    # stamp's 1 s resolution is covered by >=.
+    return t >= int(_PROC_START)
 
 
 def _evidence_read() -> dict | None:

@@ -500,3 +500,115 @@ def test_gang_topology_change_restore_bit_identical(tmp_path):
     finally:
         os.environ.pop("TPUFLOW_GANG_LOCAL_DEVICES", None)
         os.environ.pop("TPUFLOW_TEST_CKPT_DIR", None)
+
+
+def test_gang_kill_mid_save_leaves_no_torn_step(tmp_path):
+    """Crash DURING a save (shards on storage, no commit marker yet): the
+    torn step must be invisible to all_steps, swept as an orphan at the
+    retry's manager construction, and the gang must resume from the last
+    COMMITTED step — the commit-marker contract under real process death,
+    gang edition (the single-process twin lives in test_ckpt)."""
+    sentinel = tmp_path / "midsave"
+    os.environ["TPUFLOW_CRASH_SENTINEL"] = str(sentinel)
+    try:
+        flow_path = _write_flow(
+            tmp_path,
+            """
+            from tpuflow.flow import retry
+
+            class MS(FlowSpec):
+                @step
+                def start(self):
+                    self.next(self.train, num_parallel=2)
+
+                @retry(times=1)
+                @tpu(all_hosts_started_timeout=120)
+                @step
+                def train(self):
+                    import os
+                    import numpy as np
+                    import jax
+                    from jax.sharding import (
+                        Mesh, NamedSharding, PartitionSpec as P,
+                    )
+                    from tpuflow.ckpt import CheckpointManager
+                    from tpuflow.ckpt import raw as raw_fmt
+
+                    marker = (
+                        os.environ["TPUFLOW_CRASH_SENTINEL"]
+                        + f".p{jax.process_index()}"
+                    )
+                    # Deterministic mid-save death: the FIRST shard file
+                    # of step 2 lands on storage, then the process dies —
+                    # before the manifest/metadata commit can happen.
+                    orig_write = raw_fmt._write_one
+
+                    def sabotage(directory, fname, arr, pool=None):
+                        orig_write(directory, fname, arr, pool)
+                        if (os.sep + "step_2" + os.sep) in directory and not (
+                            os.path.exists(marker)
+                        ):
+                            open(marker, "w").write("x")
+                            os._exit(1)
+
+                    raw_fmt._write_one = sabotage
+
+                    mgr = CheckpointManager(
+                        os.path.join(current.tpu_storage_path, "ck"),
+                        async_save=False,
+                    )
+                    steps = mgr.all_steps()
+                    self.steps_at_start = list(steps)
+                    resumed_from = steps[-1] if steps else 0
+                    mesh = Mesh(np.asarray(jax.devices()), ("i",))
+                    sh = NamedSharding(mesh, P("i"))
+                    for ep in range(resumed_from + 1, 4):
+                        local = np.full((4,), float(ep), np.float32)
+                        w = jax.make_array_from_process_local_data(sh, local)
+                        mgr.save(
+                            ep, {"w": w}, metrics={"val_loss": 1.0 / ep}
+                        )
+                    self.final_steps = mgr.all_steps()
+                    # The resumed run must see the torn step-2 dir gone
+                    # (swept at construction) and full data in step 2's
+                    # committed replacement.
+                    restored = mgr.restore(2)
+                    self.step2_value = float(
+                        np.asarray(restored["w"]).mean()
+                    )
+                    mgr.close()
+                    self.next(self.done)
+
+                @step
+                def done(self, inputs):
+                    for inp in inputs:
+                        try:
+                            self.steps_at_start = inp.steps_at_start
+                            self.final_steps = inp.final_steps
+                            self.step2_value = inp.step2_value
+                            break
+                        except AttributeError:
+                            continue
+                    self.next(self.end)
+
+                @step
+                def end(self):
+                    pass
+            """,
+        )
+        MS = _load_flow(flow_path, "MS")
+        pathspec = FlowRunner(MS).run({})
+        from tpuflow.flow import Run
+
+        run = Run(pathspec)
+        assert run.successful
+        # Both members died mid-save of step 2...
+        assert os.path.exists(str(sentinel) + ".p0")
+        assert os.path.exists(str(sentinel) + ".p1")
+        # ...the retry saw ONLY the committed step 1 (torn step invisible)
+        assert run.data.steps_at_start == [1]
+        # ...and completed the run with a clean, fully-readable step 2.
+        assert run.data.final_steps[-1] == 3
+        assert run.data.step2_value == 2.0
+    finally:
+        os.environ.pop("TPUFLOW_CRASH_SENTINEL", None)

@@ -51,9 +51,32 @@ def test_quantize_roundtrip_error_bound(lm):
             np.testing.assert_array_equal(w, r)  # small leaves exact
             continue
         assert q.q.dtype == jnp.int8 and q.q.shape == w.shape
-        axes = tuple(range(w.ndim - 1))
+        axes = (
+            tuple(range(w.ndim - 1)) if w.ndim == 2
+            else tuple(range(1, w.ndim - 1))
+        )
         amax = np.abs(w).max(axis=axes, keepdims=True)
         assert np.all(np.abs(w - r) <= amax / 127 / 2 + 1e-8)
+
+
+def test_quantize_scan_stacked_kernels_keep_per_layer_scales():
+    """Under scan_layers kernels are (n_layer, in, out): one hot layer
+    must not inflate every other layer's scale (that would collapse
+    their int8 resolution to the hot layer's range)."""
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((4, 64, 64)).astype(np.float32)
+    w[2] *= 100.0  # one hot layer
+    q = quantize_params({"k": w})["k"]
+    assert isinstance(q, QuantLeaf)
+    assert q.scale.shape == (4, 1, 64)  # per-layer x per-out-channel
+    # Cold layers keep their own resolution: their scales are ~100x
+    # smaller than the hot layer's.
+    s = np.asarray(q.scale)
+    assert s[2].max() > 50 * s[0].max()
+    r = np.asarray(dequantize_params({"k": q})["k"])
+    for layer in range(4):
+        amax = np.abs(w[layer]).max(axis=0, keepdims=True)
+        assert np.all(np.abs(w[layer] - r[layer]) <= amax / 127 / 2 + 1e-8)
 
 
 def test_quantized_tree_is_4x_smaller(lm):

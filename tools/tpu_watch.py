@@ -114,7 +114,12 @@ def leg_fresh(rec: dict, since: float) -> bool:
                                           "%Y-%m-%dT%H:%M:%SZ"))
     except (KeyError, ValueError):
         return False
-    return t >= since - 120  # 2 min skew slack
+    # recorded_at and `since` come from the SAME host clock — no skew to
+    # absorb. A slack here would let a capture from a session killed
+    # moments ago satisfy this session's gates, which is exactly the
+    # stale-ledger outcome the gate exists to prevent. int(): the stamp
+    # truncates to whole seconds.
+    return t >= int(since)
 
 
 def git_quiescent() -> bool:

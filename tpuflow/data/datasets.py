@@ -399,7 +399,12 @@ def load_dataset(
         if os.path.exists(cache):
             z = np.load(cache)
             cached_synthetic = bool(z["synthetic"])
-            if not (cached_synthetic and _fetch_enabled()):
+            # The bypass only applies where a fetcher EXISTS for the
+            # dataset: for the others a fetch-enabled session would just
+            # regenerate identical synthetic data and rewrite the npz on
+            # every load, permanently defeating the cache.
+            fetchable = name == "fashion_mnist"
+            if not (cached_synthetic and fetchable and _fetch_enabled()):
                 return Dataset(
                     name,
                     Split(z["train_x"], z["train_y"]),

@@ -45,7 +45,7 @@ class QuantLeaf(NamedTuple):
     checkpoints, device_put, and shardings see two ordinary arrays)."""
 
     q: Any      # int8, original shape
-    scale: Any  # float, shape = original with all-but-last axes reduced
+    scale: Any  # float, broadcastable to q (reduced axes kept as size 1)
 
 
 def _is_quant(x) -> bool:
@@ -59,11 +59,12 @@ def quantize_params(
     scale_dtype=jnp.float32,
 ):
     """Replace large floating leaves (ndim >= 2, size >= ``min_size``)
-    with ``QuantLeaf``s. Symmetric per-channel quantization: the scale
-    is max-abs over every axis except the last, divided by 127 — for a
-    standard ``(in, out)`` kernel that is the per-output-channel scheme;
-    for the tied embedding ``(vocab, d)`` it is per-feature. Small
-    leaves (biases, LayerNorm, scalars) pass through exact."""
+    with ``QuantLeaf``s. Symmetric per-channel quantization, max-abs/127:
+    a 2-D ``(in, out)`` kernel reduces the in axis (per-output-channel);
+    3-D+ kernels reduce only the MIDDLE axes, keeping per-layer scales
+    for scan-stacked weights and per-in-channel scales for
+    ``(in, heads, head_dim)`` layouts. Small leaves (biases, LayerNorm,
+    scalars) pass through exact."""
 
     def one(leaf):
         x = jnp.asarray(leaf)
@@ -73,7 +74,17 @@ def quantize_params(
             or not jnp.issubdtype(x.dtype, jnp.floating)
         ):
             return leaf
-        axes = tuple(range(x.ndim - 1))
+        # 2-D (in, out): reduce the in axis — per-output-channel scales.
+        # 3-D+ kernels keep BOTH the leading and trailing axes: under
+        # scan_layers the leading axis is the layer stack (one hot layer
+        # must not inflate every other layer's scale and collapse its
+        # int8 resolution), and for (in, heads, head_dim)-style kernels
+        # the leading axis is the in-channel — either way finer scales
+        # only tighten the error bound.
+        axes = (
+            tuple(range(x.ndim - 1)) if x.ndim == 2
+            else tuple(range(1, x.ndim - 1))
+        )
         amax = jnp.max(jnp.abs(x.astype(scale_dtype)), axis=axes,
                        keepdims=True)
         scale = jnp.where(amax > 0, amax, 1.0) / 127.0
