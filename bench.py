@@ -984,13 +984,18 @@ def main() -> None:
     n_shards = int(os.environ.get("TPUFLOW_BENCH_DEVICES", "8"))
     payload_gib = float(os.environ.get("TPUFLOW_BENCH_GB", "1.0"))
 
-    from tpuflow.dist import ensure_healthy_platform, force_cpu_platform
+    from tpuflow.dist import (
+        ensure_healthy_platform,
+        force_cpu_platform,
+        maybe_enable_compile_cache,
+    )
 
     # Probe the default platform FIRST (verdict cached for the train leg),
     # then pin the checkpoint bench to host CPU unless explicitly overridden.
     ensure_healthy_platform(n_shards)
     if not use_device:
         force_cpu_platform(n_shards)
+    maybe_enable_compile_cache()
     import jax
     import numpy as np
 
@@ -1139,6 +1144,12 @@ if __name__ == "__main__":
             from tpuflow.dist import force_cpu_platform
 
             force_cpu_platform(8)
+        from tpuflow.dist import maybe_enable_compile_cache
+
+        # The evidence-capture child benefits most: a tunnel flap killing
+        # one attempt no longer costs the next attempt the 20-40 s TPU
+        # compiles it already paid for.
+        maybe_enable_compile_cache()
         print(json.dumps(bench_train()))
     else:
         main()

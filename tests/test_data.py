@@ -437,3 +437,30 @@ def test_stale_synthetic_cache_rebuilt_when_fetch_enabled(tmp_path, monkeypatch)
     monkeypatch.delenv("TPUFLOW_FETCH", raising=False)
     ds3 = load_dataset("fashion_mnist", data_dir=str(data_dir))
     assert not ds3.synthetic
+
+
+def test_stale_synthetic_cache_rebuilt_when_real_files_appear(tmp_path, monkeypatch):
+    """Pre-placed real IDX files appearing AFTER a synthetic cache was
+    written must win over the cache — without any fetch involvement."""
+    monkeypatch.delenv("TPUFLOW_FETCH", raising=False)
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "16")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "8")
+    ds = load_dataset("fashion_mnist", data_dir=str(tmp_path))
+    assert ds.synthetic  # cached the stand-in
+    rng = np.random.default_rng(1)
+    for split, n in (("train", 32), ("t10k", 8)):
+        imgs = rng.integers(0, 255, size=(n, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=n).astype(np.uint8)
+        with open(tmp_path / f"{split}-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">HBB3I", 0, 8, 3, n, 28, 28) + imgs.tobytes())
+        with open(tmp_path / f"{split}-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">HBB1I", 0, 8, 1, n) + labels.tobytes())
+    ds2 = load_dataset("fashion_mnist", data_dir=str(tmp_path))
+    assert not ds2.synthetic
+    assert ds2.train.images.shape == (32, 28, 28)
+    # Real cache now sticks even after the files are removed.
+    for split in ("train", "t10k"):
+        os.remove(tmp_path / f"{split}-images-idx3-ubyte")
+        os.remove(tmp_path / f"{split}-labels-idx1-ubyte")
+    ds3 = load_dataset("fashion_mnist", data_dir=str(tmp_path))
+    assert not ds3.synthetic

@@ -206,3 +206,59 @@ def test_hybrid_mesh_rejects_minus_one():
     devs = [_FakeDev(i, slice_index=i // 4) for i in range(8)]
     with pytest.raises(ValueError, match="-1"):
         make_hybrid_mesh({"data": 2}, {"fsdp": -1}, devices=devs)
+
+
+def test_persistent_compile_cache_hits_across_processes(tmp_path, monkeypatch):
+    """maybe_enable_compile_cache points JAX's persistent compilation
+    cache at $TPUFLOW_HOME/compile_cache: a second PROCESS running the
+    same jit program loads the compiled executable instead of
+    recompiling (the knob that amortizes 20-40 s TPU compiles across
+    retries/resumes/eval flows)."""
+    import os
+    import subprocess
+    import sys
+
+    home = tmp_path / "home"
+    prog = (
+        "import os\n"
+        "from tpuflow.dist import force_cpu_platform, "
+        "maybe_enable_compile_cache\n"
+        "force_cpu_platform(1)\n"
+        "d = maybe_enable_compile_cache()\n"
+        "assert d and os.path.isdir(d), d\n"
+        "import jax, jax.numpy as jnp\n"
+        # Force even this fast-compiling test program into the cache.
+        "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)\n"
+        "jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)\n"
+        "f = jax.jit(lambda x: jnp.tanh(x @ x).sum())\n"
+        "f(jnp.ones((64, 64))).block_until_ready()\n"
+        "print('CACHE_DIR', d)\n"
+    )
+    env = {**os.environ, "TPUFLOW_HOME": str(home), "TPUFLOW_FORCE_CPU": "1"}
+    p1 = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    cache_dir = home / "compile_cache"
+    entries = os.listdir(cache_dir)
+    assert entries, "first process wrote no cache entries"
+    mtimes = {e: os.path.getmtime(cache_dir / e) for e in entries}
+    # Second process: same program, same cache — must not ADD entries
+    # (every compile is served from the cache) and must still succeed.
+    p2 = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    entries2 = set(os.listdir(cache_dir))
+    assert entries2 == set(entries), (entries, entries2)
+    # TPUFLOW_COMPILE_CACHE=0 disables cleanly.
+    env_off = {**env, "TPUFLOW_COMPILE_CACHE": "0"}
+    p3 = subprocess.run(
+        [sys.executable, "-c",
+         "from tpuflow.dist import maybe_enable_compile_cache\n"
+         "assert maybe_enable_compile_cache() is None\n"],
+        env=env_off, capture_output=True, text=True, timeout=120,
+    )
+    assert p3.returncode == 0, p3.stderr[-2000:]

@@ -51,12 +51,12 @@ def test_quantize_roundtrip_error_bound(lm):
             np.testing.assert_array_equal(w, r)  # small leaves exact
             continue
         assert q.q.dtype == jnp.int8 and q.q.shape == w.shape
-        axes = (
-            tuple(range(w.ndim - 1)) if w.ndim == 2
-            else tuple(range(1, w.ndim - 1))
-        )
-        amax = np.abs(w).max(axis=axes, keepdims=True)
-        assert np.all(np.abs(w - r) <= amax / 127 / 2 + 1e-8)
+        # Scheme-independent bound: whatever grouping the quantizer
+        # chose, per-element error is at most half its own scale.
+        s = np.asarray(q.scale)
+        assert np.all(np.abs(w - r) <= s / 2 + 1e-8)
+        # And scales stay a negligible fraction of the int8 payload.
+        assert s.size * 4 <= max(w.size // 16, 256)
 
 
 def test_quantize_scan_stacked_kernels_keep_per_layer_scales():

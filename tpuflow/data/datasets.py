@@ -280,6 +280,23 @@ def _fetch_enabled() -> bool:
     return fetch_enabled()
 
 
+def _real_source_present(name: str, data_dir: str) -> bool:
+    """True when real (non-synthetic) source files for ``name`` exist
+    under ``data_dir`` right now — the signal that a synthetic npz cache
+    is stale and a rebuild would produce real data."""
+    if name in ("fashion_mnist", "mnist"):
+        return all(
+            _find(data_dir, [n]) is not None
+            for n in (
+                "train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte",
+            )
+        )
+    if name == "cifar10":
+        return os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py"))
+    return False
+
+
 def _load_fashion_mnist(data_dir: str, name: str) -> Dataset:
     prefix = "" if name == "fashion_mnist" else ""
 
@@ -399,12 +416,16 @@ def load_dataset(
         if os.path.exists(cache):
             z = np.load(cache)
             cached_synthetic = bool(z["synthetic"])
-            # The bypass only applies where a fetcher EXISTS for the
-            # dataset: for the others a fetch-enabled session would just
-            # regenerate identical synthetic data and rewrite the npz on
-            # every load, permanently defeating the cache.
-            fetchable = name == "fashion_mnist"
-            if not (cached_synthetic and fetchable and _fetch_enabled()):
+            # A stale SYNTHETIC cache must not shadow real data the
+            # loader could produce now: rebuild when real source files
+            # have appeared since the cache was written, or when the
+            # user enabled fetching for a dataset that has a fetcher.
+            # Otherwise honor the cache — rebuilding would regenerate
+            # identical synthetic data and rewrite the npz every load.
+            real_possible = _real_source_present(name, data_dir) or (
+                name == "fashion_mnist" and _fetch_enabled()
+            )
+            if not (cached_synthetic and real_possible):
                 return Dataset(
                     name,
                     Split(z["train_x"], z["train_y"]),

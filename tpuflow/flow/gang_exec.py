@@ -31,6 +31,11 @@ def _bootstrap_jax() -> None:
         local = int(os.environ.get("TPUFLOW_GANG_LOCAL_DEVICES", "1"))
         force_cpu_platform(local, exact=True)
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # Gang members share the persistent compile cache: after one worker
+    # (or a previous attempt) compiled the step, the rest load it.
+    from tpuflow.dist import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
 
 
 def _store_artifacts(flow_name: str, run_id: str, step_name: str) -> dict:

@@ -464,9 +464,16 @@ def main(flow_cls: type[FlowSpec], argv: list[str] | None = None):
     if cmd in ("run", "trigger"):
         # Don't let a hung accelerator tunnel stall the whole run: probe the
         # default platform and fall back to virtual CPU devices if needed.
-        from tpuflow.dist import ensure_healthy_platform
+        from tpuflow.dist import (
+            ensure_healthy_platform,
+            maybe_enable_compile_cache,
+        )
 
         ensure_healthy_platform()
+        # Persistent XLA compile cache: retry attempts, resumes, and the
+        # triggered eval flow reload compiled executables instead of
+        # re-paying the 20-40 s TPU compile.
+        maybe_enable_compile_cache()
     if cmd == "run":
         params, triggered = _parse_params(flow_cls, rest)
         return runner.run(params, triggered=triggered)

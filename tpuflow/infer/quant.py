@@ -78,13 +78,17 @@ def quantize_params(
         # 3-D+ kernels keep BOTH the leading and trailing axes: under
         # scan_layers the leading axis is the layer stack (one hot layer
         # must not inflate every other layer's scale and collapse its
-        # int8 resolution), and for (in, heads, head_dim)-style kernels
-        # the leading axis is the in-channel — either way finer scales
-        # only tighten the error bound.
+        # int8 resolution). Guard: the scale tensor must stay a
+        # negligible fraction of the int8 bytes — a head-split layout
+        # like (in, heads, head_dim) would otherwise make shape[0] *
+        # shape[-1] scales eat the compression the module exists for, so
+        # such leaves fall back to the all-but-last reduction.
         axes = (
             tuple(range(x.ndim - 1)) if x.ndim == 2
             else tuple(range(1, x.ndim - 1))
         )
+        if x.ndim > 2 and x.shape[0] * x.shape[-1] * 4 > x.size // 16:
+            axes = tuple(range(x.ndim - 1))
         amax = jnp.max(jnp.abs(x.astype(scale_dtype)), axis=axes,
                        keepdims=True)
         scale = jnp.where(amax > 0, amax, 1.0) / 127.0
