@@ -42,7 +42,9 @@ import time
 
 
 def _log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    # Wall-clock stamp: leg logs double as forensics for tunnel-window
+    # timeouts — "which phase was live when the window closed" needs times.
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
 # On-TPU evidence ledger (committed to the repo): every bench leg that
@@ -174,6 +176,63 @@ def _peak_flops_for(device_kind: str) -> float:
     return next((v for k, v in _PEAK_FLOPS if k in kind), _DEFAULT_PEAK)
 
 
+def _first_train_step(cfg, batch: int, label: str):
+    """Shared setup for every train-bench leg: build the model on a
+    data-mesh, create the donated-AdamW TrainState, shard a synthetic
+    batch, compile + run the first step. One implementation so the smoke
+    leg, the MFU leg, and the CPU leg all measure the SAME pipeline.
+
+    Timing closes on a device→host scalar fetch (``float(loss)``), NOT
+    block_until_ready: on the tunneled TPU platform used on dev boxes
+    block_until_ready acknowledges dispatch without waiting for
+    execution (measured: 10 steps "complete" in 14 ms), which round 1
+    turned into a >100% MFU claim. float(loss) transitively forces the
+    whole step chain to finish on any platform.
+    """
+    import time as _time
+    from types import SimpleNamespace
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tpuflow import dist
+    from tpuflow.models.gpt2 import GPT2
+    from tpuflow.train import TrainState, make_train_step
+
+    t_build = _time.monotonic()
+    _log(f"[bench] {label}: building model")
+    mesh = dist.make_mesh({"data": len(jax.devices())})
+    model = GPT2(cfg)
+    tokens = np.arange(batch * (cfg.n_ctx + 1), dtype=np.int32).reshape(
+        batch, cfg.n_ctx + 1
+    ) % cfg.vocab_size
+    with mesh:
+        params = model.init(jax.random.PRNGKey(0), tokens[:1, :-1])["params"]
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adamw(1e-4)
+        )
+        state = state.replace(params=dist.replicate(state.params, mesh))
+        data = dist.shard_batch({"x": tokens[:, :-1], "y": tokens[:, 1:]}, mesh)
+        step = make_train_step()
+        rng = jax.random.PRNGKey(1)
+        build_s = _time.monotonic() - t_build
+        _log(f"[bench] {label}: built in {build_s:.1f}s, compiling + "
+             "first step")
+        t0 = _time.monotonic()
+        state, metrics = step(state, data, rng)
+        loss = float(metrics["loss"])
+        compile_s = _time.monotonic() - t0
+    _log(f"[bench] {label}: compiled in {compile_s:.1f}s loss={loss:.3f}")
+    return SimpleNamespace(
+        mesh=mesh, model=model, state=state, data=data, step=step, rng=rng,
+        n_params=n_params, loss=loss, build_s=build_s, compile_s=compile_s,
+    )
+
+
 def bench_train() -> dict | None:
     """Train-step throughput + MFU on the flagship model (BASELINE.md row 2:
     'training step throughput — measure & report'; reference hot loop
@@ -189,15 +248,36 @@ def bench_train() -> dict | None:
 
     import jax
     import numpy as np
-    import optax
 
-    from tpuflow import dist
-    from tpuflow.models.gpt2 import GPT2, GPT2Config
-    from tpuflow.train import TrainState, make_train_step
+    from tpuflow.models.gpt2 import GPT2Config
 
     platform = jax.default_backend()
     on_tpu = platform == "tpu"
     import jax.numpy as jnp
+
+    tiny = dict(vocab_size=2048, n_ctx=128, n_embd=128, n_layer=2, n_head=4,
+                dropout=0.0)
+    if on_tpu and os.environ.get("TPUFLOW_TRAIN_SMOKE") != "0":
+        # First-contact insurance for brief tunnel windows (r4: a 20-min
+        # healthy window closed mid-compile of the 124M leg and left
+        # NOTHING). A 2-layer model compiles in a fraction of the time;
+        # its record proves real on-chip execution (platform, device
+        # kind, compile time, finite loss) and is merged IMMEDIATELY —
+        # the MFU/flash/decode legs then extend it if the window holds.
+        try:
+            s = _first_train_step(
+                GPT2Config(dtype=jnp.bfloat16, **tiny), 8, "smoke"
+            )
+            _evidence_merge({"train_smoke": {
+                "platform": "tpu",
+                "device_kind": jax.devices()[0].device_kind,
+                "model": "gpt2-2layer-smoke",
+                "wall_to_first_step_s": round(s.build_s + s.compile_s, 1),
+                "loss": round(s.loss, 4),
+                "loss_finite": bool(np.isfinite(s.loss)),
+            }})
+        except Exception as e:  # insurance must never block the MFU leg
+            _log(f"[bench] smoke failed: {e!r}")
 
     if on_tpu:
         cfg = GPT2Config(
@@ -207,40 +287,14 @@ def bench_train() -> dict | None:
         batch = 8
         n_timed = 20
     else:  # CPU smoke: prove the path; the number is not an MFU claim
-        cfg = GPT2Config(
-            vocab_size=2048, n_ctx=128, n_embd=128, n_layer=2, n_head=4,
-            dropout=0.0, dtype=jnp.float32,
-        )
+        cfg = GPT2Config(dtype=jnp.float32, **tiny)
         batch = 8
         n_timed = 3
-    _log(f"[bench] train child: platform={platform}, building model")
-    mesh = dist.make_mesh({"data": len(jax.devices())})
-    model = GPT2(cfg)
-    tokens = np.arange(batch * (cfg.n_ctx + 1), dtype=np.int32).reshape(
-        batch, cfg.n_ctx + 1
-    ) % cfg.vocab_size
-    with mesh:
-        params = model.init(jax.random.PRNGKey(0), tokens[:1, :-1])["params"]
-        n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
-        state = TrainState.create(
-            apply_fn=model.apply, params=params, tx=optax.adamw(1e-4)
-        )
-        state = state.replace(params=dist.replicate(state.params, mesh))
-        data = dist.shard_batch({"x": tokens[:, :-1], "y": tokens[:, 1:]}, mesh)
-        step = make_train_step()
-        rng = jax.random.PRNGKey(1)
-        # Timing is closed by a device→host scalar fetch, NOT
-        # block_until_ready: on the tunneled TPU platform used on dev boxes
-        # block_until_ready acknowledges dispatch without waiting for
-        # execution (measured: 10 steps "complete" in 14 ms), which round 1
-        # turned into a >100% MFU claim. float(loss) transitively forces the
-        # whole step chain to finish on any platform.
-        _log("[bench] train child: compiling + first step")
-        t0 = _time.monotonic()
-        state, metrics = step(state, data, rng)
-        float(metrics["loss"])
-        compile_s = _time.monotonic() - t0
-        _log(f"[bench] train child: compiled in {compile_s:.1f}s, timing")
+    r = _first_train_step(cfg, batch, f"train child ({platform})")
+    model, state, data, rng = r.model, r.state, r.data, r.rng
+    n_params, compile_s, step = r.n_params, r.compile_s, r.step
+    with r.mesh:
+        _log("[bench] train child: timing")
         for _ in range(2):  # warmup post-compile
             state, metrics = step(state, data, rng)
         float(metrics["loss"])

@@ -213,7 +213,10 @@ def test_persistent_compile_cache_hits_across_processes(tmp_path, monkeypatch):
     cache at $TPUFLOW_HOME/compile_cache: a second PROCESS running the
     same jit program loads the compiled executable instead of
     recompiling (the knob that amortizes 20-40 s TPU compiles across
-    retries/resumes/eval flows)."""
+    retries/resumes/eval flows). CPU processes need the explicit
+    TPUFLOW_COMPILE_CACHE_CPU=1 opt-in: jaxlib's CPU AOT reload path is
+    unsafe (machine-feature mismatch aborts), so by default the cache
+    only engages on accelerator platforms — pinned at the end."""
     import os
     import subprocess
     import sys
@@ -234,7 +237,8 @@ def test_persistent_compile_cache_hits_across_processes(tmp_path, monkeypatch):
         "f(jnp.ones((64, 64))).block_until_ready()\n"
         "print('CACHE_DIR', d)\n"
     )
-    env = {**os.environ, "TPUFLOW_HOME": str(home), "TPUFLOW_FORCE_CPU": "1"}
+    env = {**os.environ, "TPUFLOW_HOME": str(home), "TPUFLOW_FORCE_CPU": "1",
+           "TPUFLOW_COMPILE_CACHE_CPU": "1"}
     p1 = subprocess.run(
         [sys.executable, "-c", prog], env=env, capture_output=True,
         text=True, timeout=180,
@@ -253,7 +257,7 @@ def test_persistent_compile_cache_hits_across_processes(tmp_path, monkeypatch):
     assert p2.returncode == 0, p2.stderr[-2000:]
     entries2 = set(os.listdir(cache_dir))
     assert entries2 == set(entries), (entries, entries2)
-    # TPUFLOW_COMPILE_CACHE=0 disables cleanly.
+    # TPUFLOW_COMPILE_CACHE=0 disables cleanly even with the CPU opt-in.
     env_off = {**env, "TPUFLOW_COMPILE_CACHE": "0"}
     p3 = subprocess.run(
         [sys.executable, "-c",
@@ -262,3 +266,16 @@ def test_persistent_compile_cache_hits_across_processes(tmp_path, monkeypatch):
         env=env_off, capture_output=True, text=True, timeout=120,
     )
     assert p3.returncode == 0, p3.stderr[-2000:]
+    # Default CPU policy: SKIPPED (no opt-in) — the unsafe AOT reload
+    # path must never engage for test/gang/bench CPU processes.
+    env_cpu_default = {k: v for k, v in env.items()
+                       if k != "TPUFLOW_COMPILE_CACHE_CPU"}
+    p4 = subprocess.run(
+        [sys.executable, "-c",
+         "from tpuflow.dist import force_cpu_platform, "
+         "maybe_enable_compile_cache\n"
+         "force_cpu_platform(1)\n"
+         "assert maybe_enable_compile_cache() is None\n"],
+        env=env_cpu_default, capture_output=True, text=True, timeout=120,
+    )
+    assert p4.returncode == 0, p4.stderr[-2000:]

@@ -77,17 +77,34 @@ def probe(timeout_s: float) -> str | None:
 def run_leg(argv: list[str], extra_env: dict[str, str],
             timeout_s: float, label: str) -> bool:
     _drop_probe_cache()
+    # Stream the child's output to a per-leg file: a timed-out leg must
+    # leave diagnosable breadcrumbs (which phase it died in), not vanish
+    # with its captured pipes (that erased the r4 first-window forensics).
+    log_path = os.path.join(
+        REPO, "tools", f"tpu_watch_leg_{label.replace(' ', '_')}.log"
+    )
+    with open(log_path, "a") as logf:
+        logf.write(f"\n=== {time.strftime('%Y-%m-%dT%H:%M:%SZ')} "
+                   f"{label} ===\n")
+        logf.flush()
+        run_start = logf.tell()  # tail THIS run, not prior appends
+        try:
+            p = subprocess.run(
+                [sys.executable] + argv,
+                env=_clean_env(extra_env), timeout=timeout_s,
+                stdout=logf, stderr=subprocess.STDOUT,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"[tpu_watch] {label} timed out after {timeout_s:.0f}s "
+                  f"(phase log: {log_path})", flush=True)
+            return False
+    tail = ""
     try:
-        p = subprocess.run(
-            [sys.executable] + argv,
-            env=_clean_env(extra_env), timeout=timeout_s,
-            capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        print(f"[tpu_watch] {label} timed out after {timeout_s:.0f}s",
-              flush=True)
-        return False
-    tail = "\n".join(p.stderr.splitlines()[-25:])
+        with open(log_path) as f:
+            f.seek(run_start)
+            tail = "\n".join(f.read().splitlines()[-20:])
+    except OSError:
+        pass
     print(f"[tpu_watch] {label} rc={p.returncode}\n{tail}", flush=True)
     return p.returncode == 0
 

@@ -46,6 +46,35 @@ _DEFAULT_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ, AXIS_EXPERT)
 _initialized_multihost = False
 
 
+def _platform_is_cpu() -> bool:
+    """Best-effort 'is this process targeting XLA:CPU?' WITHOUT forcing
+    backend initialization (callers run before init on purpose — probing
+    a dead TPU tunnel from here would hang them). Pre-init the verdict
+    comes from the platform selection config/env that force_cpu_platform
+    sets; an unset platform means 'default' (an accelerator when one
+    exists), which reports False."""
+    try:
+        backends = getattr(jax._src.xla_bridge, "_backends", None)
+        if backends:  # initialized: the authoritative answer is free
+            return jax.default_backend() == "cpu"
+    except Exception:
+        pass
+    selected = ""
+    try:
+        selected = jax.config.jax_platforms or ""
+    except AttributeError:
+        pass
+    selected = selected or os.environ.get("JAX_PLATFORMS", "")
+    if selected:
+        return selected.split(",")[0].strip().lower() == "cpu"
+    # No explicit selection: on a CPU-only host the 'default' platform IS
+    # XLA:CPU, so fall back to ensure_healthy_platform's probe verdict
+    # (it records the probed backend name for exactly this kind of
+    # pre-init consumer). Unset means no probe ran — an accelerator-
+    # targeting entry point — and reports False.
+    return os.environ.get("TPUFLOW_PLATFORM_BACKEND", "") == "cpu"
+
+
 def maybe_enable_compile_cache() -> str | None:
     """Point JAX's persistent compilation cache at a durable directory.
 
@@ -54,13 +83,29 @@ def maybe_enable_compile_cache() -> str | None:
     run, next epoch's eval flow, gang restart) load the compiled
     executable instead of recompiling — the same jit program key hits
     across processes. Default ON at ``$TPUFLOW_HOME/compile_cache``
-    (compilation caching is a pure win: keyed on HLO + config, never
-    stale); ``TPUFLOW_COMPILE_CACHE=0`` disables, any other value is
-    used as the cache directory. Returns the directory in use, or None.
+    (compilation caching is keyed on HLO + config, never stale);
+    ``TPUFLOW_COMPILE_CACHE`` recognizes 0/false/off (disable) and
+    1/true/on/unset (default directory); any other value is used as
+    the cache directory itself. Returns the directory in use, or None.
     Safe to call any number of times and before/after backend init.
+
+    CPU platforms are excluded: jaxlib's XLA:CPU AOT loader
+    (cpu_aot_loader.cc) re-checks LLVM machine features when it
+    deserializes a cached executable, and XLA's tuning pseudo-features
+    (+prefer-no-scatter/+prefer-no-gather) never appear in the host
+    feature probe — reloads warn about a machine mismatch and can
+    abort the process outright (observed: deterministic SIGABRT in the
+    pipeline-parallel acceptance test when its step reloaded from
+    cache). CPU compiles are seconds, so the cache buys nothing there;
+    ``TPUFLOW_COMPILE_CACHE_CPU=1`` force-enables for experiments.
     """
     knob = os.environ.get("TPUFLOW_COMPILE_CACHE", "")
     if knob.lower() in ("0", "false", "off"):
+        return None
+    if (
+        _platform_is_cpu()
+        and os.environ.get("TPUFLOW_COMPILE_CACHE_CPU") != "1"
+    ):
         return None
     if knob.lower() in ("", "1", "true", "on"):
         # Conventional enable spellings mean "default directory" — NOT a
