@@ -59,6 +59,23 @@ def test_idx_decode_roundtrip(tmp_path):
     np.testing.assert_array_equal(_read_idx(str(lp)), labels)
 
 
+def test_corrupt_real_source_falls_back_to_synthetic_cache(tmp_path,
+                                                           monkeypatch):
+    """Truncated/zero-byte IDX files appearing next to a valid synthetic
+    cache must not turn load_dataset into a crash loop: the loader tries
+    the real bytes, fails, and serves the cached stand-in (r4 review)."""
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "100")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "20")
+    a = load_dataset("fashion_mnist", data_dir=str(tmp_path))
+    assert a.synthetic
+    for n in ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+              "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"):
+        (tmp_path / n).write_bytes(b"")  # present but unreadable
+    b = load_dataset("fashion_mnist", data_dir=str(tmp_path))
+    assert b.synthetic
+    np.testing.assert_array_equal(a.train.images, b.train.images)
+
+
 def test_idx_files_used_when_present(tmp_path):
     """If all four IDX files exist the loader uses them, not synthesis."""
     rng = np.random.default_rng(0)

@@ -23,6 +23,7 @@ import gzip
 import os
 import pickle
 import struct
+import sys
 
 import numpy as np
 
@@ -293,7 +294,14 @@ def _real_source_present(name: str, data_dir: str) -> bool:
             )
         )
     if name == "cifar10":
-        return os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py"))
+        # The directory alone is not enough: a partially extracted
+        # tarball would steer the loader off a usable synthetic cache
+        # and into a FileNotFoundError on the missing batch files.
+        batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+        return all(
+            os.path.exists(os.path.join(batch_dir, f))
+            for f in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+        )
     return False
 
 
@@ -425,31 +433,48 @@ def load_dataset(
             real_possible = _real_source_present(name, data_dir) or (
                 name == "fashion_mnist" and _fetch_enabled()
             )
-            if not (cached_synthetic and real_possible):
-                return Dataset(
-                    name,
-                    Split(z["train_x"], z["train_y"]),
-                    Split(z["test_x"], z["test_y"]),
-                    int(z["num_classes"]),
-                    cached_synthetic,
-                )
-            # The cache records a synthetic stand-in but the user has now
-            # explicitly enabled fetching (TPUFLOW_FETCH=1): a stale
-            # synthetic cache must not silently defeat the request for
-            # real bytes — fall through and rebuild (the fetch hook runs
-            # inside the loader; on fetch failure the rebuild regenerates
-            # the same synthetic data and re-caches).
-        if name in ("fashion_mnist", "mnist"):
-            ds = _load_fashion_mnist(data_dir, name)
-        elif name == "cifar10":
-            ds = _load_cifar10(data_dir)
-        elif name == "imagenet_synth":
-            ds = _load_synthetic_imagenet(synthetic_size)
-        else:
-            raise KeyError(
-                f"unknown dataset {name!r}; available: fashion_mnist, mnist, "
-                "cifar10, imagenet_synth, lm_synth, lm_text"
+            cached_ds = Dataset(
+                name,
+                Split(z["train_x"], z["train_y"]),
+                Split(z["test_x"], z["test_y"]),
+                int(z["num_classes"]),
+                cached_synthetic,
             )
+            if not (cached_synthetic and real_possible):
+                return cached_ds
+            # The cache records a synthetic stand-in but real source
+            # files (or an explicitly enabled fetch) have appeared: a
+            # stale synthetic cache must not silently defeat the request
+            # for real bytes — fall through and rebuild. The cached
+            # dataset is kept as a FALLBACK: present-but-corrupt source
+            # files (truncated download, interrupted extract) must
+            # degrade back to the still-valid synthetic stand-in, not
+            # turn every subsequent load into a crash.
+        else:
+            cached_ds = None
+        try:
+            if name in ("fashion_mnist", "mnist"):
+                ds = _load_fashion_mnist(data_dir, name)
+            elif name == "cifar10":
+                ds = _load_cifar10(data_dir)
+            elif name == "imagenet_synth":
+                ds = _load_synthetic_imagenet(synthetic_size)
+            else:
+                raise KeyError(
+                    f"unknown dataset {name!r}; available: fashion_mnist, "
+                    "mnist, cifar10, imagenet_synth, lm_synth, lm_text"
+                )
+        except KeyError:
+            raise  # unknown name is a caller bug, never maskable
+        except Exception as e:
+            if cached_ds is not None:
+                print(
+                    f"[tpuflow.data] real source for {name!r} present but "
+                    f"unreadable ({e!r}); serving cached synthetic "
+                    "stand-in", file=sys.stderr,
+                )
+                return cached_ds
+            raise
         np.savez(
             cache,
             train_x=ds.train.images,

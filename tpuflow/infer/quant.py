@@ -34,10 +34,12 @@ modules); this is a TPU-first capability on top of the D12 engine.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class QuantLeaf(NamedTuple):
@@ -81,14 +83,27 @@ def quantize_params(
         # int8 resolution). Guard: the scale tensor must stay a
         # negligible fraction of the int8 bytes — a head-split layout
         # like (in, heads, head_dim) would otherwise make shape[0] *
-        # shape[-1] scales eat the compression the module exists for, so
-        # such leaves fall back to the all-but-last reduction.
+        # shape[-1] scales eat the compression the module exists for.
+        # The fallback reduces everything BUT the leading axis: the
+        # leading slice is the one whose independence matters (the layer
+        # of a scan stack), and dequantize_params rebuilds full floats
+        # inside jit before the matmul, so coarser scales cost only
+        # resolution, never exactness. Reducing the leading axis away
+        # instead would re-create the hot-layer bleed this layout exists
+        # to prevent (caught in review, r4).
         axes = (
             tuple(range(x.ndim - 1)) if x.ndim == 2
             else tuple(range(1, x.ndim - 1))
         )
-        if x.ndim > 2 and x.shape[0] * x.shape[-1] * 4 > x.size // 16:
-            axes = tuple(range(x.ndim - 1))
+        itemsize = np.dtype(scale_dtype).itemsize
+        n_scales = x.size // math.prod(x.shape[a] for a in axes)
+        if n_scales * itemsize > x.size // 16:
+            # 2-D: collapse to one per-tensor scale; 3-D+: one scale per
+            # leading slice (per layer of a scan stack).
+            axes = (
+                tuple(range(x.ndim)) if x.ndim == 2
+                else tuple(range(1, x.ndim))
+            )
         amax = jnp.max(jnp.abs(x.astype(scale_dtype)), axis=axes,
                        keepdims=True)
         scale = jnp.where(amax > 0, amax, 1.0) / 127.0
