@@ -231,6 +231,7 @@ def train_func_per_worker(config: dict) -> None:
             train_loader, ctx.mesh, keys=("x", "y")
         ):
             state, train_metrics = train_step(state, placed, rng)
+            dist.step_fence(train_metrics["loss"])
             n_batches += 1
         # Block before timing/eval: keeps host and devices in step (and on the
         # CPU dev platform avoids queueing concurrent collective programs).

@@ -279,3 +279,22 @@ def test_persistent_compile_cache_hits_across_processes(tmp_path, monkeypatch):
         env=env_cpu_default, capture_output=True, text=True, timeout=120,
     )
     assert p4.returncode == 0, p4.stderr[-2000:]
+
+
+def test_step_fence_serializes_only_on_cpu_simulation():
+    """The oversubscribed-CPU predicate gates the hot-loop fence: on this
+    8-virtual-device CPU test platform it must say 'serialize', and
+    step_fence must force completion while passing its argument through
+    (the regression it guards: XLA:CPU's 40s collective-rendezvous
+    termination killing the MLP flow's async-dispatched epoch)."""
+    import jax.numpy as jnp
+
+    from tpuflow import dist
+
+    assert dist.serialize_steps() is True
+    mesh = dist.make_mesh({"data": len(jax.devices())})
+    x = dist.replicate(jnp.arange(8.0), mesh)
+    y = jax.jit(lambda v: v * 2)(x)
+    out = dist.step_fence(y)
+    assert out is y
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 2)

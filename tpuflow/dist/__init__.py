@@ -28,6 +28,8 @@ from tpuflow.dist.mesh import (
     process_index,
     replicate,
     replicated,
+    serialize_steps,
+    step_fence,
     shard_batch,
     shutdown,
 )
@@ -52,6 +54,8 @@ __all__ = [
     "process_index",
     "replicate",
     "replicated",
+    "serialize_steps",
+    "step_fence",
     "shard_batch",
     "shutdown",
 ]

@@ -592,6 +592,34 @@ def replicate(tree, mesh: Mesh):
     return jax.device_put(tree, replicated(mesh))
 
 
+def serialize_steps() -> bool:
+    """True when a hot loop must block each step before dispatching the next.
+
+    XLA:CPU's collective rendezvous (rendezvous.cc) *terminates the
+    process* when a participant thread fails to arrive within 40 s. On an
+    oversubscribed host-CPU simulation (8 virtual devices on a 1-core dev
+    box) asynchronously queued train-step programs plus the Python
+    dispatch loop starve the per-device executor threads long enough to
+    trip exactly that: the first epoch of the MLP flow died with
+    "Expected 8 threads to join the rendezvous, but only 7 of them
+    arrived" at op_id=1. Blocking per step keeps at most one collective
+    program in flight and parks the Python thread, which is precisely
+    the regime every test and bench leg already runs green. Accelerator
+    platforms return False and keep fully async dispatch.
+    """
+    return jax.default_backend() == "cpu" and len(jax.devices()) > 1
+
+
+def step_fence(x):
+    """Block on ``x`` when :func:`serialize_steps` says the platform needs
+    serialized dispatch; a no-op pass-through on accelerators. Hot loops
+    call this unconditionally on each step's output so the decision (and
+    its rationale, above) lives in exactly one place."""
+    if serialize_steps():
+        jax.block_until_ready(x)
+    return x
+
+
 def barrier(name: str = "tpuflow") -> None:
     """Block until all processes reach this point (parity: the collective
     behavior of ray.train.report, reference my_ray_module.py:203-205)."""

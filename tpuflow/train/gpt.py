@@ -324,6 +324,7 @@ def _train_fsdp(
                     jax.block_until_ready(metrics["loss"])
                     t_epoch = time.monotonic()
                 else:
+                    dist.step_fence(metrics["loss"])
                     n_tokens += int(np.prod(b["y"].shape))
             jax.block_until_ready(state.params)
             epoch_s = time.monotonic() - t_epoch
@@ -524,6 +525,7 @@ def _train_pipeline(
                     jax.device_put(b["x"], data_sharding),
                     jax.device_put(b["y"], data_sharding),
                 )
+                dist.step_fence(loss)
                 losses.append(loss)
                 global_step += 1
             jax.block_until_ready(params)
