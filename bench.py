@@ -233,6 +233,101 @@ def _first_train_step(cfg, batch: int, label: str):
     )
 
 
+def _timed_throughput(r, cfg, batch: int, n_timed: int, on_tpu: bool):
+    """Post-compile timed step loop shared by the train leg and the MFU
+    sweep: returns ``(record, final_state)`` where the record carries
+    steps/s, tokens/s, model TFLOP/s and (on TPU) MFU. Timing closes on a
+    ``float(loss)`` fetch — see _first_train_step on why block_until_ready
+    is not a completion point on the tunneled platform."""
+    import time as _time
+
+    import jax
+
+    state, data, rng, step = r.state, r.data, r.rng, r.step
+    with r.mesh:
+        _log(f"[bench] timing {n_timed} steps (b={batch}, T={cfg.n_ctx})")
+        for _ in range(2):  # warmup post-compile
+            state, metrics = step(state, data, rng)
+        float(metrics["loss"])
+        t0 = _time.monotonic()
+        for _ in range(n_timed):
+            state, metrics = step(state, data, rng)
+        float(metrics["loss"])  # completion of step N implies 1..N-1 done
+        dt = (_time.monotonic() - t0) / n_timed
+    tokens_per_s = batch * cfg.n_ctx / dt
+    flops_per_s = 6.0 * r.n_params * tokens_per_s
+    mfu = None
+    if on_tpu:
+        peak = _peak_flops_for(jax.devices()[0].device_kind)
+        mfu = flops_per_s / (peak * len(jax.devices()))
+    rec = {
+        "model": f"gpt2-{r.n_params / 1e6:.0f}M",
+        "batch": batch,
+        "seq": cfg.n_ctx,
+        "steps_per_s": round(1.0 / dt, 3),
+        "tokens_per_s": round(tokens_per_s, 1),
+        "model_tflops_per_s": round(flops_per_s / 1e12, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "compile_s": round(r.compile_s, 1),
+        "timed_steps": n_timed,
+    }
+    return rec, state
+
+
+def bench_mfu_sweep() -> dict | None:
+    """Batch/seq sweep of the flagship train step on the chip: the r3
+    train leg's b=8/T=512 point left MFU at 0.43 — larger batches and
+    longer sequences raise arithmetic intensity on the MXU. Each config
+    pays its own compile (persistent cache makes retries cheap); the
+    running best is merged into the evidence ledger after every config so
+    a tunnel flap strands at most the config it interrupted."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models.gpt2 import GPT2Config
+
+    if jax.default_backend() != "tpu":
+        _log("[bench] mfu sweep: not on TPU, skipping")
+        return None
+    results: dict[str, dict] = {}
+    summary: dict | None = None
+    for batch, seq in ((16, 512), (32, 512), (16, 1024)):
+        cfg = GPT2Config(
+            vocab_size=50257, n_ctx=seq, n_embd=768, n_layer=12, n_head=12,
+            dropout=0.0, dtype=jnp.bfloat16,
+        )
+        r = state = None
+        try:
+            r = _first_train_step(cfg, batch, f"sweep b{batch} T{seq}")
+            rec, state = _timed_throughput(r, cfg, batch, 20, True)
+        except Exception as e:  # one OOM/flap must not strand the sweep
+            _log(f"[bench] sweep b{batch} T{seq} failed: {e!r}")
+            rec = {"batch": batch, "seq": seq, "error": repr(e)[:300]}
+        finally:
+            # Free this config's device buffers BEFORE the next config
+            # compiles — on success AND on failure: two TrainStates
+            # resident at once would tip the larger configs into
+            # RESOURCE_EXHAUSTED and understate best_mfu.
+            del r, state
+        results[f"b{batch}_T{seq}"] = rec
+        ok = [v for v in results.values() if v.get("mfu")]
+        if not ok:
+            # Never merge an all-error sweep: the record would carry
+            # platform='tpu' + a fresh stamp, satisfying the watcher's
+            # leg_fresh gate with zero MFU measurements.
+            _log(f"[bench] sweep: no successful config yet, not merging")
+            continue
+        summary = {
+            "platform": "tpu",
+            "device_kind": jax.devices()[0].device_kind,
+            "configs": results,
+            "best_mfu": max(v["mfu"] for v in ok),
+        }
+        _evidence_merge({"train_sweep": summary})
+        _log(f"[bench] sweep so far: {json.dumps(results[f'b{batch}_T{seq}'])}")
+    return summary
+
+
 def bench_train() -> dict | None:
     """Train-step throughput + MFU on the flagship model (BASELINE.md row 2:
     'training step throughput — measure & report'; reference hot loop
@@ -291,34 +386,9 @@ def bench_train() -> dict | None:
         batch = 8
         n_timed = 3
     r = _first_train_step(cfg, batch, f"train child ({platform})")
-    model, state, data, rng = r.model, r.state, r.data, r.rng
-    n_params, compile_s, step = r.n_params, r.compile_s, r.step
-    with r.mesh:
-        _log("[bench] train child: timing")
-        for _ in range(2):  # warmup post-compile
-            state, metrics = step(state, data, rng)
-        float(metrics["loss"])
-        t0 = _time.monotonic()
-        for _ in range(n_timed):
-            state, metrics = step(state, data, rng)
-        float(metrics["loss"])  # completion of step N implies 1..N-1 done
-        dt = (_time.monotonic() - t0) / n_timed
-    tokens_per_s = batch * cfg.n_ctx / dt
-    flops_per_s = 6.0 * n_params * tokens_per_s
-    mfu = None
-    if on_tpu:
-        peak = _peak_flops_for(jax.devices()[0].device_kind)
-        mfu = flops_per_s / (peak * len(jax.devices()))
-    rec = {
-        "platform": platform,
-        "model": f"gpt2-{n_params/1e6:.0f}M",
-        "steps_per_s": round(1.0 / dt, 3),
-        "tokens_per_s": round(tokens_per_s, 1),
-        "model_tflops_per_s": round(flops_per_s / 1e12, 3),
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "compile_s": round(compile_s, 1),
-        "timed_steps": n_timed,
-    }
+    model = r.model
+    timed, state = _timed_throughput(r, cfg, batch, n_timed, on_tpu)
+    rec = {"platform": platform, **timed}
     _log(f"[bench] train: {rec}")
     # Evidence merges happen HERE, incrementally, leg by leg (VERDICT r3):
     # if the tunnel flaps mid-flash or mid-decode, the train/MFU record —
@@ -1193,7 +1263,18 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--train-child" in sys.argv:
+    if "--mfu-sweep" in sys.argv:
+        if os.environ.get("TPUFLOW_TRAIN_MODE") != "tpu":
+            # Same guard as --train-child: without an explicit TPU ask,
+            # never let a dead tunnel hang backend init.
+            from tpuflow.dist import force_cpu_platform
+
+            force_cpu_platform(8)
+        from tpuflow.dist import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache()
+        print(json.dumps(bench_mfu_sweep()))
+    elif "--train-child" in sys.argv:
         if os.environ.get("TPUFLOW_TRAIN_MODE") != "tpu":
             from tpuflow.dist import force_cpu_platform
 

@@ -13,6 +13,9 @@ measured:
      kernel correctness+speed → decode/speculative. The child itself
      merges the evidence ledger incrementally after each sub-leg.
   2. device-path checkpoint tier (small payload; documents the tunnel).
+  3. end-to-end flow contract on the chip (tools/e2e_tpu.py: fresh
+     train → --from-run resume → eval card).
+  4. MFU batch/seq sweep (``bench.py --mfu-sweep``).
 
 Run it in the background for a whole working session:
 
@@ -174,6 +177,12 @@ def main() -> int:
     interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", "45"))
     probe_timeout = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "75"))
     started = time.time()
+    # Freshness floor for the capture gates. Overriding it to an earlier
+    # time lets a RESTARTED watcher (same working session, new process —
+    # e.g. after new legs were added to this file) count legs captured
+    # since that floor instead of re-spending a healthy window re-proving
+    # them.
+    since = float(os.environ.get("TPU_WATCH_SINCE", started))
     deadline = started + float(
         os.environ.get("TPU_WATCH_MAX_S", str(11 * 3600))
     )
@@ -194,13 +203,13 @@ def main() -> int:
         # timeout here can leave a committed MFU record. Skipped when a
         # previous window of THIS session already landed it (a later flap
         # retry must not re-spend 20 min re-proving the same leg).
-        if not leg_fresh(evidence_legs().get("train", {}), started):
+        if not leg_fresh(evidence_legs().get("train", {}), since):
             run_leg([bench_py, "--train-child"],
                     {"TPUFLOW_TRAIN_MODE": "tpu"},
                     timeout_s=1200, label="train child")
             commit_evidence("train/MFU, flash kernels, decode")
         have = evidence_legs()
-        if not leg_fresh(have.get("train", {}), started):
+        if not leg_fresh(have.get("train", {}), since):
             print("[tpu_watch] no FRESH TPU train record yet; will keep "
                   "probing", flush=True)
             time.sleep(interval)
@@ -210,7 +219,7 @@ def main() -> int:
         # racing it). Disk tier + overlap leg stay OFF on every watcher
         # run — the disk tier's cold restore drops the whole machine's
         # page cache (ADVICE r3).
-        if not leg_fresh(have.get("ckpt_device", {}), started):
+        if not leg_fresh(have.get("ckpt_device", {}), since):
             run_leg([bench_py], {
                 "TPUFLOW_BENCH_DEVICE": "1",
                 "TPUFLOW_BENCH_TRAIN": "0",
@@ -221,12 +230,38 @@ def main() -> int:
             }, timeout_s=1800, label="device ckpt tier")
             commit_evidence("device ckpt tier")
             if not leg_fresh(
-                evidence_legs().get("ckpt_device", {}), started
+                evidence_legs().get("ckpt_device", {}), since
             ):
                 # A flap killed the ckpt leg after the train leg landed —
                 # keep probing for another window rather than declaring
                 # victory on a half-captured suite.
                 print("[tpu_watch] ckpt_device leg not captured; will "
+                      "keep probing", flush=True)
+                time.sleep(interval)
+                continue
+        # Leg 3: the north-star contract end to end ON the chip — fresh
+        # train → --from-run resume → eval card, three sequential CLI
+        # processes each owning the TPU (tools/e2e_tpu.py merges the
+        # e2e_flow record itself; hardware proof comes from the train
+        # task's device-profile header, not from trusting the CLI).
+        if not leg_fresh(evidence_legs().get("e2e_flow", {}), since):
+            run_leg([os.path.join(REPO, "tools", "e2e_tpu.py")], {},
+                    timeout_s=4200, label="e2e flow")
+            commit_evidence("end-to-end flow on chip")
+            if not leg_fresh(evidence_legs().get("e2e_flow", {}), since):
+                print("[tpu_watch] e2e_flow leg not captured; will keep "
+                      "probing", flush=True)
+                time.sleep(interval)
+                continue
+        # Leg 4: MFU batch/seq sweep — pushes past the b8/T512 operating
+        # point; merges the running best after every config.
+        if not leg_fresh(evidence_legs().get("train_sweep", {}), since):
+            run_leg([bench_py, "--mfu-sweep"],
+                    {"TPUFLOW_TRAIN_MODE": "tpu"},
+                    timeout_s=1500, label="mfu sweep")
+            commit_evidence("mfu sweep")
+            if not leg_fresh(evidence_legs().get("train_sweep", {}), since):
+                print("[tpu_watch] train_sweep leg not captured; will "
                       "keep probing", flush=True)
                 time.sleep(interval)
                 continue

@@ -98,9 +98,30 @@ class _DeviceProfiler:
     def __exit__(self, *exc):
         self._stop.set()
         self._thread.join(timeout=5)
+        # Record WHAT hardware was sampled, not just how much memory it
+        # used: profile.json doubles as on-hardware execution evidence
+        # (platform + device kinds), the TPU analogue of @gpu_profile's
+        # nvidia-smi header.
+        platform = None
+        kinds: list[str] = []
+        try:
+            import jax
+
+            platform = jax.default_backend()
+            kinds = [d.device_kind for d in jax.local_devices()]
+        except Exception:
+            pass
         try:
             with open(self.out_path, "w") as f:
-                json.dump({"interval": self.interval, "samples": self.samples}, f)
+                json.dump(
+                    {
+                        "interval": self.interval,
+                        "platform": platform,
+                        "device_kinds": kinds,
+                        "samples": self.samples,
+                    },
+                    f,
+                )
         except OSError:
             pass
 
