@@ -481,3 +481,18 @@ def test_stale_synthetic_cache_rebuilt_when_real_files_appear(tmp_path, monkeypa
         os.remove(tmp_path / f"{split}-labels-idx1-ubyte")
     ds3 = load_dataset("fashion_mnist", data_dir=str(tmp_path))
     assert not ds3.synthetic
+
+
+def test_data_dir_env_resolved_at_call_time(tmp_path, monkeypatch):
+    """TPUFLOW_DATA_DIR set AFTER the module was imported must still win:
+    a frozen import-time default made an in-suite flow read a 10k-row
+    cache another process had left in the login default dir (the
+    readme-contract test's order-dependent failure)."""
+    from tpuflow.data import datasets as d  # long since imported by the suite
+
+    monkeypatch.setenv("TPUFLOW_DATA_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUFLOW_SYNTH_TRAIN_N", "32")
+    monkeypatch.setenv("TPUFLOW_SYNTH_TEST_N", "16")
+    ds = d.load_dataset("fashion_mnist")
+    assert (len(ds.train), len(ds.test)) == (32, 16)
+    assert os.path.exists(os.path.join(str(tmp_path), "fashion_mnist_cache.npz"))

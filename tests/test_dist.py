@@ -298,3 +298,20 @@ def test_step_fence_serializes_only_on_cpu_simulation():
     out = dist.step_fence(y)
     assert out is y
     np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 2)
+
+
+def test_ensure_healthy_platform_skips_probe_when_pinned_cpu(
+    tmp_path, monkeypatch
+):
+    """With the platform already pinned to CPU (what this conftest does),
+    ensure_healthy_platform must return instantly instead of paying the
+    90s subprocess probe of the DEFAULT platform — a hanging accelerator
+    tunnel was charging every flow-CLI test the full timeout."""
+    import time
+
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))  # no cache file
+    monkeypatch.delenv("TPUFLOW_PLATFORM_PROBED", raising=False)
+    monkeypatch.delenv("TPUFLOW_FORCE_CPU", raising=False)
+    t0 = time.monotonic()
+    assert dist.ensure_healthy_platform(probe_timeout_s=90.0) == "cpu"
+    assert time.monotonic() - t0 < 5.0

@@ -29,9 +29,17 @@ import numpy as np
 
 from tpuflow.utils import FileLock
 
-_DEFAULT_DIR = os.environ.get(
-    "TPUFLOW_DATA_DIR", os.path.expanduser("~/tpuflow_data")
-)
+def _default_dir() -> str:
+    """Resolve TPUFLOW_DATA_DIR at CALL time, not import time: a frozen
+    module constant captures whatever environment happened to exist when
+    the module was first imported, so a process that sets the env var
+    later (tests monkeypatching a tmp dir, a flow configuring per-run
+    storage) silently reads/writes someone else's dataset cache — the
+    readme-contract test evaluated a 10k-row cache left in the login
+    user's default dir by an unrelated manual run."""
+    return os.environ.get(
+        "TPUFLOW_DATA_DIR", os.path.expanduser("~/tpuflow_data")
+    )
 
 FASHION_MNIST_CLASSES = [
     "T-shirt/top",
@@ -225,7 +233,7 @@ def resolve_text_path(
                 f"lm_text: requested text file does not exist: {explicit}"
             )
         return explicit
-    txts = sorted(_glob.glob(os.path.join(data_dir or _DEFAULT_DIR, "*.txt")))
+    txts = sorted(_glob.glob(os.path.join(data_dir or _default_dir(), "*.txt")))
     return txts[0] if txts else None
 
 
@@ -406,7 +414,7 @@ def load_dataset(
     FileLock so only one process per host does the decode/generation.
     ``seq_len``/``vocab_size`` apply to the 'lm_synth' language-model
     dataset (its Split holds token ids, not images)."""
-    data_dir = data_dir or _DEFAULT_DIR
+    data_dir = data_dir or _default_dir()
     os.makedirs(data_dir, exist_ok=True)
     if name == "imagenet_synth":
         # Deterministic generation; too large to be worth an npz cache.

@@ -176,6 +176,18 @@ def ensure_healthy_platform(
     if os.environ.get("TPUFLOW_FORCE_CPU") == "1":
         force_cpu_platform(n_cpu_devices)
         return "cpu"
+    if _platform_is_cpu():
+        # Platform already pinned to CPU (test conftest, gang subprocess,
+        # bench parent): there is no accelerator init to protect against,
+        # and the subprocess probe targets the DEFAULT platform — with a
+        # hanging tunnel it would charge this already-decided process the
+        # full probe timeout (observed: every flow-CLI test paying 90 s
+        # while the axon tunnel hung half-open). Still force the virtual
+        # device count: a child that merely INHERITED JAX_PLATFORMS=cpu
+        # would otherwise come up with 1 device (no-op if a backend is
+        # already initialized).
+        force_cpu_platform(n_cpu_devices)
+        return "cpu"
     cached = os.environ.get("TPUFLOW_PLATFORM_PROBED") or _probe_cache_read()
     if cached == "cpu":
         force_cpu_platform(n_cpu_devices)
