@@ -292,3 +292,28 @@ def test_gpt2_with_ulysses_attention_trains():
         new_state, metrics = step(state, batch, jax.random.PRNGKey(1))
         jax.block_until_ready(new_state.params)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_attention_auto_picks_xla_off_tpu(monkeypatch):
+    """impl='auto' must resolve to the XLA path everywhere except a TPU
+    backend at long sequence (the measured fwd+bwd crossover,
+    TPU_EVIDENCE.json flash_attention: 0.2x at T=512, 1.73x at T=2048) —
+    on this CPU platform it must equal xla_attention bit-for-bit at any
+    length, including ones the flash kernel couldn't even tile."""
+    from tpuflow.ops.attention import attention, xla_attention
+
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (2, 48, 2, 16))
+        for i in range(3)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(attention(q, k, v, causal=True, impl="auto")),
+        np.asarray(xla_attention(q, k, v, causal=True)),
+    )
+    # Threshold knob is read per call: even a huge min_seq changes nothing
+    # off-TPU.
+    monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "1")
+    np.testing.assert_array_equal(
+        np.asarray(attention(q, k, v, causal=True, impl="auto")),
+        np.asarray(xla_attention(q, k, v, causal=True)),
+    )

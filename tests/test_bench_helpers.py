@@ -102,7 +102,7 @@ def test_bench_int8_decode_leg(tiny_lm):
     rec = bench._bench_int8_decode(model, params, prompt, n_new=8)
     assert set(rec) == {
         "tokens_per_s", "fp_tokens_per_s", "speedup_vs_fp",
-        "token_agreement",
+        "token_agreement", "note",
     }
     assert 0.0 <= rec["token_agreement"] <= 1.0
     assert rec["tokens_per_s"] > 0 and rec["fp_tokens_per_s"] > 0

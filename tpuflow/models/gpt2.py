@@ -27,7 +27,7 @@ class GPT2Config:
     n_head: int = 12
     dropout: float = 0.1
     ln_eps: float = 1e-5  # GPT-2's LayerNorm epsilon (HF-checkpoint parity)
-    attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
+    attn_impl: str = "xla"  # 'auto' | 'xla' | 'flash' | 'ring' | 'ulysses'
     dtype: jnp.dtype = jnp.float32  # activation dtype; bfloat16 on TPU
     # Rematerialize each block on the backward pass (jax.checkpoint): peak
     # activation memory drops from O(n_layer·B·T·C) to O(B·T·C) + one block's
@@ -79,7 +79,7 @@ class GPT2Config:
         cls,
         preset: str,
         *,
-        attn_impl: str = "xla",
+        attn_impl: str = "auto",
         seq_len: int = 64,
         stage_axis: int = 1,
         n_experts: int = 0,

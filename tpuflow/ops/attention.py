@@ -46,7 +46,24 @@ def xla_attention(q, k, v, *, causal: bool = True):
 
 
 def attention(q, k, v, *, causal: bool = True, impl: str = "xla"):
-    """Dispatch to the selected implementation (see module docstring)."""
+    """Dispatch to the selected implementation (see module docstring).
+
+    ``impl='auto'`` picks by measured crossover: on-chip round-4 evidence
+    (TPU_EVIDENCE.json flash_attention) has the Pallas kernel's fwd+bwd
+    LOSING to XLA at T=512 (0.2x — the custom bwd recomputes what XLA's
+    saved-activation bwd reads back) and WINNING at T=2048 (1.73x, where
+    the O(T^2) score materialization starts to hurt XLA). 'auto' therefore
+    uses flash only on TPU at T >= TPUFLOW_FLASH_MIN_SEQ (default 2048,
+    the measured-win point; retune as more lengths get measured) and XLA
+    everywhere else — CPU always takes XLA (flash there is interpret-mode,
+    for tests only).
+    """
+    if impl == "auto":
+        import os
+
+        min_seq = int(os.environ.get("TPUFLOW_FLASH_MIN_SEQ", "2048"))
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if (on_tpu and q.shape[1] >= min_seq) else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal)
     if impl == "flash":

@@ -44,7 +44,7 @@ class GptTrainConfig:
     experts: int = 0                # Switch-MoE experts per block (0=dense)
     stage_axis: int = 1             # >1 = GPipe pipeline mode
     microbatches: int = 2
-    attn_impl: str = "xla"          # xla | flash | ring | ulysses
+    attn_impl: str = "auto"         # auto | xla | flash | ring | ulysses
     dataset: str = "lm_synth"       # lm_synth | lm_text
     text_path: str | None = None    # pin the lm_text corpus file
     sample_tokens: int = 0
