@@ -58,8 +58,12 @@ class TpuGptTrain(FlowSpec):
     microbatches = Parameter(
         "microbatches", default=2, help="pipeline microbatches per step"
     )
-    attn_impl = Parameter("attn_impl", default="auto",
-                          help="auto|xla|flash|ring|ulysses (auto = flash on\n                          TPU at T>=TPUFLOW_FLASH_MIN_SEQ, else xla)")
+    attn_impl = Parameter(
+        "attn_impl",
+        default="auto",
+        help="auto|xla|flash|ring|ulysses (auto = flash on TPU at "
+        "T>=TPUFLOW_FLASH_MIN_SEQ, else xla)",
+    )
     dataset = Parameter(
         "dataset", default="lm_synth", help="lm_synth | lm_text (byte-level)"
     )

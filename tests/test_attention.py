@@ -310,8 +310,9 @@ def test_attention_auto_picks_xla_off_tpu(monkeypatch):
         np.asarray(attention(q, k, v, causal=True, impl="auto")),
         np.asarray(xla_attention(q, k, v, causal=True)),
     )
-    # Threshold knob is read per call: even a huge min_seq changes nothing
-    # off-TPU.
+    # The threshold is resolved at trace time (baked into compiled
+    # programs); these unjitted calls re-read it, and even a tiny min_seq
+    # changes nothing off-TPU.
     monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "1")
     np.testing.assert_array_equal(
         np.asarray(attention(q, k, v, causal=True, impl="auto")),

@@ -61,7 +61,13 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "xla"):
     if impl == "auto":
         import os
 
-        min_seq = int(os.environ.get("TPUFLOW_FLASH_MIN_SEQ", "2048"))
+        try:
+            min_seq = int(os.environ.get("TPUFLOW_FLASH_MIN_SEQ", "2048"))
+        except ValueError:
+            min_seq = 2048  # malformed knob: keep the measured default
+        # NB: resolved at trace time — under jit the choice is baked into
+        # the compiled program for each shape; changing the env var after
+        # compilation does not retune existing executables.
         on_tpu = jax.default_backend() == "tpu"
         impl = "flash" if (on_tpu and q.shape[1] >= min_seq) else "xla"
     if impl == "xla":
