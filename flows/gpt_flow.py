@@ -80,6 +80,12 @@ class TpuGptTrain(FlowSpec):
         default=1,
         help="gradient-accumulation microbatches per optimizer step",
     )
+    optimizer = Parameter(
+        "optimizer",
+        default="adamw",
+        help="adamw | sgd | adafactor (factored 2nd moments, O(rows+cols) "
+        "state) | lion (single sign-momentum buffer)",
+    )
     lr_schedule = Parameter(
         "lr_schedule", default="constant", help="constant | cosine | linear"
     )
@@ -146,6 +152,7 @@ class TpuGptTrain(FlowSpec):
             dataset=self.dataset,
             sample_tokens=int(self.sample_tokens),
             accum_steps=int(self.accum_steps),
+            optimizer_name=self.optimizer,
             lr_schedule=self.lr_schedule,
             warmup_steps=int(self.warmup_steps),
             grad_clip=float(self.grad_clip),

@@ -49,6 +49,7 @@ class GptTrainConfig:
     text_path: str | None = None    # pin the lm_text corpus file
     sample_tokens: int = 0
     accum_steps: int = 1
+    optimizer_name: str = "adamw"   # adamw | sgd | adafactor | lion
     lr_schedule: str = "constant"
     warmup_steps: int = 0
     grad_clip: float = 0.0
@@ -112,7 +113,7 @@ class GptTrainConfig:
         total = self.epochs * self.steps_per_epoch
         return make_optimizer(
             self.learning_rate,
-            optimizer="adamw",
+            optimizer=self.optimizer_name,
             weight_decay=self.weight_decay,
             grad_clip_norm=self.grad_clip or None,
             warmup_steps=self.warmup_steps,

@@ -63,7 +63,8 @@ def make_optimizer(
     schedule: str = "constant",
     final_scale: float = 0.1,
 ) -> optax.GradientTransformation:
-    """'adamw' | 'sgd' with optional global-norm clipping and LR schedule.
+    """'adamw' | 'sgd' | 'adafactor' | 'lion' with optional global-norm
+    clipping and LR schedule.
 
     Clipping runs BEFORE the optimizer update (the standard order: the
     update direction is computed from the clipped gradient). Defaults
@@ -89,6 +90,20 @@ def make_optimizer(
         tx = optax.adamw(sched, weight_decay=weight_decay)
     elif optimizer == "sgd":
         tx = optax.sgd(sched, momentum=momentum)
+    elif optimizer == "adafactor":
+        # The TPU-classic memory-efficient optimizer: factored second
+        # moments store O(rows+cols) per matrix instead of Adam's O(n)
+        # (a 355M-param model's optimizer state drops from ~2.8 GiB to
+        # ~4 MiB of factored stats + the params) — the standard choice
+        # when optimizer HBM, not FLOPs, bounds model size.
+        tx = optax.adafactor(
+            sched, weight_decay_rate=weight_decay or None
+        )
+    elif optimizer == "lion":
+        # Sign-momentum optimizer (Chen et al. 2023): one momentum buffer
+        # (half Adam's state), well-behaved in bf16; typical LR ~3-10x
+        # lower than AdamW's for the same config.
+        tx = optax.lion(sched, weight_decay=weight_decay)
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
     if grad_clip_norm is not None:
