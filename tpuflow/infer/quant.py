@@ -16,10 +16,18 @@ Design (TPU/XLA-first):
   stay exact).
 - **Dequantize inside the compiled program**: ``QuantizedModel`` wraps
   any Flax model and rebuilds float weights *inside* ``apply`` — i.e.
-  inside the caller's jit trace — as ``q.astype(dtype) * scale``. XLA
-  fuses that convert+multiply into the consuming matmul's operand load,
-  so only int8 bytes cross HBM; nothing materializes a float copy of
-  the weights in device memory across steps.
+  inside the caller's jit trace — as ``q.astype(dtype) * scale``. At
+  rest (and across host→device transfer) only int8 bytes exist.
+  CAVEAT, measured on-chip (r4, TPU_EVIDENCE.json decode.int8 = 0.76x
+  vs fp at 124M/b8): XLA fusions do not cross dot boundaries, so the
+  dequantized weights CAN materialize as a per-step bf16 buffer —
+  convert+scale+write+read on top of the matmul — making weight-only
+  int8 a *memory capacity* feature (half/quarter-sized resident
+  weights, cheap transfer), not a decode-throughput feature, at small
+  model sizes. A throughput win needs either much larger models (where
+  the resident-set halving keeps weights HBM-side at all) or a true
+  int8-operand MXU matmul (dynamic activation quantization), which is
+  future work.
 - **Zero integration surface**: the wrapper exposes ``apply`` and
   ``config`` — exactly what ``generate`` / ``beam_search`` /
   ``speculative_generate`` / ``score`` use — and is hashable, so it
