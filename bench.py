@@ -1,8 +1,15 @@
 """Benchmark: sharded checkpoint save+restore throughput (the north-star
 metric, BASELINE.md: target ≥ 2 GB/s/chip on v5e-16).
 
-Prints exactly ONE JSON line to stdout:
-    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N/2.0}
+Prints TWO JSON lines to stdout — the full record first, then a compact
+digest as the LAST line (same metric/value/unit/vs_baseline fields plus a
+short "summary"; sized so a bounded stdout tail always captures the
+headline whole — VERDICT r4 weak #1):
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N/2.0,
+     "extra": {...}}
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N/2.0,
+     "summary": {...}}
+Parse the LAST line for the headline; parse the first for full detail.
 
 Methodology
 -----------
@@ -96,6 +103,22 @@ def _evidence_read() -> dict | None:
         return None
 
 
+def _git_commit(repo: str) -> str | None:
+    """Short HEAD hash of ``repo``, or None (no repo / no git / timeout)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except Exception:
+        return None
+    if proc.returncode == 0 and proc.stdout.strip():
+        return proc.stdout.strip()
+    return None
+
+
 def _evidence_merge(updates: dict) -> None:
     """Merge leg records into TPU_EVIDENCE.json, provenance stamped per leg.
 
@@ -114,12 +137,7 @@ def _evidence_merge(updates: dict) -> None:
     dirty = None
     try:
         repo = os.path.dirname(TPU_EVIDENCE_PATH)
-        proc = subprocess.run(
-            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-        )
-        if proc.returncode == 0 and proc.stdout.strip():
-            commit = proc.stdout.strip()
+        commit = _git_commit(repo)
         # A watcher capture normally runs with a mid-round dirty tree, so
         # the commit hash alone may not contain the code measured — record
         # that honestly (ADVICE r3). Scoped to the MEASURED code: ledgers
@@ -490,34 +508,34 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
 
 
 def _bench_int8_decode(model, params, prompt, n_new: int) -> dict:
-    """Weight-only int8 decode (tpuflow.infer.quant): decode streams the
-    full weight set per token, so int8 weights bound the HBM bytes at
-    1/4 (f32) or 1/2 (bf16) of the plain path. Tokens may legitimately
-    differ from full precision (the weights differ) — the record reports
-    the agreement fraction instead of asserting exactness, plus the
-    measured speedup vs the plain leg timed moments earlier."""
+    """int8 decode in BOTH modes (tpuflow.infer.quant):
+
+    - weight-only: int8 at rest, dequantized into the bf16 matmul —
+      auto-GATED by quant_decision (measured 0.76x at 124M/b8 on chip,
+      r4: the per-step dequant buffer loses below ~1 GiB of weights);
+      the record carries the gate's verdict + rationale, and the mode is
+      still *measured* here so the gate stays pinned to current data.
+    - mxu (W8A8): dynamic activation quant, int8 x int8 -> int32 on the
+      MXU — no dequant materialization, ungated.
+
+    Fidelity is TEACHER-FORCED per-step top-1 agreement (one forward
+    over prompt + the fp greedy continuation), which scores every step
+    under the same context — free-running whole-sequence agreement
+    conflated one early near-tie flip (which cascades) with genuinely
+    bad quantization (VERDICT r4 weak #3)."""
     import statistics
     import time as _time
 
     import numpy as np
 
-    from tpuflow.infer import generate, quantize_model
-
-    qm, qp = quantize_model(model, params)
+    from tpuflow.infer import generate, quant_decision, quantize_model
+    from tpuflow.infer.quant import teacher_forced_predictions
 
     def plain():
         return np.asarray(
             generate(model, params, prompt, max_new_tokens=n_new,
                      temperature=0.0)
         )
-
-    def run():
-        return np.asarray(
-            generate(qm, qp, prompt, max_new_tokens=n_new, temperature=0.0)
-        )
-
-    want = plain()  # already compiled by the caller's decode leg
-    got = run()     # compile the int8 program
 
     def timed(fn):
         out = []
@@ -527,23 +545,49 @@ def _bench_int8_decode(model, params, prompt, n_new: int) -> dict:
             out.append(_time.monotonic() - t0)
         return statistics.median(out)
 
-    dt_fp = timed(plain)
-    dt = timed(run)
+    want = plain()  # already compiled by the caller's decode leg
+    # Teacher-forcing context: prompt + the fp greedy continuation. The
+    # fp reference predictions are computed ONCE and reused across modes.
+    tf_tokens = np.concatenate([np.asarray(prompt), want], axis=1)
+    P = prompt.shape[1]
     B = prompt.shape[0]
-    return {
-        "tokens_per_s": round(B * n_new / dt, 1),
+    ref_pred = np.asarray(
+        teacher_forced_predictions(model, params, tf_tokens, P)
+    )
+    dt_fp = timed(plain)
+    gate = quant_decision(params, mode="weight")
+    rec = {
         "fp_tokens_per_s": round(B * n_new / dt_fp, 1),
-        "speedup_vs_fp": round(dt_fp / dt, 2),
-        "token_agreement": round(float((got == want).mean()), 3),
-        "note": (
-            "weight-only int8 dequantizes into the bf16 matmul, so it wins "
-            "only where weight HBM reads dominate (multi-B-param models at "
-            "small batch); at 124M/b8 the dequant overhead is expected to "
-            "net out negative. token_agreement reflects this bench's "
-            "barely-trained model (near-tie logits flip under quant "
-            "noise), not trained-model fidelity."
-        ),
+        "weight_mode_gate": {"apply": gate.apply, "reason": gate.reason},
     }
+    for mode in ("weight", "mxu"):
+        try:
+            # Inside the try: a quantization-time failure (e.g. OOM on a
+            # large model) must not erase the OTHER mode's record.
+            qm, qp = quantize_model(model, params, mode=mode)
+
+            def run():
+                return np.asarray(
+                    generate(qm, qp, prompt, max_new_tokens=n_new,
+                             temperature=0.0)
+                )
+
+            got = run()  # compile
+            dt = timed(run)
+            q_pred = np.asarray(
+                teacher_forced_predictions(qm, qp, tf_tokens, P)
+            )
+            rec[mode] = {
+                "tokens_per_s": round(B * n_new / dt, 1),
+                "speedup_vs_fp": round(dt_fp / dt, 2),
+                "teacher_forced_agreement": round(
+                    float((q_pred == ref_pred).mean()), 3
+                ),
+                "greedy_seq_agreement": round(float((got == want).mean()), 3),
+            }
+        except Exception as e:  # one mode failing must not erase the other
+            rec[mode] = {"error": repr(e)[:200]}
+    return rec
 
 
 def _natural_prompt(n_tokens: int, vocab_size: int):
@@ -646,11 +690,13 @@ def _bench_spec_prompt(model, params, prompt, n_new: int) -> dict:
         # speedup headline stays withheld; these fields make the record
         # diagnosable (a near-1 prefix match at a late first_divergence
         # is a benign tie-flip; an early divergence would be a real bug).
-        prompt_len = prompt.shape[1]
-        got_new, want_new = got[:, prompt_len:], want[:, prompt_len:]
-        mism = np.nonzero((got_new != want_new).any(axis=0))[0]
+        # Both paths return NEW tokens only, (B, n_new) — compare whole
+        # arrays (an earlier revision sliced off prompt_len here, which
+        # silently dropped the first prompt_len new tokens from the
+        # agreement stats).
+        mism = np.nonzero((got != want).any(axis=0))[0]
         rec.update(
-            token_agreement=round(float((got_new == want_new).mean()), 3),
+            token_agreement=round(float((got == want).mean()), 3),
             first_divergence=int(mism[0]) if mism.size else None,
             new_tokens=n_new,
         )
@@ -1311,6 +1357,50 @@ def main() -> None:
     if extra:
         record["extra"] = extra
     print(json.dumps(record))
+    # LAST stdout line: a compact record the driver's ~2,000-char tail
+    # always captures whole. In r4 the full record grew past the tail
+    # and the host-tier headline vanished from BENCH_r04.json (VERDICT
+    # r4 weak #1) — this line re-states the metric plus the per-tier /
+    # MFU / platform headline in well under that budget. It carries the
+    # same metric/value/unit/vs_baseline fields, so a driver parsing
+    # the last JSON line still reads the headline metric.
+    print(json.dumps(_compact_summary(record, train)))
+
+
+def _compact_summary(record: dict, train) -> dict:
+    """<= ~800-char digest of the full record: headline metric + tier
+    GB/s + best train MFU + platform provenance + git commit."""
+    extra = record.get("extra", {})
+    tiers = extra.get("tiers", {})
+    s: dict = {k: record[k] for k in ("metric", "value", "unit",
+                                      "vs_baseline")}
+    digest: dict = {"host_combined_gbps": record["value"]}
+    disk = tiers.get("disk", {})
+    if isinstance(disk.get("combined_gbps"), (int, float)):
+        digest["disk_combined_gbps"] = disk["combined_gbps"]
+    ev = extra.get("tpu_evidence") or {}
+    ev_train = ev.get("train", {})
+    if isinstance(train, dict) and train.get("platform") == "tpu":
+        digest["train"] = {
+            "platform": "tpu", "fresh": True,
+            "mfu": train.get("mfu"),
+            "tokens_per_s": train.get("tokens_per_s"),
+        }
+    elif ev_train:
+        digest["train"] = {
+            "platform": ev_train.get("platform"),
+            "fresh": "train" in ev.get("fresh_legs", []),
+            "mfu": ev_train.get("mfu"),
+            "tokens_per_s": ev_train.get("tokens_per_s"),
+        }
+    sweep = ev.get("train_sweep", {})
+    if isinstance(sweep.get("best_mfu"), (int, float)):
+        digest["best_mfu_sweep"] = sweep["best_mfu"]
+    if "e2e_flow" in ev:
+        digest["e2e_flow_on_chip"] = True
+    digest["git"] = _git_commit(os.path.dirname(os.path.abspath(__file__)))
+    s["summary"] = digest
+    return s
 
 
 if __name__ == "__main__":

@@ -95,14 +95,66 @@ def test_peak_flops_table_matches_device_kind_strings():
 
 def test_bench_int8_decode_leg(tiny_lm):
     """The TPU-gated int8 decode sub-leg must be executable (CPU drive:
-    speedup is noise here, but the record shape and agreement stat are
-    pinned before real chip time is spent on it)."""
+    speedup is noise here, but the record shape — both modes, the gate
+    verdict, and the teacher-forced agreement stat — is pinned before
+    real chip time is spent on it)."""
     model, params, cfg = tiny_lm
     prompt = np.arange(2 * 12, dtype=np.int32).reshape(2, 12) % cfg.vocab_size
     rec = bench._bench_int8_decode(model, params, prompt, n_new=8)
-    assert set(rec) == {
-        "tokens_per_s", "fp_tokens_per_s", "speedup_vs_fp",
-        "token_agreement", "note",
+    assert set(rec) == {"fp_tokens_per_s", "weight_mode_gate", "weight",
+                        "mxu"}
+    assert rec["fp_tokens_per_s"] > 0
+    # A tiny test model sits far below the measured threshold: gated off.
+    gate = rec["weight_mode_gate"]
+    assert set(gate) == {"apply", "reason"}
+    assert gate["apply"] is False
+    assert "gated OFF" in gate["reason"]
+    for mode in ("weight", "mxu"):
+        sub = rec[mode]
+        assert sub["tokens_per_s"] > 0 and sub["speedup_vs_fp"] > 0
+        assert 0.0 <= sub["teacher_forced_agreement"] <= 1.0
+        assert 0.0 <= sub["greedy_seq_agreement"] <= 1.0
+
+
+def test_compact_summary_is_small_and_carries_headline():
+    """The LAST stdout line of the main bench: must re-state the metric
+    fields (a driver parsing the last JSON line still gets the metric)
+    and fit WELL under the driver's ~2,000-char stdout tail with every
+    optional leg populated (VERDICT r4 weak #1)."""
+    import json
+
+    record = {
+        "metric": "sharded_ckpt_save_restore_throughput",
+        "value": 3.97, "unit": "GB/s", "vs_baseline": 1.985,
+        "extra": {
+            "tiers": {
+                "primary": {"combined_gbps": 3.97},
+                "disk": {"combined_gbps": 1.11},
+            },
+            "tpu_evidence": {
+                "fresh_legs": [], "cached_legs": ["train", "train_sweep"],
+                "train": {"platform": "tpu", "mfu": 0.428,
+                          "tokens_per_s": 113202.0},
+                "train_sweep": {"best_mfu": 0.51},
+                "e2e_flow": {"platform": "tpu"},
+            },
+        },
     }
-    assert 0.0 <= rec["token_agreement"] <= 1.0
-    assert rec["tokens_per_s"] > 0 and rec["fp_tokens_per_s"] > 0
+    s = bench._compact_summary(record, train=None)
+    line = json.dumps(s)
+    assert len(line) < 800, len(line)
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert s[k] == record[k]
+    d = s["summary"]
+    assert d["host_combined_gbps"] == 3.97
+    assert d["disk_combined_gbps"] == 1.11
+    assert d["train"]["mfu"] == 0.428 and d["train"]["platform"] == "tpu"
+    assert d["train"]["fresh"] is False
+    assert d["best_mfu_sweep"] == 0.51
+    assert d["e2e_flow_on_chip"] is True
+    # A fresh on-TPU train leg from THIS run takes precedence.
+    s2 = bench._compact_summary(
+        record, train={"platform": "tpu", "mfu": 0.5, "tokens_per_s": 1.0}
+    )
+    assert s2["summary"]["train"]["fresh"] is True
+    assert s2["summary"]["train"]["mfu"] == 0.5

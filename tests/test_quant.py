@@ -160,8 +160,131 @@ def test_quantized_model_is_jit_static(lm):
     assert a == b and hash(a) == hash(b)
     assert a.config.n_ctx == cfg.n_ctx
 
+def test_mxu_mode_logits_close(lm):
+    """W8A8 (mode='mxu'): Dense kernels stay int8 through the matmul via
+    dynamic activation quantization. Noisier than weight-only (the
+    activations are quantized too) but must stay bounded."""
+    model, params, cfg = lm
+    qm, qp = quantize_model(model, params, mode="mxu")
+    x = np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % cfg.vocab_size
+    ref = np.asarray(model.apply({"params": params}, x), np.float32)
+    got = np.asarray(qm.apply({"params": qp}, x), np.float32)
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(ref - got).max() / denom < 0.15
+    # Only Dense kernels were quantized: embeddings stay exact floats.
+    assert not isinstance(qp["wte"], QuantLeaf)
+    assert isinstance(qp["h0"]["c_attn"]["kernel"], QuantLeaf)
+
+
+def test_mxu_mode_decode_entry_points(lm):
+    """mode='mxu' is the same drop-in static-arg model: generate, beam,
+    speculative, scoring all compile and agree with its own argmax."""
+    model, params, cfg = lm
+    qm, qp = quantize_model(model, params, mode="mxu")
+    prompt = np.arange(2 * 12, dtype=np.int32).reshape(2, 12) % cfg.vocab_size
+    toks = np.asarray(
+        generate(qm, qp, prompt, max_new_tokens=6, temperature=0.0)
+    )
+    assert toks.shape == (2, 6)
+    beam_toks, _ = beam_search(qm, qp, prompt, beam_size=1, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(beam_toks), toks)
+    spec = np.asarray(
+        speculative_generate(qm, qp, prompt, max_new_tokens=6, draft_len=3)
+    )
+    np.testing.assert_array_equal(spec, toks)
+
+
+def test_mxu_mode_scan_stacked_model():
+    """Under scan_layers, Dense kernels are (n_layer, in, out) stacks;
+    nn.scan must slice the QuantLeaf's q and scale together per layer."""
+    cfg = GPT2Config(
+        vocab_size=128, n_ctx=64, n_embd=64, n_layer=2, n_head=2,
+        dropout=0.0, dtype=jnp.float32, scan_layers=True,
+    )
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(1), np.zeros((1, 8), np.int32)
+    )["params"]
+    qm, qp = quantize_model(model, params, mode="mxu")
+    k = qp["h"]["block"]["c_attn"]["kernel"]
+    assert isinstance(k, QuantLeaf) and k.q.ndim == 3
+    x = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    ref = np.asarray(model.apply({"params": params}, x), np.float32)
+    got = np.asarray(qm.apply({"params": qp}, x), np.float32)
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(ref - got).max() / denom < 0.15
+
+
+def test_mxu_mode_rejects_non_dense_kernel_consumers():
+    """``_quantize_dense_kernels`` selects by leaf NAME; a non-Dense
+    module with a big 'kernel' (a 1-D nn.Conv is 3-D: (k, in, out)) must
+    fail with a clear TypeError at apply, not a cryptic crash inside
+    float ops."""
+    import flax.linen as nn
+
+    class ConvNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(64, kernel_size=(4,), name="conv")(x)
+
+    model = ConvNet()
+    x = np.zeros((1, 16, 32), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    assert params["conv"]["kernel"].size >= 4096  # big enough to quantize
+    qm, qp = quantize_model(model, params, mode="mxu")
+    assert isinstance(qp["conv"]["kernel"], QuantLeaf)
+    with pytest.raises(TypeError, match="nn.Dense kernels only"):
+        qm.apply({"params": qp}, x)
+
+
+def test_teacher_forced_agreement_metric(lm):
+    """The fidelity metric: 1.0 against itself; high-but-measurable for
+    int8; and it scores per-step under the SAME context, so one early
+    flip cannot cascade into a near-zero score."""
+    from tpuflow.infer import teacher_forced_agreement
+
+    model, params, cfg = lm
+    toks = np.arange(2 * 24, dtype=np.int32).reshape(2, 24) % cfg.vocab_size
+    self_agree = teacher_forced_agreement(
+        model, params, model, params, toks, prompt_len=8
+    )
+    assert self_agree == 1.0
+    qm, qp = quantize_model(model, params, mode="mxu")
+    agree = teacher_forced_agreement(model, params, qm, qp, toks, prompt_len=8)
+    assert 0.0 <= agree <= 1.0
+    with pytest.raises(ValueError, match="past prompt_len"):
+        teacher_forced_agreement(
+            model, params, model, params, toks[:, :8], prompt_len=8
+        )
+
+
+def test_quant_decision_gate(lm):
+    """Auto-gate: weight-only is OFF below the measured size threshold
+    (0.76x at 124M on chip, r4) and ON above; mxu is ungated. The
+    gated maybe_quantize returns the ORIGINAL model/params untouched."""
+    from tpuflow.infer import maybe_quantize, quant_decision
+
+    model, params, _ = lm
+    d = quant_decision(params, mode="weight")
+    assert not d.apply and "gated OFF" in d.reason and d.weight_bytes > 0
+    assert quant_decision(params, mode="mxu").apply
+    m2, p2, dec = maybe_quantize(model, params, mode="weight")
+    assert m2 is model and p2 is params and not dec.apply
+    qm, qp, dec2 = maybe_quantize(model, params, mode="mxu")
+    assert isinstance(qm, QuantizedModel) and dec2.apply
+    # Threshold itself: a fake tree above the line turns weight mode on.
+    import tpuflow.infer.quant as quant_mod
+
+    big = {"w": np.zeros((quant_mod.WEIGHT_QUANT_MIN_BYTES // 4 + 1,),
+                         np.float32)}
+    assert quant_decision(big, mode="weight").apply
+
+
 def test_generation_predictor_quantize(lm):
-    """engine integration: quantize='int8' at predictor construction."""
+    """engine integration: explicit quantize='int8'/'int8-mxu' are
+    FORCED (a capacity ask the throughput gate must not override, with
+    the gate's advisory verdict still recorded); 'auto' delegates to the
+    measured policy — a tiny model keeps fp weights."""
     from tpuflow.infer import GenerationPredictor
 
     model, params, cfg = lm
@@ -170,8 +293,26 @@ def test_generation_predictor_quantize(lm):
     )
     out = pred({"tokens": [[1, 2, 3, 4], [5, 6]]})
     assert np.asarray(out["generated"]).shape == (2, 4)
-    from tpuflow.infer.quant import QuantizedModel
-
+    # Explicit ask wins; the advisory verdict (gate would say no at this
+    # size) is still recorded for the caller to inspect.
     assert isinstance(pred.model, QuantizedModel)
+    assert pred.model.mode == "weight"
+    assert pred.quant_decision is not None and not pred.quant_decision.apply
+    mxu = GenerationPredictor(
+        model, params, max_new_tokens=4, temperature=0.0, quantize="int8-mxu"
+    )
+    assert isinstance(mxu.model, QuantizedModel)
+    assert mxu.model.mode == "mxu" and mxu.quant_decision.apply
+    out = mxu({"tokens": [[1, 2, 3, 4], [5, 6]]})
+    assert np.asarray(out["generated"]).shape == (2, 4)
+    # 'auto': the measured policy decides — fp at this size.
+    auto = GenerationPredictor(
+        model, params, max_new_tokens=4, temperature=0.0, quantize="auto"
+    )
+    assert auto.model is model and not auto.quant_decision.apply
+    # No quantize ask: no decision recorded.
+    assert GenerationPredictor(
+        model, params, max_new_tokens=4
+    ).quant_decision is None
     with pytest.raises(ValueError, match="unknown quantize"):
         GenerationPredictor(model, params, max_new_tokens=4, quantize="fp4")

@@ -9,10 +9,14 @@ from tpuflow.infer.engine import (
 )
 from tpuflow.infer.generate import generate, pad_ragged, render_tokens
 from tpuflow.infer.quant import (
+    QuantDecision,
     QuantizedModel,
     dequantize_params,
+    maybe_quantize,
+    quant_decision,
     quantize_model,
     quantize_params,
+    teacher_forced_agreement,
 )
 from tpuflow.infer.score import best_of_n, sequence_logprob
 from tpuflow.infer.speculative import speculative_generate
@@ -20,16 +24,20 @@ from tpuflow.infer.speculative import speculative_generate
 __all__ = [
     "BatchPredictor",
     "GenerationPredictor",
+    "QuantDecision",
     "QuantizedModel",
     "beam_search",
     "best_of_n",
     "dequantize_params",
     "generate",
     "map_batches",
+    "maybe_quantize",
     "pad_ragged",
+    "quant_decision",
     "quantize_model",
     "quantize_params",
     "render_tokens",
     "sequence_logprob",
     "speculative_generate",
+    "teacher_forced_agreement",
 ]

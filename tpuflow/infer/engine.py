@@ -168,17 +168,43 @@ class GenerationPredictor:
         rng=None,
         quantize: str | None = None,
     ):
+        self.quant_decision = None
         if quantize is not None:
-            # Weight-only int8: decode is HBM-bound, int8 weights quarter
-            # the per-token stream (tpuflow.infer.quant). The wrapper is
-            # a drop-in static model; everything below is unchanged.
-            if quantize != "int8":
-                raise ValueError(
-                    f"unknown quantize mode {quantize!r}; supported: int8"
-                )
-            from tpuflow.infer.quant import quantize_model
+            # Explicit modes are FORCED — 'int8' (weight-only at rest; a
+            # memory-capacity ask the throughput gate must not override)
+            # and 'int8-mxu' (W8A8 dynamic activation quantization).
+            # 'auto' delegates to the measured policy (quant_decision):
+            # weight-only only above the size threshold where it pays
+            # (0.76x vs fp at 124M/b8 on chip, r4), fp otherwise. The
+            # verdict lands on ``self.quant_decision`` either way; the
+            # wrapper is a drop-in static model, everything below is
+            # unchanged.
+            from tpuflow.infer.quant import (
+                maybe_quantize,
+                quant_decision,
+                quantize_model,
+            )
 
-            model, params = quantize_model(model, params)
+            modes = {"int8": "weight", "int8-mxu": "mxu"}
+            if quantize == "auto":
+                model, params, self.quant_decision = maybe_quantize(
+                    model, params, mode="weight"
+                )
+            elif quantize in modes:
+                # Advisory verdict on the ORIGINAL float tree (after
+                # quantization the byte count would be meaningless), then
+                # quantize unconditionally — the user asked.
+                self.quant_decision = quant_decision(
+                    params, mode=modes[quantize]
+                )
+                model, params = quantize_model(
+                    model, params, mode=modes[quantize]
+                )
+            else:
+                raise ValueError(
+                    f"unknown quantize mode {quantize!r}; supported: "
+                    f"{sorted(modes) + ['auto']}"
+                )
         self.model = model
         self.params = params
         self.max_new_tokens = max_new_tokens

@@ -403,7 +403,16 @@ class GPT2(nn.Module):
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h{i}")(x, train, decode, pad_lens)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_f")(x)
-        # Weight-tied LM head; logits in float32 for a stable softmax/CE.
-        return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype)).astype(
-            jnp.float32
+        # Weight-tied LM head; logits come straight out of the MXU's f32
+        # accumulator (preferred_element_type) — never rounded through
+        # bf16. The old einsum→bf16→f32 path collapsed near-tie logits
+        # onto equal bf16 values, and argmax over those flipped between
+        # the chunked verify forward and single-token decode (the r4
+        # on-chip speculative numerics_ok=false). f32 logits also feed a
+        # stable softmax/CE in training.
+        return jnp.einsum(
+            "btc,vc->btv",
+            x,
+            wte.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
         )
