@@ -55,6 +55,23 @@ def test_flash_grad_matches_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_flash_grad_compact_lse_residual(monkeypatch):
+    """TPUFLOW_FLASH_LSE=compact (the remat-off memory escape hatch)
+    stores the (BH, Tq) residual and reinflates it in the backward —
+    gradients must match the default full-layout path exactly."""
+    q, k, v = _qkv(B=1, T=32, H=2, D=16)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, block_q=16, block_k=16).sum()
+
+    g_full = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("TPUFLOW_FLASH_LSE", "compact")
+    jax.clear_caches()  # the env knob resolves at trace time
+    g_compact = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_full, g_compact):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_ring_attention_matches_single_device():
     mesh = dist.make_mesh({"data": 2, "seq": 4})
     q, k, v = _qkv(B=2, T=64, H=2, D=16)

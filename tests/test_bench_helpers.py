@@ -158,3 +158,71 @@ def test_compact_summary_is_small_and_carries_headline():
     )
     assert s2["summary"]["train"]["fresh"] is True
     assert s2["summary"]["train"]["mfu"] == 0.5
+
+
+def test_flash_crossover_fit():
+    """Crossover = smallest trusted T where flash fwd+bwd wins, only when
+    every larger measured T agrees; suspect/broken points are excluded."""
+    recs = {
+        "T512": {"numerics_ok": True, "fwdbwd_speedup": 0.2,
+                 "timing_suspect": ["xla"]},
+        "T1024": {"numerics_ok": True, "fwdbwd_speedup": 1.1},
+        "T2048": {"numerics_ok": True, "fwdbwd_speedup": 1.73},
+        "T4096": {"numerics_ok": True, "fwdbwd_speedup": 2.1},
+    }
+    assert bench._flash_crossover_from(recs) == 1024
+    # A numerics failure at a larger T doesn't veto (it carries no
+    # speedup at all); a genuine slower point above the candidate does.
+    recs["T4096"] = {"numerics_ok": True, "fwdbwd_speedup": 0.9}
+    assert bench._flash_crossover_from(recs) is None
+    recs["T4096"] = {"numerics_ok": False, "max_err": 1.0}
+    assert bench._flash_crossover_from(recs) == 1024
+    assert bench._flash_crossover_from({}) is None
+
+
+def test_flash_tuning_roundtrip(tmp_path, monkeypatch):
+    """bench persists the measured crossover where the dispatcher's
+    impl='auto' reads it: env var beats file beats default."""
+    import importlib
+
+    # tpuflow.ops re-exports the attention FUNCTION; get the module.
+    attn = importlib.import_module("tpuflow.ops.attention")
+
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ", raising=False)
+    attn._flash_tuning_cache = None  # drop the per-process cache
+    assert attn._flash_min_seq() == attn._DEFAULT_FLASH_MIN_SEQ
+    bench._persist_flash_tuning(1024)
+    attn._flash_tuning_cache = None
+    assert attn._flash_min_seq() == 1024
+    monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "512")
+    assert attn._flash_min_seq() == 512  # env var wins over the file
+    monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "banana")
+    assert attn._flash_min_seq() == attn._DEFAULT_FLASH_MIN_SEQ
+    attn._flash_tuning_cache = None
+
+
+def test_flash_tuning_not_persisted_on_suspect_sweep(tmp_path, monkeypatch):
+    """A jitter-polluted sweep (any timing_suspect point) must not clobber
+    the host tuning file — dropping suspect points can only RAISE the
+    fitted crossover and would silently disable measured flash wins."""
+    import importlib
+    import json
+
+    attn = importlib.import_module("tpuflow.ops.attention")
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))
+    bench._persist_flash_tuning(1024)  # a prior clean run's value
+    recs = {
+        "T2048": {"numerics_ok": True, "fwdbwd_speedup": 0.5,
+                  "timing_suspect": ["xla"]},
+        "T4096": {"numerics_ok": True, "fwdbwd_speedup": 2.0},
+    }
+    # Simulate bench_flash's gate: crossover fits 4096, but the sweep is
+    # dirty, so the file must keep the prior value.
+    assert bench._flash_crossover_from(recs) == 4096
+    clean = not any(
+        r.get("timing_suspect") for r in recs.values() if isinstance(r, dict)
+    )
+    assert not clean
+    with open(attn.flash_tuning_path()) as f:
+        assert json.load(f)["flash_min_seq"] == 1024

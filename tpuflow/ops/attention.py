@@ -45,31 +45,64 @@ def xla_attention(q, k, v, *, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+_DEFAULT_FLASH_MIN_SEQ = 2048
+_flash_tuning_cache: dict | None = None
+
+
+def flash_tuning_path() -> str:
+    """Where ``bench.py`` persists the measured flash/XLA fwd+bwd
+    crossover on this host: ``$TPUFLOW_HOME/flash_tuning.json`` with
+    ``{"flash_min_seq": T}``."""
+    import os
+
+    home = os.environ.get(
+        "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
+    )
+    return os.path.join(home, "flash_tuning.json")
+
+
+def _flash_min_seq() -> int:
+    """Dispatch threshold resolution: TPUFLOW_FLASH_MIN_SEQ env var beats
+    the host's measured tuning file beats the shipped default. The file
+    read is cached per process (this runs at trace time)."""
+    import json
+    import os
+
+    global _flash_tuning_cache
+    env = os.environ.get("TPUFLOW_FLASH_MIN_SEQ")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            return _DEFAULT_FLASH_MIN_SEQ  # malformed knob: keep default
+    if _flash_tuning_cache is None:
+        try:
+            with open(flash_tuning_path()) as f:
+                _flash_tuning_cache = json.load(f)
+        except (OSError, ValueError):
+            _flash_tuning_cache = {}
+    v = _flash_tuning_cache.get("flash_min_seq")
+    return v if isinstance(v, int) and v > 0 else _DEFAULT_FLASH_MIN_SEQ
+
+
 def attention(q, k, v, *, causal: bool = True, impl: str = "xla"):
     """Dispatch to the selected implementation (see module docstring).
 
-    ``impl='auto'`` picks by measured crossover: on-chip round-4 evidence
-    (TPU_EVIDENCE.json flash_attention) has the Pallas kernel's fwd+bwd
-    LOSING to XLA at T=512 (0.2x — the custom bwd recomputes what XLA's
-    saved-activation bwd reads back) and WINNING at T=2048 (1.73x, where
-    the O(T^2) score materialization starts to hurt XLA). 'auto' therefore
-    uses flash only on TPU at T >= TPUFLOW_FLASH_MIN_SEQ (default 2048,
-    the measured-win point; retune as more lengths get measured) and XLA
-    everywhere else — CPU always takes XLA (flash there is interpret-mode,
-    for tests only).
+    ``impl='auto'`` picks by measured crossover: flash only on TPU at
+    T >= the resolved threshold (TPUFLOW_FLASH_MIN_SEQ env var, else the
+    host's bench-measured tuning file — ``flash_tuning_path()`` — else
+    2048, the r4 measured-win point: on-chip evidence had fwd+bwd
+    winning at T=2048 by 1.73x while the T=512 record proved timing-
+    artifact-suspect), and XLA everywhere else — CPU always takes XLA
+    (flash there is interpret-mode, for tests only).
     """
     if impl == "auto":
-        import os
-
-        try:
-            min_seq = int(os.environ.get("TPUFLOW_FLASH_MIN_SEQ", "2048"))
-        except ValueError:
-            min_seq = 2048  # malformed knob: keep the measured default
         # NB: resolved at trace time — under jit the choice is baked into
         # the compiled program for each shape; changing the env var after
         # compilation does not retune existing executables.
         on_tpu = jax.default_backend() == "tpu"
-        impl = "flash" if (on_tpu and q.shape[1] >= min_seq) else "xla"
+        impl = "flash" if (on_tpu and q.shape[1] >= _flash_min_seq()) \
+            else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal)
     if impl == "flash":

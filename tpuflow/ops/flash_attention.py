@@ -349,9 +349,14 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 
     qf, kf, vf = flat(q, Tq), flat(k, Tk), flat(v, Tk)
     of, gf = flat(o, Tq), flat(g, Tq)
-    # Re-expand the (BH, Tq) residual to the 128-lane layout the kernels'
-    # block specs need; transient for the two backward calls only.
-    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+    # lse normally arrives in the kernels' native (BH, Tq, 128)
+    # lane-broadcast layout straight from the forward — no
+    # slice/rebroadcast round trip (at short T those two extra HBM
+    # passes rival the useful q/k/v traffic). Under
+    # TPUFLOW_FLASH_LSE=compact the residual is (BH, Tq) and is
+    # reinflated here.
+    if lse.ndim == 2:
+        lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
@@ -420,10 +425,19 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
     o, lse = _flash_fwd(
         q, k, v, causal, block_q, block_k, interpret, with_lse=True
     )
-    # The kernel emits lse broadcast over a 128-lane minor dim (Mosaic
-    # tiling); keep only lane 0 in the residual so the value held alive
-    # from forward to backward is (BH, Tq) f32, not 128x that.
-    return o, (q, k, v, o, lse[..., 0])
+    # The residual keeps the kernel's native (BH, Tq, 128) lane-broadcast
+    # layout by default (the same choice as the reference TPU flash
+    # kernels, which hold their l/m residuals this way): slicing to a
+    # compact (BH, Tq) here and re-broadcasting in the backward costs two
+    # full-array HBM passes per step, which at short T dominates the
+    # backward. The 128x f32 residual is transient per layer under remat;
+    # WITHOUT remat it is held for every layer simultaneously and roughly
+    # doubles attention's residual bytes — TPUFLOW_FLASH_LSE=compact
+    # restores the small residual for memory-bound remat-off configs
+    # (trading the two HBM passes back).
+    if os.environ.get("TPUFLOW_FLASH_LSE") == "compact":
+        return o, (q, k, v, o, lse[..., 0])
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
