@@ -130,7 +130,10 @@ def train_func_per_worker(config: dict) -> None:
         shard_index=jax.process_index(),
         num_shards=nproc,
     )
-    _log(f"dataloaders ready (world={world}, rank={rank})")
+    _log(
+        f"dataloaders ready (world={world}, rank={rank}, "
+        f"mesh={dict(ctx.mesh.shape)})"
+    )
 
     # Resolve any resume source FIRST and start backing its restore
     # destination pages in the background (ckpt.RestoreArena): the model
@@ -299,10 +302,26 @@ def train_model(
         "model_kwargs": model_kwargs,
         "num_classes": num_classes,
     }
+    # TPUFLOW_DCN_DATA=N: hybrid-mesh mode — the 'data' axis spans N
+    # slices/hosts over DCN while each slice's local devices form an
+    # ICI-side 'fsdp' axis (dist.make_hybrid_mesh; the multi-pod recipe
+    # of SURVEY.md §1). batch_sharding splits batches over data x fsdp,
+    # so the DP world and the loss math are unchanged vs the flat mesh.
+    # An EXPLICIT num_workers argument always wins over the env knob —
+    # a lingering env var must not silently discard a caller's ask.
+    dcn_data = int(os.environ.get("TPUFLOW_DCN_DATA", "0") or 0)
+    if dcn_data > 1 and (num_workers is None or num_workers <= 0):
+        _log(f"hybrid mesh: TPUFLOW_DCN_DATA={dcn_data} (data over "
+             "DCN x fsdp over ICI)")
+        scaling = ScalingConfig(
+            dcn_mesh_axes={"data": dcn_data}, use_tpu=use_tpu
+        )
+    else:
+        scaling = ScalingConfig(num_workers=workers, use_tpu=use_tpu)
     trainer = Trainer(
         train_func_per_worker,
         train_loop_config=train_config,
-        scaling_config=ScalingConfig(num_workers=workers, use_tpu=use_tpu),
+        scaling_config=scaling,
         run_config=RunConfig(
             storage_path=checkpoint_storage_path,
             checkpoint_config=CheckpointConfig(num_to_keep=num_to_keep),

@@ -226,3 +226,24 @@ def test_flash_tuning_not_persisted_on_suspect_sweep(tmp_path, monkeypatch):
     assert not clean
     with open(attn.flash_tuning_path()) as f:
         assert json.load(f)["flash_min_seq"] == 1024
+
+
+def test_mfu_roofline_bounds():
+    """The ceiling argument attached to every sweep config: GPT-2-124M on
+    v5e is compute-bound at the swept batch sizes (memory floor well
+    under the compute floor), so attainable_mfu ~= 1.0 and the measured
+    gap is kernel/pipeline inefficiency, not an HBM wall."""
+    n = 124_000_000
+    r = bench._mfu_roofline(n, 8, 512, peak_flops=197e12, hbm_gbps=819.0)
+    assert r["bound"] == "compute"
+    assert r["attainable_mfu"] == 1.0
+    assert r["compute_floor_ms"] > 3 * r["memory_floor_ms"]
+    # Tiny batch flips the balance: one sequence of 32 tokens streams the
+    # full optimizer state per step — memory-bound.
+    r2 = bench._mfu_roofline(n, 1, 32, peak_flops=197e12, hbm_gbps=819.0)
+    assert r2["bound"] == "memory"
+    assert r2["attainable_mfu"] < 1.0
+    # HBM table matches device_kind strings like the FLOPs table does.
+    assert bench._hbm_gbps_for("TPU v5 lite") == 819.0
+    assert bench._hbm_gbps_for("TPU v6e") == 1640.0
+    assert bench._hbm_gbps_for("TPU weird") == bench._DEFAULT_HBM_GBPS

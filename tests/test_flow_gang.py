@@ -612,3 +612,99 @@ def test_gang_kill_mid_save_leaves_no_torn_step(tmp_path):
         assert run.data.step2_value == 2.0
     finally:
         os.environ.pop("TPUFLOW_CRASH_SENTINEL", None)
+
+
+@pytest.mark.slow
+def test_gang_hybrid_mesh_loss_parity(tmp_path, monkeypatch):
+    """The joined rehearsal (VERDICT r4 #8): flows/train_flow.py as a REAL
+    2-process jax.distributed gang whose workers build a HYBRID mesh —
+    'data' across the two processes (DCN-outer, process_index standing in
+    for slice_index on CPU), 'fsdp' over each process's 4 local virtual
+    devices — must train to the same loss as the single-process 8-device
+    flat run.
+
+    Parity layers: (1) the loader's global-permutation-then-stride
+    sharding gives every global step an IDENTICAL batch set in both
+    topologies — asserted exactly below; (2) end-of-run val_loss agrees
+    to a tolerance that allows f32 reduction-order noise (the hybrid
+    mesh reduces gradients over a hierarchical 2x4 tree, the flat mesh
+    over one 8-way ring) amplified through 8 SGD steps of an untrained
+    ReLU net — wide enough for that chaos, far too tight for any real
+    math bug (a wrong world size or mask scales the loss by ~2x)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    home = str(tmp_path / "home")
+    base_env = {
+        **os.environ,
+        "TPUFLOW_HOME": home,
+        "TPUFLOW_FORCE_CPU": "1",
+        "TPUFLOW_DATA_DIR": str(tmp_path / "data"),
+        "TPUFLOW_SYNTH_TRAIN_N": "256",
+        "TPUFLOW_SYNTH_TEST_N": "128",
+    }
+
+    def run_flow(extra_env):
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "flows", "train_flow.py"),
+             "run", "--epochs", "1", "--batch-size", "32"],
+            env={**base_env, **extra_env},
+            capture_output=True, text=True, timeout=900,
+        )
+        assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+        return p.stdout + p.stderr
+
+    # Run 1: 2-process gang, hybrid mesh data(DCN)=2 x fsdp(ICI)=4.
+    run_flow({
+        "TPUFLOW_N_PARALLEL": "2",
+        "TPUFLOW_GANG_LOCAL_DEVICES": "4",
+        "TPUFLOW_DCN_DATA": "2",
+    })
+    # Run 2: single process, flat 8-device data mesh.
+    run_flow({
+        "TPUFLOW_N_PARALLEL": "1",
+        "TPUFLOW_GANG_LOCAL_DEVICES": "8",
+    })
+
+    from tpuflow.flow import Run
+
+    r1 = Run("TpuTrain/1").data.result
+    r2 = Run("TpuTrain/2").data.result
+    # Structural proof the topology ask was honored (Result.mesh_axes —
+    # gang-worker stdout is only surfaced on failure).
+    assert r1.mesh_axes["data"] == 2 and r1.mesh_axes["fsdp"] == 4, \
+        r1.mesh_axes
+    assert r2.mesh_axes["data"] == 8, r2.mesh_axes
+    m1, m2 = r1.metrics, r2.metrics
+    assert abs(m1["val_loss"] - m2["val_loss"]) < 2e-3, (m1, m2)
+    assert abs(m1["accuracy"] - m2["accuracy"]) < 0.05, (m1, m2)
+
+    # Exact layer: the two topologies' loaders assemble the SAME global
+    # batch set at every step (stride-sharded from one seeded
+    # permutation), so the runs above trained on identical data.
+    import numpy as np
+
+    monkeypatch.syspath_prepend(os.path.join(repo, "flows"))
+    for k, v in base_env.items():
+        if k.startswith("TPUFLOW_"):
+            monkeypatch.setenv(k, v)
+    from my_tpu_module import get_dataloaders
+
+    flat, _ = get_dataloaders(32, dataset="fashion_mnist", seed=0,
+                              shard_index=0, num_shards=1)
+    sh0, _ = get_dataloaders(16, dataset="fashion_mnist", seed=0,
+                             shard_index=0, num_shards=2)
+    sh1, _ = get_dataloaders(16, dataset="fashion_mnist", seed=0,
+                             shard_index=1, num_shards=2)
+    for ldr in (flat, sh0, sh1):
+        if hasattr(ldr, "set_epoch"):
+            ldr.set_epoch(0)
+    for f, a, b in zip(flat, sh0, sh1):
+        rows_flat = np.sort(
+            f["x"].reshape(f["x"].shape[0], -1).sum(axis=1)
+        )
+        rows_hybrid = np.sort(
+            np.concatenate([a["x"], b["x"]]).reshape(32, -1).sum(axis=1)
+        )
+        np.testing.assert_allclose(rows_flat, rows_hybrid)

@@ -433,10 +433,17 @@ def make_hybrid_mesh(
     ICI side) so every tpuflow sharding rule resolves.
 
     Slices are identified by ``device.slice_index`` (TPU runtimes expose
-    it); on single-slice or CPU platforms a DCN product of 1 degrades to
-    exactly ``make_mesh`` semantics.
+    it); on a multi-process CPU gang — the dev-mode analogue of pod
+    slices over DCN, where every CPU device reports slice 0 —
+    ``device.process_index`` stands in, so one host == one slice and the
+    DCN axes partition across the gang's processes. On single-slice or
+    CPU platforms a DCN product of 1 degrades to exactly ``make_mesh``
+    semantics.
     """
     devices = list(devices if devices is not None else jax.devices())
+
+    def _slice_id(d) -> int:
+        return getattr(d, "slice_index", 0) or 0
     dcn_axes = dict(dcn_axes)
     ici_axes = dict(ici_axes)
     overlap = set(dcn_axes) & set(ici_axes)
@@ -451,16 +458,27 @@ def make_hybrid_mesh(
             "meshes; specify every axis size explicitly"
         )
 
-    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
+    slice_ids = sorted({_slice_id(d) for d in devices})
+    if len(slice_ids) != n_slices and all(
+        getattr(d, "platform", "") == "cpu" for d in devices
+    ):
+        # Multi-process CPU gang (the dev-mode analogue of pod slices
+        # over DCN): every CPU device reports slice_index 0, so the
+        # process becomes the slice — one host == one slice, DCN axes
+        # partition across the gang's processes.
+        def _slice_id(d) -> int:  # noqa: F811 — deliberate rebind
+            return getattr(d, "process_index", 0)
+
+        slice_ids = sorted({_slice_id(d) for d in devices})
     if len(slice_ids) != n_slices:
         raise ValueError(
             f"dcn axes {dict(dcn_axes)} want {n_slices} slices but the "
             f"devices span {len(slice_ids)} (slice ids {slice_ids})"
         )
-    per_slice = [d for d in devices if getattr(d, "slice_index", 0) == slice_ids[0]]
+    per_slice = [d for d in devices if _slice_id(d) == slice_ids[0]]
     n_ici = math.prod(ici_axes.values())
     if any(
-        sum(1 for d in devices if getattr(d, "slice_index", 0) == s) != len(per_slice)
+        sum(1 for d in devices if _slice_id(d) == s) != len(per_slice)
         for s in slice_ids
     ) or n_ici != len(per_slice):
         raise ValueError(
@@ -496,7 +514,7 @@ def make_hybrid_mesh(
             e,
         )
         by_slice = [
-            [d for d in devices if getattr(d, "slice_index", 0) == s]
+            [d for d in devices if _slice_id(d) == s]
             for s in slice_ids
         ]
         dev_array = np.asarray(by_slice).reshape(shape)
