@@ -182,3 +182,35 @@ def test_speculative_with_fsdp_sharded_params(mesh8):
             )
         )
     np.testing.assert_array_equal(got, want)
+
+
+def test_token_exact_bf16_long_decode():
+    """The r4 on-chip failure mode, reproduced and fixed: bf16 rounding of
+    layer outputs is WIDTH-DEPENDENT (a (K+1)-chunk verify forward and
+    single-token decode round near-boundary values to different bf16
+    ulps — 0.4% steps that dwarf f32 accumulation noise), which flipped
+    near-tie argmaxes ~1/32 tokens on a repetitive prompt. decode_dtype
+    =f32 (the default) makes decode numerics width-independent: 128
+    tokens must match plain greedy EXACTLY on a bf16 model, both layer
+    layouts."""
+    for scan in (False, True):
+        cfg = GPT2Config(
+            vocab_size=512, n_ctx=512, n_embd=128, n_layer=4, n_head=4,
+            dropout=0.0, dtype=jnp.bfloat16, scan_layers=scan,
+        )
+        model = GPT2(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+        )["params"]
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        prompt = np.tile(np.arange(16, dtype=np.int32)[None, :], (1, 8))
+        want = np.asarray(
+            generate(model, params, prompt, max_new_tokens=128,
+                     temperature=0.0)
+        )
+        got = np.asarray(
+            speculative_generate(
+                model, params, prompt, max_new_tokens=128, draft_len=8
+            )
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"scan={scan}")

@@ -75,7 +75,7 @@ def _beam_jit(
     # than prefilling B*K identical prompts.
     logits, vars_out = model.apply(
         {"params": params}, prompt, decode=True, mutable=["cache"],
-        pad_lens=pad_lens,
+        pad_lens=pad_lens, prefill=True,
     )
     axis = _cache_batch_axis(model)
     cache = _tile_cache(vars_out["cache"], K, B, axis)

@@ -102,7 +102,7 @@ def _generate_jit(
         # Prefill: one pass over the prompt initializes + fills the caches.
         logits, vars_out = model.apply(
             {"params": params}, prompt, decode=True, mutable=["cache"],
-            pad_lens=pad_lens,
+            pad_lens=pad_lens, prefill=True,
         )
         cache = vars_out["cache"]
     else:
@@ -123,7 +123,7 @@ def _generate_jit(
             )
             logits, vars_out = model.apply(
                 variables, chunk, decode=True, mutable=["cache"],
-                pad_lens=pad_lens,
+                pad_lens=pad_lens, prefill=True,
             )
             cache = vars_out["cache"]
     rng, sub = jax.random.split(rng)

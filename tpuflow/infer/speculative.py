@@ -73,7 +73,8 @@ def _spec_jit(
 
     # Prefill the prompt, sample the first token (greedy).
     logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"]
+        {"params": params}, prompt, decode=True, mutable=["cache"],
+        prefill=True,
     )
     cache = vars_out["cache"]
     cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)

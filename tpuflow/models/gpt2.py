@@ -54,6 +54,36 @@ class GPT2Config:
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2  # load-balance loss coefficient
+    # Decode-path (KV-cache) compute dtype. Autoregressive decode is
+    # HBM-bandwidth-bound — weights stream as bf16 regardless — so f32
+    # compute costs ~nothing and makes decode numerics WIDTH-INDEPENDENT:
+    # bf16 rounding of layer outputs differs systematically between a
+    # (K+1)-token chunk forward and single-token decode (one bf16 ulp is
+    # 0.4%, dwarfing the 1e-7 f32 accumulation noise), which flipped
+    # near-tie argmaxes and broke speculative decode's exactness vs plain
+    # greedy (r4 on-chip numerics_ok=false; reproduced on CPU-bf16 at
+    # scan_layers). None = use ``dtype`` (the old width-dependent
+    # behavior, for capacity-critical serving).
+    decode_dtype: jnp.dtype | None = jnp.float32
+    # KV-cache storage dtype. None = the decode compute dtype above (so
+    # exactness-by-default); set bfloat16 to halve cache bytes for long
+    # contexts at the cost of the width-dependent rounding amplifier.
+    cache_dtype: jnp.dtype | None = None
+
+    def compute_dtype(self, decode: bool):
+        """Activation/compute dtype for this forward: ``decode_dtype``
+        on the KV-cache path (width-independent f32 by default — see the
+        field comment), ``dtype`` for training/scoring forwards."""
+        if decode and self.decode_dtype is not None:
+            return self.decode_dtype
+        return self.dtype
+
+    def kv_cache_dtype(self):
+        """Storage dtype of the KV cache (``cache_dtype`` override, else
+        the decode compute dtype)."""
+        if self.cache_dtype is not None:
+            return self.cache_dtype
+        return self.compute_dtype(decode=True)
 
     @classmethod
     def small_test(cls, **kw) -> "GPT2Config":
@@ -168,13 +198,21 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, train: bool, decode: bool = False, pad_lens=None):
+    def __call__(self, x, train: bool, decode: bool = False, pad_lens=None,
+                 prefill: bool = False):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
+        # Decode-path compute dtype (f32 by default: width-independent
+        # numerics on the HBM-bound path; see GPT2Config.decode_dtype).
+        # Prefill keeps the training dtype — prompt ingestion runs with
+        # the SAME width in every decode strategy, so it cannot introduce
+        # width-dependent rounding, and it is the one decode-mode call
+        # that is compute-bound (TxT attention over the whole prompt).
+        dt = cfg.compute_dtype(decode and not prefill)
 
-        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_1")(x)
-        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_1")(x)
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=dt, name="c_attn")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, cfg.n_head, head_dim)
         k = k.reshape(B, T, cfg.n_head, head_dim)
@@ -190,11 +228,11 @@ class Block(nn.Module):
         else:
             a = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         a = a.reshape(B, T, cfg.n_embd)
-        a = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(a)
+        a = nn.Dense(cfg.n_embd, dtype=dt, name="c_proj")(a)
         a = nn.Dropout(cfg.dropout, deterministic=not train)(a)
         x = x + a
 
-        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_2")(x)
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_2")(x)
         if cfg.n_experts > 0:
             from tpuflow.models.moe import MoEMLP
 
@@ -204,13 +242,13 @@ class Block(nn.Module):
                 n_experts=cfg.n_experts,
                 capacity_factor=cfg.capacity_factor,
                 aux_weight=cfg.moe_aux_weight,
-                dtype=cfg.dtype,
+                dtype=dt,
                 name="moe",
             )(h, train)
         else:
-            h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="mlp_fc")(h)
+            h = nn.Dense(4 * cfg.n_embd, dtype=dt, name="mlp_fc")(h)
             h = nn.gelu(h)
-            h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="mlp_proj")(h)
+            h = nn.Dense(cfg.n_embd, dtype=dt, name="mlp_proj")(h)
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
@@ -238,29 +276,30 @@ class Block(nn.Module):
 
         cfg = self.config
         B, T, H, D = q.shape
+        cdt = cfg.kv_cache_dtype()
         ck = self.variable(
             "cache",
             "cached_key",
             jnp.zeros,
             (B, cfg.n_ctx, H, D),
-            cfg.dtype,
+            cdt,
         )
         cv = self.variable(
             "cache",
             "cached_value",
             jnp.zeros,
             (B, cfg.n_ctx, H, D),
-            cfg.dtype,
+            cdt,
         )
         idx = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         start = idx.value
         ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, start, 0, 0)
+            ck.value, k.astype(cdt), (0, start, 0, 0)
         )
         cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, start, 0, 0)
+            cv.value, v.astype(cdt), (0, start, 0, 0)
         )
         idx.value = start + T
 
@@ -299,9 +338,12 @@ class _ScanBlock(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, train: bool, decode: bool = False, pad_lens=None):
+    def __call__(self, x, train: bool, decode: bool = False, pad_lens=None,
+                 prefill: bool = False):
         return (
-            Block(self.config, name="block")(x, train, decode, pad_lens),
+            Block(self.config, name="block")(
+                x, train, decode, pad_lens, prefill
+            ),
             None,
         )
 
@@ -314,12 +356,17 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(
         self, tokens, *, train: bool = False, decode: bool = False,
-        pad_lens=None,
+        pad_lens=None, prefill: bool = False,
     ):
         """``pad_lens`` (B,) int32 marks LEFT-padded rows: row b's first
         ``pad_lens[b]`` columns are padding — their positions clamp to 0,
         and every attention masks them out of the key set (ragged prompt
-        generation / scoring; tpuflow.infer)."""
+        generation / scoring; tpuflow.infer). ``prefill=True`` marks a
+        decode-mode call that ingests the prompt: it keeps the training
+        compute dtype (same-width in every decode strategy, so no
+        width-dependent rounding; and it is the compute-bound decode
+        call) while verify chunks and single-token steps run in
+        ``decode_dtype``."""
         cfg = self.config
         B, T = tokens.shape
         if pad_lens is not None:
@@ -368,7 +415,8 @@ class GPT2(nn.Module):
             pe = wpe[positions]
         else:
             pe = wpe[:T]
-        x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
+        dt = cfg.compute_dtype(decode and not prefill)
+        x = wte[tokens].astype(dt) + pe.astype(dt)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         def remat_wrap(mod):
             import jax as _jax
@@ -384,7 +432,7 @@ class GPT2(nn.Module):
                         f"unknown remat_policy {cfg.remat_policy!r}; valid "
                         "names are the jax.checkpoint_policies attributes"
                     ) from None
-            return nn.remat(mod, static_argnums=(2, 3), policy=policy)
+            return nn.remat(mod, static_argnums=(2, 3, 4), policy=policy)
 
         if cfg.scan_layers:
             body = remat_wrap(_ScanBlock) if cfg.remat else _ScanBlock
@@ -397,22 +445,25 @@ class GPT2(nn.Module):
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,
             )
-            x, _ = blocks(cfg, name="h")(x, train, decode, pad_lens)
+            x, _ = blocks(cfg, name="h")(x, train, decode, pad_lens, prefill)
         else:
             block_cls = remat_wrap(Block) if cfg.remat else Block
             for i in range(cfg.n_layer):
-                x = block_cls(cfg, name=f"h{i}")(x, train, decode, pad_lens)
-        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype, name="ln_f")(x)
+                x = block_cls(cfg, name=f"h{i}")(
+                    x, train, decode, pad_lens, prefill
+                )
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_f")(x)
         # Weight-tied LM head; logits come straight out of the MXU's f32
         # accumulator (preferred_element_type) — never rounded through
         # bf16. The old einsum→bf16→f32 path collapsed near-tie logits
         # onto equal bf16 values, and argmax over those flipped between
-        # the chunked verify forward and single-token decode (the r4
-        # on-chip speculative numerics_ok=false). f32 logits also feed a
-        # stable softmax/CE in training.
+        # the chunked verify forward and single-token decode (one part of
+        # the r4 on-chip speculative numerics_ok=false; decode_dtype
+        # handles the layer-stack part). f32 logits also feed a stable
+        # softmax/CE in training.
         return jnp.einsum(
             "btc,vc->btv",
             x,
-            wte.astype(cfg.dtype),
+            wte.astype(dt),
             preferred_element_type=jnp.float32,
         )
