@@ -217,13 +217,16 @@ def speculative_generate(
     """Greedy decode via prompt-lookup speculation, committing up to
     ``draft_len + 1`` tokens per model forward when the context repeats.
 
-    Token-exact vs ``generate(..., temperature=0)`` up to the numerics of
-    the batched verify forward: acceptance compares the model's argmax
-    over a (K+1)-token warm-cache chunk against single-token decode, and
-    on low-precision platforms (TPU bf16) the different contraction
-    shapes can in principle flip a near-tie argmax. Verified bit-exact
-    across 9 CPU scenarios (tests/test_speculative.py); the bench
-    withholds any speedup claim on mismatch rather than assuming.
+    Token-exact vs ``generate(..., temperature=0)``: acceptance compares
+    the model's argmax over a (K+1)-token warm-cache chunk against
+    single-token decode. On models whose decode path computes in a
+    width-independent dtype (``GPT2Config.decode_dtype``, f32 by default
+    — bf16 rounding of layer outputs differs systematically between
+    chunk widths, which used to flip near-tie argmaxes) this is exact on
+    every platform; verified bit-exact across the CPU scenarios
+    including a 128-token bf16 decode (tests/test_speculative.py). The
+    bench still withholds any speedup claim on mismatch rather than
+    assuming.
 
     ``prompt``: dense (B, T) int32 (ragged batches: decode rows
     separately, or use ``generate``). ``ngram`` is the match-key length
