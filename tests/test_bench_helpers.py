@@ -247,3 +247,24 @@ def test_mfu_roofline_bounds():
     assert bench._hbm_gbps_for("TPU v5 lite") == 819.0
     assert bench._hbm_gbps_for("TPU v6e") == 1640.0
     assert bench._hbm_gbps_for("TPU weird") == bench._DEFAULT_HBM_GBPS
+
+
+def test_measure_device_staging_fields():
+    """The ckpt_device leg's transport-split helper must be executable
+    (CPU drive) and report positive GB/s + seconds for both directions."""
+    import jax
+    import numpy as np
+
+    state = {
+        "w0": jax.device_put(np.random.default_rng(0).standard_normal(
+            (256, 1024)).astype(np.float32)),
+        "w1": jax.device_put(np.zeros((128, 1024), np.float32)),
+    }
+    nbytes = sum(v.nbytes for v in state.values())
+    rec = bench.measure_device_staging(state, nbytes)
+    assert set(rec) == {"stage_get_gbps", "stage_put_gbps",
+                       "stage_get_s", "stage_put_s"}
+    assert rec["stage_get_gbps"] > 0 and rec["stage_put_gbps"] > 0
+    # The seconds fields round to 3 decimals — a warm sub-millisecond CPU
+    # transfer legitimately records 0.0.
+    assert rec["stage_get_s"] >= 0 and rec["stage_put_s"] >= 0

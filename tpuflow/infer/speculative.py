@@ -1,10 +1,12 @@
 """Prompt-lookup speculative decoding: draft from the context, verify in
 one forward — token-exact greedy decoding at a fraction of the steps.
 
-No draft model: candidate continuations come from the sequence itself
-(the last (ngram-1)-gram is matched against the prompt + generated text,
-and the tokens that followed its most recent occurrence become the
-draft — byte-level and natural-language corpora repeat constantly).
+No draft model: candidate continuations come from the sequence itself —
+the trailing (ngram-1)-gram is matched against the prompt + generated
+text and the tokens following its most recent occurrence become the
+draft, LADDERING down to shorter grams (ultimately a single token) when
+the longer gram never recurs — byte-level and natural-language corpora
+repeat short grams constantly even when long ones don't.
 Each iteration then runs ONE cached forward over the draft_len+1 chunk
 (multi-token warm-cache attention is exact: Block._cached_attention's
 masked full-cache path), accepts the longest prefix on which the model's
@@ -91,31 +93,49 @@ def _spec_jit(
     done0 = (cur == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
 
     def draft(hist, n_hist):
-        """Per-row prompt lookup: the K tokens that followed the most
-        recent earlier occurrence of the trailing (ngram-1)-gram.
+        """Per-row prompt lookup with an n-gram LADDER: the K tokens that
+        followed the most recent earlier occurrence of the trailing
+        (ngram-1)-gram; when that gram never recurs, retry with shorter
+        and shorter grams down to 1 (natural text rarely repeats long
+        grams but constantly repeats short ones — the ladder keeps
+        acceptance above the repeat-last-token floor). Wrong drafts only
+        cost speed, never correctness: the verify forward arbitrates.
         ``n_hist`` = tokens valid in hist (prompt + committed + cur)."""
         pos = jnp.arange(W)
 
         def row(h):
+            # One fused scan over the history computes, for EVERY gram
+            # length g <= G at once, whether each window position matches
+            # the trailing g-gram (suffix-aligned comparisons share the
+            # same equality matrix).
             tail = jax.vmap(
                 lambda o: jax.lax.dynamic_index_in_dim(h, o, keepdims=False)
             )(n_hist - G + jnp.arange(G))
-            # windows[i] = h[i : i+G]; match where the whole window equals
-            # the tail AND the window ends strictly before the tail itself.
             idx = pos[:, None] + jnp.arange(G)[None, :]
             windows = h[jnp.clip(idx, 0, W - 1)]
-            ok = jnp.all(windows == tail[None, :], axis=1)
-            ok = ok & (pos + G < n_hist) & (pos + G + K <= W)
-            m = jnp.where(ok, pos, -1).max()  # most recent occurrence
-            found = m >= 0
-            start = jnp.where(found, m + G, 0)
+            eq = windows == tail[None, :]  # (W, G)
+            # suffix_ok[i, g-1] = positions i..i+G-1 match the tail on its
+            # LAST g entries (i.e. a g-gram match ending at i+G).
+            suffix_ok = jnp.cumprod(eq[:, ::-1], axis=1).astype(bool)
+            in_range = (pos + G < n_hist) & (pos + G + K <= W)
+            start = jnp.int32(0)
+            found_any = jnp.bool_(False)
+            # Ladder from the longest gram down: take the first length
+            # with any match (static unroll over G <= ngram-1 lengths).
+            for g in range(G, 0, -1):
+                ok_g = suffix_ok[:, g - 1] & in_range
+                m_g = jnp.where(ok_g, pos, -1).max()
+                found_g = m_g >= 0
+                take = found_g & ~found_any
+                start = jnp.where(take, m_g + G, start)
+                found_any = found_any | found_g
             cand = jax.lax.dynamic_slice(h, (start,), (K,))
-            # No match: propose the last token repeated (cheap, often
-            # right for byte-level runs; wrong drafts only cost speed).
+            # Ladder exhausted (token never seen before): repeat the last
+            # token (often right for byte-level runs).
             last = jax.lax.dynamic_index_in_dim(
                 h, n_hist - 1, keepdims=False
             )
-            return jnp.where(found, cand, jnp.full((K,), last))
+            return jnp.where(found_any, cand, jnp.full((K,), last))
 
         return jax.vmap(row)(hist)
 
