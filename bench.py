@@ -1663,6 +1663,9 @@ def _compact_summary(record: dict, train) -> dict:
             "mfu": train.get("mfu"),
             "tokens_per_s": train.get("tokens_per_s"),
         }
+        # A fresh on-chip run carries the perf verdicts on itself (the
+        # tpu_evidence block is only attached when the leg degraded).
+        ev_train = train
     elif ev_train:
         digest["train"] = {
             "platform": ev_train.get("platform"),
@@ -1677,11 +1680,9 @@ def _compact_summary(record: dict, train) -> dict:
         digest["e2e_flow_on_chip"] = True
     # The r5 perf-feature verdicts, when the chip legs carry them: the
     # spec-decode exactness claim, the int8 mode speedups, and the flash
-    # fwd+bwd crossover — the headline facts a bounded tail must show.
-    # A FRESH on-chip train run carries them on `train` itself (the
-    # tpu_evidence block is only attached when the leg degraded/cached).
-    if isinstance(train, dict) and train.get("platform") == "tpu":
-        ev_train = train
+    # fwd+bwd crossover — the headline facts a bounded tail must show
+    # (ev_train above already points at the fresh train dict when the
+    # leg ran live this process).
     spec = ev_train.get("decode", {}).get("speculative", {})
     rep = spec.get("repetitive", {})
     if "numerics_ok" in rep:

@@ -683,3 +683,66 @@ def test_eos_while_loop_path_matches_scan_path():
         )
     )
     np.testing.assert_array_equal(with_eos, plain)
+
+
+def test_generation_predictor_speculative():
+    """Engine-surface speculative decoding: dense equal-length greedy
+    batches route through prompt-lookup speculation and must be
+    token-exact vs the plain predictor; ragged batches fall through to
+    generate (identical stream); sampling asks fail loudly."""
+    from tpuflow.infer import GenerationPredictor
+
+    model, params = _model()
+    dense_rows = {"tokens": np.tile(
+        np.arange(8, dtype=np.int32)[None, :], (2, 2)
+    )}  # (2, 16) dense ndarray
+    plain = GenerationPredictor(
+        model, params, max_new_tokens=6, temperature=0.0
+    )
+    spec = GenerationPredictor(
+        model, params, max_new_tokens=6, temperature=0.0, speculative=True
+    )
+    np.testing.assert_array_equal(
+        spec(dense_rows)["generated"], plain(dense_rows)["generated"]
+    )
+    # Ragged rows: the fallback path still produces the identical stream.
+    ragged = {"tokens": [[1, 2, 3, 4, 5], [7, 8]]}
+    np.testing.assert_array_equal(
+        spec(ragged)["generated"], plain(ragged)["generated"]
+    )
+    with pytest.raises(ValueError, match="greedy"):
+        GenerationPredictor(
+            model, params, max_new_tokens=4, temperature=0.7,
+            speculative=True,
+        )
+
+
+def test_generation_predictor_speculative_validation_and_dense_lists():
+    """Construction-time validation (bad draft_len/ngram/pad_to fail
+    loudly, not mid-stream) and the equal-length list-form batch taking
+    the dense path (lens normalized away)."""
+    from tpuflow.infer import GenerationPredictor
+
+    model, params = _model()
+    for kw, msg in (
+        ({"ngram": 1}, "ngram"),
+        ({"draft_len": 0}, "draft_len"),
+        ({"pad_to": 32}, "pad_to"),
+    ):
+        with pytest.raises(ValueError, match=msg):
+            GenerationPredictor(
+                model, params, max_new_tokens=4, temperature=0.0,
+                speculative=True, **kw,
+            )
+    # Equal-length LIST rows: no padding happened, so speculation engages
+    # and matches the plain predictor exactly.
+    spec = GenerationPredictor(
+        model, params, max_new_tokens=6, temperature=0.0, speculative=True
+    )
+    plain = GenerationPredictor(
+        model, params, max_new_tokens=6, temperature=0.0
+    )
+    rows = {"tokens": [list(range(1, 9)) * 2, list(range(3, 11)) * 2]}
+    np.testing.assert_array_equal(
+        spec(rows)["generated"], plain(rows)["generated"]
+    )

@@ -167,6 +167,9 @@ class GenerationPredictor:
         pad_to: int | None = None,
         rng=None,
         quantize: str | None = None,
+        speculative: bool = False,
+        draft_len: int = 8,
+        ngram: int = 3,
     ):
         self.quant_decision = None
         if quantize is not None:
@@ -214,6 +217,31 @@ class GenerationPredictor:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.pad_to = pad_to
+        # Speculative (prompt-lookup) decoding for the engine surface:
+        # greedy-only by construction — stochastic sampling would need
+        # acceptance-rejection the drafter doesn't implement, so an
+        # incompatible ask fails loudly here rather than silently
+        # degrading per batch.
+        if speculative and temperature != 0.0:
+            raise ValueError(
+                "speculative=True requires temperature=0.0 (greedy): "
+                "prompt-lookup speculation is token-exact greedy decoding"
+            )
+        if speculative and pad_to is not None:
+            # pad_to left-pads narrower batches, and the speculative path
+            # is dense-only — every padded batch would silently fall back
+            # to plain generate, so the combination is refused outright.
+            raise ValueError(
+                "speculative=True is incompatible with pad_to: padded "
+                "batches are LEFT-padded and speculation is dense-only"
+            )
+        if speculative and draft_len < 1:
+            raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+        if speculative and ngram < 2:
+            raise ValueError(f"ngram must be >= 2, got {ngram}")
+        self.speculative = speculative
+        self.draft_len = draft_len
+        self.ngram = ngram
         # Advanced per __call__ (split): batches sample independently; the
         # same construction-time seed still reproduces the whole stream.
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -262,6 +290,38 @@ class GenerationPredictor:
                     axis=1,
                 )
         self._rng, sub = jax.random.split(self._rng)
+        if lens is not None and bool((lens == prompt.shape[1]).all()):
+            # Rows that HAPPEN to be equal-length arrived as lists: no row
+            # was actually padded, so drop the lens and take the dense
+            # program (faster attention masks; enables speculation).
+            lens = None
+        if (
+            self.speculative
+            and lens is None
+            and prompt.shape[1] >= self.ngram - 1
+            # The uniform advance can overshoot by draft_len+1 — the spec
+            # path needs that slack in n_ctx where plain generate doesn't.
+            and prompt.shape[1] + self.max_new_tokens + self.draft_len + 1
+            <= getattr(self.model.config, "n_ctx", 1 << 30)
+        ):
+            # Dense equal-length greedy batch: the speculative fast path
+            # (token-exact vs generate — decode numerics are
+            # width-independent, GPT2Config.decode_dtype). Ragged batches
+            # and sub-ngram prompts fall through to plain generate, which
+            # produces the identical token stream.
+            from tpuflow.infer.speculative import speculative_generate
+
+            out = speculative_generate(
+                self.model,
+                self.params,
+                prompt,
+                max_new_tokens=self.max_new_tokens,
+                draft_len=self.draft_len,
+                ngram=self.ngram,
+                eos_id=self.eos_id,
+                pad_id=self.pad_id,
+            )
+            return {"generated": np.asarray(out, np.int32)}
         out = generate(
             self.model,
             self.params,
