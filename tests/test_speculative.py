@@ -214,3 +214,49 @@ def test_token_exact_bf16_long_decode():
             )
         )
         np.testing.assert_array_equal(got, want, err_msg=f"scan={scan}")
+
+
+def test_prefill_chunk_token_exact():
+    """Chunked prefill (the long-prompt memory bound) produces the same
+    tokens as plain generate USING THE SAME CHUNKING — and, on a
+    width-independent (f32-decode) model, as the one-shot prefill too."""
+    model, params = _model()
+    prompt = np.tile(np.array([5, 6, 7, 8], np.int32), (2, 6))  # (2, 24)
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=12, temperature=0.0,
+                 prefill_chunk=8)
+    )
+    got = np.asarray(
+        speculative_generate(
+            model, params, prompt, max_new_tokens=12, draft_len=4,
+            prefill_chunk=8,
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+    oneshot = np.asarray(
+        speculative_generate(
+            model, params, prompt, max_new_tokens=12, draft_len=4
+        )
+    )
+    np.testing.assert_array_equal(got, oneshot)
+
+
+def test_prefill_chunk_validation_and_normalization():
+    """Bad chunk widths fail loudly outside jit; a no-op width (>= T)
+    normalizes to the unchunked program (no duplicate compilation key)."""
+    model, params = _model()
+    prompt = np.ones((1, 8), np.int32)
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            speculative_generate(
+                model, params, prompt, max_new_tokens=4, prefill_chunk=bad
+            )
+    want = np.asarray(
+        speculative_generate(model, params, prompt, max_new_tokens=4)
+    )
+    got = np.asarray(
+        speculative_generate(
+            model, params, prompt, max_new_tokens=4, prefill_chunk=64
+        )
+    )
+    np.testing.assert_array_equal(got, want)

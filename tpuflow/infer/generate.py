@@ -98,34 +98,9 @@ def _generate_jit(
     # structure), so dense batches compile the fast T x T prefill path.
     B, T = prompt.shape
 
-    if prefill_chunk is None or prefill_chunk >= T:
-        # Prefill: one pass over the prompt initializes + fills the caches.
-        logits, vars_out = model.apply(
-            {"params": params}, prompt, decode=True, mutable=["cache"],
-            pad_lens=pad_lens, prefill=True,
-        )
-        cache = vars_out["cache"]
-    else:
-        # Chunked prefill for long prompts: fixed-size slices stream into
-        # the cache (static chunk count — at most two distinct widths
-        # compile), bounding the largest attention-score tensor to
-        # (B, H, chunk, n_ctx) instead of (B, H, T, T). Chunks after the
-        # first hit the warm cache at start > 0, which the model computes
-        # exactly (masked full-cache attention behind the lax.cond in
-        # Block._cached_attention).
-        cache = None
-        for start in range(0, T, prefill_chunk):
-            chunk = prompt[:, start:start + prefill_chunk]
-            variables = (
-                {"params": params}
-                if cache is None
-                else {"params": params, "cache": cache}
-            )
-            logits, vars_out = model.apply(
-                variables, chunk, decode=True, mutable=["cache"],
-                pad_lens=pad_lens, prefill=True,
-            )
-            cache = vars_out["cache"]
+    logits, cache = chunked_prefill(
+        model, params, prompt, prefill_chunk, pad_lens=pad_lens
+    )
     rng, sub = jax.random.split(rng)
     # Left-padding puts every row's last REAL token in the last column, so
     # logits[:, -1] is the right next-token distribution for dense and
@@ -206,6 +181,39 @@ def render_tokens(ids, *, byte_level: bool = False) -> str:
             for t in ids
         )
     return " ".join(str(t) for t in ids)
+
+
+def chunked_prefill(model, params, prompt, prefill_chunk, *, pad_lens=None):
+    """Fill a fresh KV cache from ``prompt``, one pass (``prefill_chunk``
+    None or >= T) or in fixed-size slices — chunking bounds the largest
+    attention-score tensor to (B, H, chunk, n_ctx) instead of
+    (B, H, T, T) for long prompts, at a static chunk count (at most two
+    distinct widths compile). Chunks after the first hit the warm cache
+    at start > 0, which the model computes exactly (masked full-cache
+    attention behind the lax.cond in Block._cached_attention). Shared by
+    ``generate`` and ``speculative_generate`` (call INSIDE jit); returns
+    ``(last_chunk_logits, cache)``."""
+    T = prompt.shape[1]
+    if prefill_chunk is None or prefill_chunk >= T:
+        logits, vars_out = model.apply(
+            {"params": params}, prompt, decode=True, mutable=["cache"],
+            pad_lens=pad_lens, prefill=True,
+        )
+        return logits, vars_out["cache"]
+    cache = None
+    for start in range(0, T, prefill_chunk):
+        chunk = prompt[:, start:start + prefill_chunk]
+        variables = (
+            {"params": params}
+            if cache is None
+            else {"params": params, "cache": cache}
+        )
+        logits, vars_out = model.apply(
+            variables, chunk, decode=True, mutable=["cache"],
+            pad_lens=pad_lens, prefill=True,
+        )
+        cache = vars_out["cache"]
+    return logits, cache
 
 
 def after_first_true(flags):

@@ -31,7 +31,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from tpuflow.infer.generate import after_first_true, check_cache_capacity
+from tpuflow.infer.generate import (
+    after_first_true,
+    check_cache_capacity,
+    chunked_prefill,
+)
 
 
 def _reset_index(cache, value):
@@ -53,7 +57,7 @@ def _reset_index(cache, value):
     jax.jit,
     static_argnums=(0,),
     static_argnames=("max_new_tokens", "draft_len", "ngram", "eos_id",
-                     "pad_id", "with_stats"),
+                     "pad_id", "with_stats", "prefill_chunk"),
 )
 def _spec_jit(
     model,
@@ -66,6 +70,7 @@ def _spec_jit(
     eos_id: int | None,
     pad_id: int,
     with_stats: bool = False,
+    prefill_chunk: int | None = None,
 ):
     B, T = prompt.shape
     K = draft_len
@@ -73,12 +78,9 @@ def _spec_jit(
     L = max_new_tokens + K + 1  # output slack for the last overshoot write
     W = T + L  # full history width (drafting searches this)
 
-    # Prefill the prompt, sample the first token (greedy).
-    logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"],
-        prefill=True,
-    )
-    cache = vars_out["cache"]
+    # Prefill the prompt (one shot, or chunked for long prompts — same
+    # memory trade as generate's knob), sample the first token (greedy).
+    logits, cache = chunked_prefill(model, params, prompt, prefill_chunk)
     cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
     # One buffer serves both drafting (full history) and output (the
@@ -233,9 +235,16 @@ def speculative_generate(
     eos_id: int | None = None,
     pad_id: int = 0,
     return_stats: bool = False,
+    prefill_chunk: int | None = None,
 ):
     """Greedy decode via prompt-lookup speculation, committing up to
     ``draft_len + 1`` tokens per model forward when the context repeats.
+
+    ``prefill_chunk``: stream the prompt into the cache in fixed slices
+    (long-context memory bound, same semantics as ``generate``'s knob).
+    For bitwise parity against plain greedy on a bf16-prefill model, use
+    the SAME chunking on both paths — prefill widths round bf16 values
+    identically only when they match.
 
     Token-exact vs ``generate(..., temperature=0)``: acceptance compares
     the model's argmax over a (K+1)-token warm-cache chunk against
@@ -272,6 +281,12 @@ def speculative_generate(
     # The uniform advance can run the cache up to draft_len+1 past the
     # budget before the loop notices — reserve that slack in n_ctx.
     check_cache_capacity(model, T, max_new_tokens + draft_len + 1)
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    if prefill_chunk is not None and prefill_chunk >= T:
+        # Same program as unchunked — normalize so the jit cache doesn't
+        # hold duplicate compilations keyed on a no-op chunk width.
+        prefill_chunk = None
     return _spec_jit(
         model,
         params,
@@ -282,4 +297,5 @@ def speculative_generate(
         eos_id=eos_id,
         pad_id=pad_id,
         with_stats=return_stats,
+        prefill_chunk=prefill_chunk,
     )
