@@ -746,3 +746,29 @@ def test_generation_predictor_speculative_validation_and_dense_lists():
     np.testing.assert_array_equal(
         spec(rows)["generated"], plain(rows)["generated"]
     )
+
+
+def test_beam_prefill_chunk_matches_oneshot():
+    """beam_search(prefill_chunk=N): chunked prompt ingestion produces
+    the same beams as the one-shot prefill (width-independent decode
+    dtype), and bad widths fail loudly."""
+    from tpuflow.infer import beam_search
+
+    model, params = _model()
+    prompt = np.tile(np.array([4, 5, 6, 7], np.int32), (2, 4))  # (2, 16)
+    want_t, want_s = beam_search(
+        model, params, prompt, beam_size=3, max_new_tokens=6
+    )
+    got_t, got_s = beam_search(
+        model, params, prompt, beam_size=3, max_new_tokens=6,
+        prefill_chunk=8,
+    )
+    np.testing.assert_array_equal(np.asarray(got_t), np.asarray(want_t))
+    np.testing.assert_allclose(
+        np.asarray(got_s), np.asarray(want_s), rtol=1e-5
+    )
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        beam_search(
+            model, params, prompt, beam_size=2, max_new_tokens=4,
+            prefill_chunk=0,
+        )

@@ -54,7 +54,8 @@ def _gather_beams(cache, flat_parent, rows: int, axis: int):
 @functools.partial(
     jax.jit,
     static_argnums=(0,),
-    static_argnames=("beam_size", "max_new_tokens", "eos_id", "pad_id"),
+    static_argnames=("beam_size", "max_new_tokens", "eos_id", "pad_id",
+                     "prefill_chunk"),
 )
 def _beam_jit(
     model,
@@ -67,18 +68,21 @@ def _beam_jit(
     eos_id: int | None,
     pad_id: int,
     length_penalty: float = 1.0,
+    prefill_chunk: int | None = None,
 ):
     B, T = prompt.shape
     K = beam_size
 
-    # Prefill ONCE at width B, then tile the cache K-fold — K x cheaper
-    # than prefilling B*K identical prompts.
-    logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"],
-        pad_lens=pad_lens, prefill=True,
+    # Prefill ONCE at width B (one shot or chunked — generate's memory
+    # knob), then tile the cache K-fold — K x cheaper than prefilling
+    # B*K identical prompts.
+    from tpuflow.infer.generate import chunked_prefill
+
+    logits, prefill_cache = chunked_prefill(
+        model, params, prompt, prefill_chunk, pad_lens=pad_lens
     )
     axis = _cache_batch_axis(model)
-    cache = _tile_cache(vars_out["cache"], K, B, axis)
+    cache = _tile_cache(prefill_cache, K, B, axis)
     tiled_pad_lens = (
         jnp.repeat(pad_lens, K, axis=0) if pad_lens is not None else None
     )
@@ -181,6 +185,7 @@ def beam_search(
     length_penalty: float = 1.0,
     prompt_lens=None,
     return_all: bool = False,
+    prefill_chunk: int | None = None,
 ):
     """Deterministic beam-search continuation of ``prompt`` (B, T) int32.
 
@@ -189,10 +194,13 @@ def beam_search(
     logprob / length**penalty; eos-frozen tails contribute nothing) — or,
     with ``return_all``, ``(tokens, scores, all_tokens (B, K, M),
     all_scores (B, K))``. ``beam_size=1`` equals greedy decoding exactly.
-    Ragged prompts ride ``prompt_lens`` exactly as in ``generate``.
+    Ragged prompts ride ``prompt_lens`` exactly as in ``generate``, and
+    ``prefill_chunk`` streams long prompts into the cache in fixed
+    slices (the same memory bound as ``generate``'s knob).
     """
     from tpuflow.infer.generate import (
         check_cache_capacity,
+        normalize_prefill_chunk,
         prompt_lens_to_pad_lens,
     )
 
@@ -208,6 +216,7 @@ def beam_search(
             "penalties would be silently neutralized by the norm clamp)"
         )
     check_cache_capacity(model, T, max_new_tokens)
+    prefill_chunk = normalize_prefill_chunk(prefill_chunk, T)
     pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
     best, best_scores, all_seqs, all_scores = _beam_jit(
         model,
@@ -219,6 +228,7 @@ def beam_search(
         eos_id=eos_id,
         pad_id=pad_id,
         length_penalty=length_penalty,
+        prefill_chunk=prefill_chunk,
     )
     if return_all:
         return best, best_scores, all_seqs, all_scores

@@ -183,6 +183,19 @@ def render_tokens(ids, *, byte_level: bool = False) -> str:
     return " ".join(str(t) for t in ids)
 
 
+def normalize_prefill_chunk(prefill_chunk, T: int):
+    """One validator shared by every inference entry point (generate /
+    beam / speculative) so the chunk contract can't drift: widths < 1
+    fail loudly OUTSIDE jit; no-op widths (>= T) normalize to None so
+    the jit cache holds one program, not duplicates keyed on a width
+    that changes nothing."""
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    if prefill_chunk is not None and prefill_chunk >= T:
+        return None
+    return prefill_chunk
+
+
 def chunked_prefill(model, params, prompt, prefill_chunk, *, pad_lens=None):
     """Fill a fresh KV cache from ``prompt``, one pass (``prefill_chunk``
     None or >= T) or in fixed-size slices — chunking bounds the largest
@@ -326,12 +339,7 @@ def generate(
             "token)"
         )
     check_cache_capacity(model, T, max_new_tokens)
-    if prefill_chunk is not None and prefill_chunk < 1:
-        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
-    if prefill_chunk is not None and prefill_chunk >= T:
-        # Same program as unchunked — normalize so the jit cache doesn't
-        # hold duplicate compilations keyed on a no-op chunk width.
-        prefill_chunk = None
+    prefill_chunk = normalize_prefill_chunk(prefill_chunk, T)
     pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
     if rng is None:
         rng = jax.random.PRNGKey(0)

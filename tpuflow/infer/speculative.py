@@ -35,6 +35,7 @@ from tpuflow.infer.generate import (
     after_first_true,
     check_cache_capacity,
     chunked_prefill,
+    normalize_prefill_chunk,
 )
 
 
@@ -281,12 +282,7 @@ def speculative_generate(
     # The uniform advance can run the cache up to draft_len+1 past the
     # budget before the loop notices — reserve that slack in n_ctx.
     check_cache_capacity(model, T, max_new_tokens + draft_len + 1)
-    if prefill_chunk is not None and prefill_chunk < 1:
-        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
-    if prefill_chunk is not None and prefill_chunk >= T:
-        # Same program as unchunked — normalize so the jit cache doesn't
-        # hold duplicate compilations keyed on a no-op chunk width.
-        prefill_chunk = None
+    prefill_chunk = normalize_prefill_chunk(prefill_chunk, T)
     return _spec_jit(
         model,
         params,
