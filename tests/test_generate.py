@@ -772,3 +772,38 @@ def test_beam_prefill_chunk_matches_oneshot():
             model, params, prompt, beam_size=2, max_new_tokens=4,
             prefill_chunk=0,
         )
+
+
+def test_generation_predictor_prefill_chunk_passthrough():
+    """The engine's prefill_chunk knob reaches both decode paths and the
+    streamed tokens stay exact vs the unchunked predictor."""
+    from tpuflow.infer import GenerationPredictor
+
+    model, params = _model()
+    rows = {"tokens": np.tile(
+        np.arange(8, dtype=np.int32)[None, :], (2, 3)
+    )}  # (2, 24)
+    plain = GenerationPredictor(model, params, max_new_tokens=6,
+                                temperature=0.0)
+    chunked = GenerationPredictor(model, params, max_new_tokens=6,
+                                  temperature=0.0, prefill_chunk=8)
+    np.testing.assert_array_equal(
+        chunked(rows)["generated"], plain(rows)["generated"]
+    )
+    spec_chunked = GenerationPredictor(
+        model, params, max_new_tokens=6, temperature=0.0,
+        speculative=True, prefill_chunk=8,
+    )
+    np.testing.assert_array_equal(
+        spec_chunked(rows)["generated"], plain(rows)["generated"]
+    )
+
+
+def test_generation_predictor_prefill_chunk_validated_at_construction():
+    from tpuflow.infer import GenerationPredictor
+
+    model, params = _model()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        GenerationPredictor(
+            model, params, max_new_tokens=4, prefill_chunk=0
+        )

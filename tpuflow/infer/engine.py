@@ -170,6 +170,7 @@ class GenerationPredictor:
         speculative: bool = False,
         draft_len: int = 8,
         ngram: int = 3,
+        prefill_chunk: int | None = None,
     ):
         self.quant_decision = None
         if quantize is not None:
@@ -242,6 +243,14 @@ class GenerationPredictor:
         self.speculative = speculative
         self.draft_len = draft_len
         self.ngram = ngram
+        # Long-prompt memory bound, passed through to every decode entry
+        # point (generate and the speculative fast path alike). Same
+        # fail-loudly-at-construction contract as the knobs above.
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.prefill_chunk = prefill_chunk
         # Advanced per __call__ (split): batches sample independently; the
         # same construction-time seed still reproduces the whole stream.
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -320,6 +329,7 @@ class GenerationPredictor:
                 ngram=self.ngram,
                 eos_id=self.eos_id,
                 pad_id=self.pad_id,
+                prefill_chunk=self.prefill_chunk,
             )
             return {"generated": np.asarray(out, np.int32)}
         out = generate(
@@ -334,6 +344,7 @@ class GenerationPredictor:
             eos_id=self.eos_id,
             pad_id=self.pad_id,
             rng=sub,
+            prefill_chunk=self.prefill_chunk,
         )
         return {"generated": np.asarray(out, np.int32)}
 
