@@ -807,3 +807,28 @@ def test_generation_predictor_prefill_chunk_validated_at_construction():
         GenerationPredictor(
             model, params, max_new_tokens=4, prefill_chunk=0
         )
+
+
+def test_cache_dtype_capacity_knob():
+    """cache_dtype=bfloat16 (the long-context capacity trade) halves the
+    KV-cache bytes while decode still runs: cache leaves store bf16, the
+    decode path still computes in decode_dtype, and generation works end
+    to end (no exactness claim — the knob's documented trade)."""
+    model, params = _model(dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16)
+    cfg = model.config
+    assert cfg.kv_cache_dtype() == jnp.bfloat16
+    assert cfg.compute_dtype(decode=True) == jnp.float32  # still f32
+    _, vars_out = model.apply(
+        {"params": params}, np.ones((1, 8), np.int32), decode=True,
+        mutable=["cache"], prefill=True,
+    )
+    leaves = jax.tree_util.tree_leaves(vars_out["cache"])
+    kv = [l for l in leaves if l.ndim == 4]
+    assert kv and all(l.dtype == jnp.bfloat16 for l in kv)
+    toks = np.asarray(
+        generate(model, params, np.ones((2, 8), np.int32),
+                 max_new_tokens=6, temperature=0.0)
+    )
+    assert toks.shape == (2, 6)
+    # Default config stores the cache in the decode compute dtype (f32).
+    assert GPT2Config.small_test().kv_cache_dtype() == jnp.float32
