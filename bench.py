@@ -316,6 +316,12 @@ def _hbm_gbps_for(device_kind: str) -> float:
     return _DEFAULT_HBM_GBPS
 
 
+# Per-param HBM bytes of one optimizer step (see _mfu_roofline docstring):
+# bf16 param reads fwd+bwd + bf16 grad write+read + f32 adamw mu/nu
+# read+write + f32 param read+write.
+_ROOFLINE_HBM_BYTES_PER_PARAM = (2 * 2) + (2 * 2) + (2 * 8) + (2 * 4)
+
+
 def _mfu_roofline(n_params: int, batch: int, seq: int, *, peak_flops: float,
                   hbm_gbps: float) -> dict:
     """Analytic per-step floors for the GPT train step: which resource
@@ -324,15 +330,18 @@ def _mfu_roofline(n_params: int, batch: int, seq: int, *, peak_flops: float,
 
     Compute floor: model flops 6*N*tokens at bf16 peak. Memory floor:
     the step's irreducible HBM traffic — bf16 params read in fwd and
-    bwd, bf16 grads written+read, f32 adamw moments (2 per param)
-    read+written, f32 master-ish param update read+write ~= 2*2N + 2*2N
-    + 2*8N + 8N bytes = 28N bytes — at HBM bandwidth. Activation traffic
-    scales with batch*seq and is excluded (it raises the memory floor,
-    so 'compute-bound' verdicts are conservative, 'memory-bound' ones
-    are lower bounds)."""
+    bwd (2*2N), bf16 grads written+read (2*2N), f32 adamw moments
+    (2 per param) read+written (2*8N), f32 param update read+write
+    (2*4N = 8N) = 4N + 4N + 16N + 8N = 32N bytes — at HBM bandwidth.
+    (The constant and this derivation are pinned against each other by
+    tests/test_bench_helpers.py::test_mfu_roofline_memory_floor_constant;
+    an earlier revision shipped 28N against the same 32N derivation.)
+    Activation traffic scales with batch*seq and is excluded (it raises
+    the memory floor, so 'compute-bound' verdicts are conservative,
+    'memory-bound' ones are lower bounds)."""
     flops = 6.0 * n_params * batch * seq
     compute_s = flops / peak_flops
-    memory_s = 28.0 * n_params / (hbm_gbps * 1e9)
+    memory_s = _ROOFLINE_HBM_BYTES_PER_PARAM * n_params / (hbm_gbps * 1e9)
     binding = "compute" if compute_s >= memory_s else "memory"
     attainable = compute_s / max(compute_s, memory_s)
     return {

@@ -197,9 +197,15 @@ def test_flash_tuning_roundtrip(tmp_path, monkeypatch):
     assert attn._flash_min_seq() == 1024
     monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "512")
     assert attn._flash_min_seq() == 512  # env var wins over the file
+    # A malformed env var warns (once) and falls through to the measured
+    # tuning file — the host's crossover beats the shipped constant.
     monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "banana")
-    assert attn._flash_min_seq() == attn._DEFAULT_FLASH_MIN_SEQ
+    attn._warned_malformed_env = False
+    with pytest.warns(UserWarning, match="FLASH_MIN_SEQ"):
+        assert attn._flash_min_seq() == 1024
+    assert attn._flash_min_seq() == 1024  # warned once, still resolves
     attn._flash_tuning_cache = None
+    attn._warned_malformed_env = False
 
 
 def test_flash_tuning_not_persisted_on_suspect_sweep(tmp_path, monkeypatch):
@@ -247,6 +253,20 @@ def test_mfu_roofline_bounds():
     assert bench._hbm_gbps_for("TPU v5 lite") == 819.0
     assert bench._hbm_gbps_for("TPU v6e") == 1640.0
     assert bench._hbm_gbps_for("TPU weird") == bench._DEFAULT_HBM_GBPS
+
+
+def test_mfu_roofline_memory_floor_constant():
+    """Pin the memory-floor arithmetic to its docstring derivation: bf16
+    params read fwd+bwd (2*2N) + bf16 grads write+read (2*2N) + f32 adamw
+    mu/nu read+write (2*8N) + f32 params read+write (2*4N) = 32N bytes.
+    (A prior revision shipped 28N against this same derivation.)"""
+    assert bench._ROOFLINE_HBM_BYTES_PER_PARAM == (
+        2 * 2 + 2 * 2 + 2 * 8 + 2 * 4
+    ) == 32
+    n, hbm = 1_000_000, 819.0
+    r = bench._mfu_roofline(n, 8, 512, peak_flops=197e12, hbm_gbps=hbm)
+    expect_ms = 32.0 * n / (hbm * 1e9) * 1e3
+    assert r["memory_floor_ms"] == round(expect_ms, 3)
 
 
 def test_measure_device_staging_fields():

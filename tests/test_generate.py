@@ -55,6 +55,50 @@ def test_greedy_matches_with_scan_layers():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_generate_with_remat(scan_layers):
+    """Regression (ISSUE 1 satellite): remat'd blocks must keep pad_lens
+    DYNAMIC. nn.remat static_argnums=(2, 3, 4) marked pad_lens (arg 4)
+    static, so EVERY decode-mode call under remat=True crashed with
+    TracerBoolConversionError; the correct set is (2, 3, 5) — train/
+    decode/prefill static, pad_lens traced. Covers both scan layouts,
+    dense and ragged, and pins remat-off/remat-on token equality."""
+    model, params = _model(remat=True, scan_layers=scan_layers)
+    prompt = np.arange(2 * 7, dtype=np.int32).reshape(2, 7) % 512
+    got = np.asarray(
+        generate(model, params, prompt, max_new_tokens=6, temperature=0.0)
+    )
+    want = _greedy_reference(model, params, prompt, 6)
+    np.testing.assert_array_equal(got, want)
+    # Ragged decode: pad_lens is a traced array through the remat'd block.
+    lens = np.array([5, 7], np.int32)
+    padded = np.asarray(prompt)
+    padded = np.concatenate(
+        [np.zeros((2, 0), np.int32), padded], axis=1
+    )
+    padded[0, :2] = 0  # left-pad row 0's first 2 slots
+    padded[0, 2:] = prompt[0, :5]
+    ragged = np.asarray(
+        generate(
+            model, params, padded, prompt_lens=lens,
+            max_new_tokens=6, temperature=0.0,
+        )
+    )
+    # Row 1 is dense in both calls: identical tokens.
+    np.testing.assert_array_equal(ragged[1], got[1])
+    # Remat must be numerically inert: the remat-off model with the SAME
+    # params decodes the same tokens.
+    import dataclasses
+
+    cfg_off = dataclasses.replace(model.config, remat=False)
+    off = np.asarray(
+        generate(
+            GPT2(cfg_off), params, prompt, max_new_tokens=6, temperature=0.0
+        )
+    )
+    np.testing.assert_array_equal(got, off)
+
+
 def test_sampling_reproducible_and_in_topk():
     model, params = _model()
     prompt = np.ones((2, 4), np.int32)

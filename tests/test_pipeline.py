@@ -73,8 +73,9 @@ def test_pipeline_block_params_sharded_over_stage(setup):
         placed = jax.device_put(params, gpt2_pipeline_shardings(mesh, params))
     leaf = jax.tree_util.tree_leaves(placed["h"]["block"])[0]
     # 4 stages x 1 layer each: every stage holds a distinct layer slice.
+    # (slice objects are unhashable before py3.12 — set-ify the bounds.)
     owned = {
-        s.index[0] for s in leaf.addressable_shards
+        (s.index[0].start, s.index[0].stop) for s in leaf.addressable_shards
     }
     assert len(owned) == 4
     # Non-block params replicated: every shard spans the full array.

@@ -260,3 +260,27 @@ def test_prefill_chunk_validation_and_normalization():
         )
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_draft_ladder_early_history_blind_spot():
+    """Regression (ISSUE 1 satellite): a g-gram (g < G) match ending in
+    the first G-g history positions lives at a NEGATIVE window origin —
+    the old pos = arange(W) ladder never visited it, so short-gram drafts
+    at the start of the prompt silently degraded to repeat-last-token.
+    Geometry: G=2, K=2, history [3,8,1,4,3] (n_hist=5). The trailing
+    2-gram [4,3] never recurs; the trailing 1-gram [3] occurs ONLY at
+    h[0], a match ending at p=1 (origin -1). The fixed ladder drafts the
+    tokens after it, h[1:3] = [8,1]."""
+    from tpuflow.infer.speculative import _draft_ladder
+
+    hist = jnp.asarray([[3, 8, 1, 4, 3, 0, 0, 0, 0, 0]], jnp.int32)
+    d = np.asarray(_draft_ladder(hist, jnp.int32(5), K=2, G=2))
+    np.testing.assert_array_equal(d, [[8, 1]])
+    # Control: a full-G match still outranks the laddered short gram.
+    hist2 = jnp.asarray([[4, 3, 9, 2, 4, 3, 0, 0, 0, 0]], jnp.int32)
+    d2 = np.asarray(_draft_ladder(hist2, jnp.int32(6), K=2, G=2))
+    np.testing.assert_array_equal(d2, [[9, 2]])
+    # Ladder exhausted (token genuinely never seen): repeat-last fallback.
+    hist3 = jnp.asarray([[1, 2, 3, 4, 5, 0, 0, 0, 0, 0]], jnp.int32)
+    d3 = np.asarray(_draft_ladder(hist3, jnp.int32(5), K=2, G=2))
+    np.testing.assert_array_equal(d3, [[5, 5]])

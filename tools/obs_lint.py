@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Telemetry-name lint: every literal span/counter/gauge/histogram/event
+name emitted anywhere under ``tpuflow/`` must be registered — with the
+same kind — in the canonical catalog (``tpuflow.obs.catalog.CATALOG``).
+
+This is the drift guard between emitters and consumers (the timeline
+card, ``obs.summarize``, downstream flows): rename a metric at the
+emitter without updating the catalog and this fails; record a span under
+a name registered as a counter and this fails. Unemitted catalog entries
+are reported as warnings (a name may be staged ahead of its emitter) but
+do not fail the lint.
+
+Run standalone (``python tools/obs_lint.py``, exit 1 on failure) or via
+its pytest twin (tests/test_obs.py::test_obs_catalog_lint).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# obs.span("name", ...) / obs.counter("name") / ... (the module-level API)
+_API_RE = re.compile(
+    r"\bobs\.(span|counter|gauge|histogram|event)\(\s*[\"']([a-z0-9_.]+)[\"']"
+)
+# obs.timed_iter(loader, "name") — records histogram observations
+_TIMED_ITER_RE = re.compile(
+    r"\bobs\.timed_iter\([^)]*?,\s*[\"']([a-z0-9_.]+)[\"']", re.S
+)
+# rec.record("span", "name", ...) — the low-level recorder API (used where
+# the duration is measured manually, e.g. the ckpt save commit thread)
+_RECORD_RE = re.compile(
+    r"\.record\(\s*[\"'](span|counter|gauge|histogram|event)[\"']\s*,"
+    r"\s*[\"']([a-z0-9_.]+)[\"']",
+    re.S,
+)
+# self._rec.record(kind, self._name, ...) etc. carry no literal name —
+# those are the recorder's own internals, exempted by path below.
+_EXEMPT_FILES = {os.path.join("tpuflow", "obs", "recorder.py")}
+
+
+def emitted_names(root: str = REPO) -> list[tuple[str, str, str]]:
+    """(relpath, kind, name) for every literal emitter call in tpuflow/."""
+    out = []
+    pkg = os.path.join(root, "tpuflow")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in _EXEMPT_FILES:
+                continue
+            with open(path) as f:
+                src = f.read()
+            for m in _API_RE.finditer(src):
+                out.append((rel, m.group(1), m.group(2)))
+            for m in _TIMED_ITER_RE.finditer(src):
+                out.append((rel, "histogram", m.group(1)))
+            for m in _RECORD_RE.finditer(src):
+                out.append((rel, m.group(1), m.group(2)))
+    return out
+
+
+def lint(root: str = REPO) -> tuple[list[str], list[str]]:
+    """Returns (errors, warnings)."""
+    sys.path.insert(0, root)
+    from tpuflow.obs.catalog import CATALOG
+
+    errors, used = [], set()
+    for rel, kind, name in emitted_names(root):
+        used.add(name)
+        if name not in CATALOG:
+            errors.append(
+                f"{rel}: emits {kind} {name!r} not registered in "
+                "tpuflow.obs.catalog.CATALOG"
+            )
+        elif CATALOG[name][0] != kind:
+            errors.append(
+                f"{rel}: emits {name!r} as {kind} but the catalog "
+                f"registers it as {CATALOG[name][0]}"
+            )
+    warnings = [
+        f"catalog name {name!r} has no literal emitter in tpuflow/"
+        for name in sorted(set(CATALOG) - used)
+    ]
+    return errors, warnings
+
+
+def main() -> int:
+    errors, warnings = lint()
+    for w in warnings:
+        print(f"[obs-lint] warning: {w}")
+    for e in errors:
+        print(f"[obs-lint] ERROR: {e}")
+    if errors:
+        return 1
+    print(f"[obs-lint] ok ({len(emitted_names())} emitter calls checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

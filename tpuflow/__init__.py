@@ -19,6 +19,10 @@ torch DDP/NCCL + Ray Data), re-designed TPU-first:
 - ``tpuflow.ops``   — Pallas TPU kernels (flash attention, ...).
 - ``tpuflow.parallel`` — sharding rules: DP / FSDP / tensor / ring-attention
   sequence parallelism over a named ``jax.sharding.Mesh``.
+- ``tpuflow.obs``   — unified telemetry: spans / counters / gauges /
+  histograms as JSONL under the run dir, gang-merged into one timeline,
+  rendered as the run's timeline card (replacing Ray Train's report()
+  stream + Metaflow cards as the observability surface).
 
 See ``SURVEY.md`` at the repo root for the capability contract and the mapping
 from every reference component to its tpuflow equivalent.

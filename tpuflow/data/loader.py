@@ -168,9 +168,27 @@ def prefetch_to_device(loader, mesh, *, depth: int = 2, keys=None):
 
     thread = threading.Thread(target=_worker, daemon=True)
     thread.start()
+    # Telemetry (tpuflow.obs): batch-wait vs prefetch-hit timing — the
+    # "was the input pipeline ever the bottleneck" evidence. Resolved once
+    # outside the loop; disabled runs take the bare q.get path.
+    from tpuflow import obs
+
+    obs_on = obs.enabled()
     try:
         while True:
-            item = q.get()
+            if obs_on:
+                import time
+
+                hit = not q.empty()
+                t0 = time.monotonic()
+                item = q.get()
+                obs.histogram("data.batch_wait_s", time.monotonic() - t0)
+                if hit:
+                    obs.counter("data.prefetch_hit")
+                else:
+                    obs.counter("data.prefetch_miss")
+            else:
+                item = q.get()
             if item is done:
                 break
             if isinstance(item, BaseException):

@@ -141,8 +141,24 @@ def force_cpu_platform(n_devices: int = 8, *, exact: bool = False) -> None:
     """
     try:
         jax.config.update("jax_platforms", "cpu")
-        if exact or jax.config.jax_num_cpu_devices < n_devices:
-            jax.config.update("jax_num_cpu_devices", n_devices)
+        if hasattr(jax.config, "jax_num_cpu_devices"):
+            if exact or jax.config.jax_num_cpu_devices < n_devices:
+                jax.config.update("jax_num_cpu_devices", n_devices)
+        else:
+            # Older jax (< 0.5) has no jax_num_cpu_devices config: the
+            # virtual device count comes from XLA_FLAGS, honored only if
+            # set before backend init (same timing contract as the config).
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags or exact:
+                import re
+
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "", flags
+                ).strip()
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} "
+                    f"--xla_force_host_platform_device_count={n_devices}"
+                ).strip()
     except RuntimeError:
         # Backends already initialized: leave the parent's platform AND the
         # env untouched so subprocesses don't silently diverge from it.

@@ -12,6 +12,7 @@ from tpuflow.flow.cards import (
     Markdown,
     Table,
     metrics_table,
+    timeline_card,
     training_curve_card,
 )
 from tpuflow.flow.client import (
@@ -48,6 +49,7 @@ __all__ = [
     "Task",
     "card",
     "metrics_table",
+    "timeline_card",
     "training_curve_card",
     "current",
     "device_profile",

@@ -127,6 +127,152 @@ class CardBuffer:
         )
 
 
+class _GanttBar:
+    """One timeline bar: offset + width as percentages of the run span.
+    Rendered as nested divs so the bar scales with the column width."""
+
+    def __init__(self, left_pct: float, width_pct: float, color: str):
+        self.left_pct = left_pct
+        self.width_pct = width_pct
+        self.color = color
+
+    def _render(self) -> str:
+        return (
+            "<div style='position:relative;width:240px;height:12px;"
+            "background:#f1f0ec'>"
+            f"<div style='position:absolute;left:{self.left_pct:.2f}%;"
+            f"width:{max(self.width_pct, 0.5):.2f}%;height:12px;"
+            f"background:{self.color}'></div></div>"
+        )
+
+
+# Span-name → bar color (categorical slots of the validated palette; one
+# hue per subsystem so the Gantt reads by layer).
+_TIMELINE_COLORS = {
+    "flow.": "#8a8782",
+    "train.": "#2a78d6",
+    "ckpt.": "#eb6834",
+    "data.": "#2e9960",
+    "infer.": "#9268d4",
+    "device.": "#c2b33a",
+}
+
+
+def _span_color(name: str) -> str:
+    for prefix, color in _TIMELINE_COLORS.items():
+        if name.startswith(prefix):
+            return color
+    return "#8a8782"
+
+
+def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> None:
+    """Run-level observability card (the tentpole's L1 surface): headline
+    metrics + a per-span Gantt-style table over the merged event stream +
+    subsystem aggregates. Rendered by FlowRunner into ``timeline.html`` at
+    the run root when the run finishes (success or failure). Appends into
+    ``buf``; cards must never fail the run, so callers wrap in try/except.
+    """
+    from tpuflow import obs
+
+    if not events:
+        return
+    if summary is None:
+        summary = obs.summarize(events)
+    buf.append(Markdown("# Run timeline"))
+
+    headline = summary.get("headline", {})
+    if headline:
+        def fmt(k, v):
+            if "bytes" in k:
+                return f"{v / 1e6:.1f} MB"
+            if "gbps" in k:
+                return f"{v:.2f} GB/s"
+            if k.endswith("_s"):
+                return f"{v:.4f} s"
+            if "rate" in k or "mfu" in k:
+                return f"{v:.3f}"
+            return f"{v:,.1f}" if isinstance(v, float) else str(v)
+
+        buf.append(Markdown("## Headline"))
+        buf.append(
+            Table(
+                [[k, fmt(k, v)] for k, v in sorted(headline.items())],
+                headers=["metric", "value"],
+            )
+        )
+
+    spans = [
+        e for e in events if e.get("kind") == "span" and e.get("dur_s", 0) > 0
+    ]
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e["dur_s"] for e in spans)
+        total = max(t1 - t0, 1e-9)
+        buf.append(Markdown("## Timeline"))
+        rows = []
+        # The run span covers everything — show the inner structure only.
+        for e in sorted(spans, key=lambda e: e["ts"]):
+            if e["name"] == "flow.run":
+                continue
+            label = e["name"]
+            if e.get("step"):
+                label += f" [{e['step']}]"
+            detail = []
+            if e.get("bytes"):
+                detail.append(f"{float(e['bytes']) / 1e6:.1f} MB")
+            if e.get("gbps"):
+                detail.append(f"{float(e['gbps']):.2f} GB/s")
+            if e.get("tokens_per_s"):
+                detail.append(f"{float(e['tokens_per_s']):.0f} tok/s")
+            rows.append(
+                [
+                    label,
+                    f"p{e.get('proc', 0)}",
+                    f"+{e['ts'] - t0:.3f}s",
+                    f"{e['dur_s']:.3f}s",
+                    " ".join(detail),
+                    _GanttBar(
+                        100.0 * (e["ts"] - t0) / total,
+                        100.0 * e["dur_s"] / total,
+                        _span_color(e["name"]),
+                    ),
+                ]
+            )
+        buf.append(
+            Table(
+                rows,
+                headers=["span", "proc", "start", "dur", "detail", ""],
+            )
+        )
+
+    agg = summary.get("spans", {})
+    if agg:
+        buf.append(Markdown("## Span aggregates"))
+        buf.append(
+            Table(
+                [
+                    [n, s["count"], f"{s['total_s']:.3f}s",
+                     f"{s['mean_s']:.4f}s", f"{s['max_s']:.4f}s"]
+                    for n, s in sorted(agg.items())
+                ],
+                headers=["span", "count", "total", "mean", "max"],
+            )
+        )
+    counters = summary.get("counters", {})
+    hists = summary.get("histograms", {})
+    if counters or hists:
+        buf.append(Markdown("## Counters and histograms"))
+        rows = [[n, "counter", f"{v:,.0f}", "", ""]
+                for n, v in sorted(counters.items())]
+        rows += [
+            [n, "histogram", h["count"], f"{h['p50']:.5f}", f"{h['max']:.5f}"]
+            for n, h in sorted(hists.items())
+        ]
+        buf.append(
+            Table(rows, headers=["name", "kind", "count/total", "p50", "max"])
+        )
+
+
 def training_curve_card(buf, records: Sequence[dict]) -> None:
     """Training-curve card (D14): per-epoch loss chart + metrics table +
     final-perplexity headline — the train-side sibling of the eval flows'

@@ -177,6 +177,26 @@ class Run:
                 )
         raise KeyError(f"step {step!r} not found in {self.pathspec}")
 
+    # ----------------------------------------------------------- telemetry
+    def events(self) -> list[dict]:
+        """The run's merged telemetry stream (tpuflow.obs events): the
+        committed ``events.jsonl`` when the runner finished the merge, else
+        merged on the fly from the gang-worker fragments (a still-running
+        or crashed run stays readable). Empty list when the run recorded
+        no telemetry (TPUFLOW_OBS=0)."""
+        from tpuflow import obs
+
+        return obs.load_run_events(store.run_dir(self.flow, self.run_id))
+
+    def telemetry(self) -> dict:
+        """Aggregated telemetry (``obs.summarize`` of ``events()``): span
+        aggregates, counters, histograms, and the headline metrics the
+        timeline card shows — how downstream flows (eval) read the
+        training run's step-time/tokens-per-s/checkpoint-GB/s evidence."""
+        from tpuflow import obs
+
+        return obs.summarize(self.events())
+
 
 class Flow:
     """Handle to a flow's run history: ``Flow("TpuGptTrain")`` — the

@@ -30,7 +30,11 @@ def _bootstrap_jax() -> None:
 
         local = int(os.environ.get("TPUFLOW_GANG_LOCAL_DEVICES", "1"))
         force_cpu_platform(local, exact=True)
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if int(os.environ.get("TPUFLOW_NUM_PROCESSES", "1")) > 1:
+            # Cross-process CPU collectives only exist for real gangs —
+            # a 1-process member must not ask for gloo (jaxlib refuses to
+            # build gloo collectives without a distributed client).
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
     # Gang members share the persistent compile cache: after one worker
     # (or a previous attempt) compiled the step, the rest load it.
     from tpuflow.dist import maybe_enable_compile_cache
@@ -109,8 +113,20 @@ def main(argv: list[str]) -> None:
     )
     os.makedirs(current.tpu_storage_path, exist_ok=True)
 
+    # The recorder self-configures from TPUFLOW_OBS_DIR/TPUFLOW_OBS_PROC
+    # (set by FlowRunner._exec_gang), so each member writes its own
+    # events.p<proc>.jsonl beside the head's — merged at end of run.
+    from tpuflow import obs
+
     fn = flow_cls.steps()[step_name]
-    fn(flow)
+    with obs.span(
+        "flow.gang_member",
+        step=step_name,
+        gang_index=jax.process_index(),
+        gang_size=jax.process_count(),
+    ):
+        fn(flow)
+    obs.flush()
 
     # Every member persists its own artifacts; the head's land at the gang
     # step's task_id and are what the flow continues with (non-head members

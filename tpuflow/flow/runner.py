@@ -25,6 +25,7 @@ import time
 import traceback
 from typing import Any
 
+from tpuflow import obs
 from tpuflow.flow import store
 from tpuflow.flow.cards import CardBuffer
 from tpuflow.flow.client import Run
@@ -124,6 +125,27 @@ class _DeviceProfiler:
                 )
         except OSError:
             pass
+        # Absorb the sampler into the unified telemetry stream: the memory
+        # gauges land beside the step spans so one timeline answers both
+        # "where did time go" and "what did HBM do meanwhile".
+        if obs.enabled():
+            peaks: dict[int, int] = {}
+            for entry in self.samples:
+                for dev in entry["devices"]:
+                    used = dev.get("bytes_in_use")
+                    if used is not None:
+                        obs.gauge(
+                            "device.bytes_in_use", used,
+                            ts=entry["ts"], device=dev["id"],
+                        )
+                    peak = dev.get("peak_bytes_in_use")
+                    if peak is not None:
+                        peaks[dev["id"]] = max(peaks.get(dev["id"], 0), peak)
+            for dev_id, peak in sorted(peaks.items()):
+                obs.gauge(
+                    "device.peak_bytes_in_use", peak,
+                    device=dev_id, platform=platform,
+                )
 
 
 class FlowRunner:
@@ -189,6 +211,16 @@ class FlowRunner:
         task_counter = 0
         pathspec = f"{self.flow_name}/{run_id}"
         print(f"[tpuflow] run {pathspec} starting")
+        # Telemetry root for this run: the head process records here, gang
+        # members inherit it via TPUFLOW_OBS_DIR (one events.p<proc>.jsonl
+        # each), and the end-of-run merge produces <rdir>/events.jsonl.
+        # TPUFLOW_OBS=0 disables recording entirely (README Observability).
+        self._obs_dir = None
+        if os.environ.get("TPUFLOW_OBS", "1") not in ("0", "false"):
+            self._obs_dir = os.path.join(rdir, "obs")
+            obs.configure(self._obs_dir, proc=0)
+        run_span = obs.span("flow.run", flow=self.flow_name, run=str(run_id))
+        run_span.__enter__()
         try:
             while True:
                 fn = steps[step_name]
@@ -207,23 +239,33 @@ class FlowRunner:
                 attempt = 0
                 while True:
                     try:
-                        if num_parallel > 1:
-                            gang_inputs = self._exec_gang(
-                                flow, step_name, run_id, task_id, num_parallel,
-                                timeout=(gang or {}).get("timeout", 300.0),
-                            )
-                        else:
-                            self._exec_local(
-                                flow, fn, step_name, run_id, task_id
-                            )
-                            # A following join sees this task as a 1-member
-                            # gang (num_parallel=1 degenerate case).
-                            gang_inputs = [_GangInput(dict(flow._artifacts))]
+                        with obs.span(
+                            "flow.step", step=step_name, task=task_id,
+                            attempt=attempt, num_parallel=num_parallel,
+                        ):
+                            if num_parallel > 1:
+                                gang_inputs = self._exec_gang(
+                                    flow, step_name, run_id, task_id,
+                                    num_parallel,
+                                    timeout=(gang or {}).get("timeout", 300.0),
+                                )
+                            else:
+                                self._exec_local(
+                                    flow, fn, step_name, run_id, task_id
+                                )
+                                # A following join sees this task as a
+                                # 1-member gang (num_parallel=1 degenerate
+                                # case).
+                                gang_inputs = [
+                                    _GangInput(dict(flow._artifacts))
+                                ]
                         break
                     except Exception:
                         attempt += 1
                         if attempt > retries:
                             raise
+                        obs.counter("flow.retry", step=step_name,
+                                    attempt=attempt)
                         print(
                             f"[tpuflow] step {step_name} failed "
                             f"(attempt {attempt}/{retries}), retrying:\n"
@@ -252,17 +294,45 @@ class FlowRunner:
             meta["status"] = "failed"
             meta["error"] = repr(e)
             meta["finished"] = time.time()
+            run_span.set(status="failed")
+            run_span.__exit__(None, None, None)
+            meta["telemetry"] = self._finalize_obs(rdir, pathspec)
             store.write_run_meta(self.flow_name, run_id, meta)
             print(f"[tpuflow] run {pathspec} FAILED: {e!r}")
             raise
         meta["status"] = "success"
         meta["finished"] = time.time()
+        run_span.set(status="success")
+        run_span.__exit__(None, None, None)
+        meta["telemetry"] = self._finalize_obs(rdir, pathspec)
         store.write_run_meta(self.flow_name, run_id, meta)
         store.append_event(
             {"flow": self.flow_name, "run": pathspec, "status": "success"}
         )
         print(f"[tpuflow] run {pathspec} succeeded")
         return pathspec
+
+    def _finalize_obs(self, rdir: str, pathspec: str) -> dict:
+        """Close the run's recorder, merge gang-worker event files into
+        ``<rdir>/events.jsonl``, render the timeline card, and return the
+        headline summary (stored in run.json as the run-level
+        observability card's data). Telemetry must never fail the run."""
+        try:
+            obs.configure(None)  # flush + close the head recorder
+            events = obs.merge_run_events(rdir)
+            if not events:
+                return {}
+            summary = obs.summarize(events)
+            from tpuflow.flow.cards import timeline_card
+
+            buf = CardBuffer()
+            timeline_card(buf, events, summary=summary)
+            with open(os.path.join(rdir, "timeline.html"), "w") as f:
+                f.write(buf.render_html(f"{pathspec} timeline"))
+            return summary.get("headline", {})
+        except Exception as e:
+            print(f"[tpuflow] telemetry finalize failed (ignored): {e!r}")
+            return {}
 
     # ----------------------------------------------------- single-task exec
     def _exec_local(
@@ -316,12 +386,13 @@ class FlowRunner:
             else:
                 self._call_step(flow, fn, join_inputs)
             if current.card is not None:
-                with open(os.path.join(tdir, "card.html"), "w") as f:
-                    f.write(
-                        current.card.render_html(
-                            f"{self.flow_name}/{run_id}/{step_name}"
+                with obs.span("flow.card_render", step=step_name):
+                    with open(os.path.join(tdir, "card.html"), "w") as f:
+                        f.write(
+                            current.card.render_html(
+                                f"{self.flow_name}/{run_id}/{step_name}"
+                            )
                         )
-                    )
             store.save_artifacts(
                 self.flow_name, run_id, step_name, task_id, flow._artifacts
             )
@@ -377,6 +448,11 @@ class FlowRunner:
                 TPUFLOW_GANG_TIMEOUT=str(timeout),
                 TPUFLOW_FORCE_CPU=env_force_cpu(),
             )
+            if getattr(self, "_obs_dir", None):
+                # Each member records its own events.p<i>.jsonl in the
+                # run's obs dir; the end-of-run merge unions them.
+                env["TPUFLOW_OBS_DIR"] = self._obs_dir
+                env["TPUFLOW_OBS_PROC"] = str(i)
             cmd = [
                 sys.executable,
                 "-m",
@@ -400,14 +476,18 @@ class FlowRunner:
             )
         deadline = time.time() + timeout + 600
         failed = False
-        for p, log in procs:
-            try:
-                rc = p.wait(timeout=max(deadline - time.time(), 1))
-            except subprocess.TimeoutExpired:
-                p.kill()
-                rc = -9
-            log.close()
-            failed = failed or rc != 0
+        with obs.span(
+            "flow.gang", step=step_name, num_parallel=num_parallel
+        ) as gang_span:
+            for p, log in procs:
+                try:
+                    rc = p.wait(timeout=max(deadline - time.time(), 1))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rc = -9
+                log.close()
+                failed = failed or rc != 0
+            gang_span.set(failed=failed)
         if failed:
             logs = []
             for i in range(num_parallel):

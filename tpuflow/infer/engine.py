@@ -22,7 +22,7 @@ from typing import Callable, Sequence
 import jax
 import numpy as np
 
-from tpuflow import dist
+from tpuflow import dist, obs
 from tpuflow.ckpt import Checkpoint, restore_from_handle
 
 
@@ -129,9 +129,12 @@ class BatchPredictor:
         # my_ray_module.py:276-278 squeezes (1,B,1,28,28)).
         while x.ndim > 0 and x.shape[0] == 1 and x.ndim > 3:
             x = x[0]
-        placed = dist.shard_batch({"x": x}, self.mesh)
-        logits = self._forward(self.params, self.batch_stats, placed["x"])
-        logits = np.asarray(logits, dtype=np.float32)
+        with obs.span("infer.predict", rows=int(x.shape[0])):
+            placed = dist.shard_batch({"x": x}, self.mesh)
+            logits = self._forward(self.params, self.batch_stats, placed["x"])
+            # np.asarray materializes the result, so the span closes on an
+            # honest wall time.
+            logits = np.asarray(logits, dtype=np.float32)
         return {
             "logits": logits,
             "predicted_values": logits.argmax(axis=-1),
@@ -320,33 +323,56 @@ class GenerationPredictor:
             # produces the identical token stream.
             from tpuflow.infer.speculative import speculative_generate
 
-            out = speculative_generate(
+            obs_on = obs.enabled()
+            with obs.span(
+                "infer.generate_batch", rows=int(prompt.shape[0]),
+                new_tokens=self.max_new_tokens, speculative=True,
+            ):
+                out = speculative_generate(
+                    self.model,
+                    self.params,
+                    prompt,
+                    max_new_tokens=self.max_new_tokens,
+                    draft_len=self.draft_len,
+                    ngram=self.ngram,
+                    eos_id=self.eos_id,
+                    pad_id=self.pad_id,
+                    prefill_chunk=self.prefill_chunk,
+                    # Telemetry wants the realized acceptance rate; the
+                    # extra jit variant (with_stats is a static arg) is
+                    # only ever compiled when obs is on.
+                    return_stats=obs_on,
+                )
+                if obs_on:
+                    out, stats = out
+                    n_fwd = int(stats["n_forwards"])
+                    n_com = int(stats["n_committed"])
+                    obs.counter("infer.spec.forwards", n_fwd)
+                    obs.counter("infer.spec.committed", n_com)
+                    if n_fwd:
+                        obs.gauge("infer.spec.acceptance", n_com / n_fwd)
+                out = np.asarray(out, np.int32)
+            return {"generated": out}
+        with obs.span(
+            "infer.generate_batch", rows=int(prompt.shape[0]),
+            new_tokens=self.max_new_tokens, speculative=False,
+        ):
+            out = generate(
                 self.model,
                 self.params,
                 prompt,
+                prompt_lens=lens,
                 max_new_tokens=self.max_new_tokens,
-                draft_len=self.draft_len,
-                ngram=self.ngram,
+                temperature=self.temperature,
+                top_k=self.top_k,
+                top_p=self.top_p,
                 eos_id=self.eos_id,
                 pad_id=self.pad_id,
+                rng=sub,
                 prefill_chunk=self.prefill_chunk,
             )
-            return {"generated": np.asarray(out, np.int32)}
-        out = generate(
-            self.model,
-            self.params,
-            prompt,
-            prompt_lens=lens,
-            max_new_tokens=self.max_new_tokens,
-            temperature=self.temperature,
-            top_k=self.top_k,
-            top_p=self.top_p,
-            eos_id=self.eos_id,
-            pad_id=self.pad_id,
-            rng=sub,
-            prefill_chunk=self.prefill_chunk,
-        )
-        return {"generated": np.asarray(out, np.int32)}
+            out = np.asarray(out, np.int32)
+        return {"generated": out}
 
 
 def _collate(vals: list) -> object:

@@ -29,6 +29,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from tpuflow import obs
+
 
 def _sample(
     logits,
@@ -343,7 +345,12 @@ def generate(
     pad_lens = prompt_lens_to_pad_lens(prompt_lens, B, T)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    return _generate_jit(
+    rec = obs.recorder()
+    if rec is not None:
+        import time
+
+        t0, ts0 = time.monotonic(), time.time()
+    out = _generate_jit(
         model,
         params,
         prompt,
@@ -359,3 +366,18 @@ def generate(
         pad_id=pad_id,
         prefill_chunk=prefill_chunk,
     )
+    if rec is not None:
+        # Fenced decode latency + tokens/s (telemetry-on only: the fence
+        # trades the async-dispatch overlap for an honest wall time; with
+        # obs off the call returns the in-flight arrays untouched).
+        import time
+
+        out = jax.block_until_ready(out)
+        dur = time.monotonic() - t0
+        n = B * max_new_tokens
+        rec.record(
+            "span", "infer.generate", ts=ts0, dur_s=dur, batch=B,
+            prompt_len=T, new_tokens=max_new_tokens,
+            tokens_per_s=n / dur if dur > 0 else 0.0,
+        )
+    return out

@@ -54,6 +54,65 @@ def _reset_index(cache, value):
     return jax.tree_util.tree_unflatten(flat[1], out)
 
 
+def _draft_ladder(hist, n_hist, *, K: int, G: int):
+    """Per-row prompt lookup with an n-gram LADDER: the K tokens that
+    followed the most recent earlier occurrence of the trailing G-gram;
+    when that gram never recurs, retry with shorter and shorter grams
+    down to 1 (natural text rarely repeats long grams but constantly
+    repeats short ones — the ladder keeps acceptance above the
+    repeat-last-token floor). Wrong drafts only cost speed, never
+    correctness: the verify forward arbitrates. ``hist``: (B, W) history
+    buffers; ``n_hist`` = tokens valid in hist (prompt + committed +
+    cur)."""
+    W = hist.shape[1]
+    # Window origins extend to -(G-1): a g-gram (g < G) only needs the
+    # LAST g columns of its window in range, so matches ending in the
+    # first G-g history positions live at negative origins. The old
+    # pos = arange(W) never visited them — short-gram matches at the
+    # start of the prompt were invisible to the ladder (the
+    # first-positions blind spot).
+    pos = jnp.arange(-(G - 1), W)
+
+    def row(h):
+        # One fused scan over the history computes, for EVERY gram
+        # length g <= G at once, whether each window position matches
+        # the trailing g-gram (suffix-aligned comparisons share the
+        # same equality matrix).
+        tail = jax.vmap(
+            lambda o: jax.lax.dynamic_index_in_dim(h, o, keepdims=False)
+        )(n_hist - G + jnp.arange(G))
+        idx = pos[:, None] + jnp.arange(G)[None, :]
+        # Negative idx clips to 0 — garbage columns, but only in the
+        # first G-g slots a g-gram never reads (see the per-g origin
+        # bound below).
+        windows = h[jnp.clip(idx, 0, W - 1)]
+        eq = windows == tail[None, :]  # (W+G-1, G)
+        # suffix_ok[i, g-1] = window at origin pos[i] matches the tail
+        # on its LAST g entries (i.e. a g-gram match ending at pos[i]+G).
+        suffix_ok = jnp.cumprod(eq[:, ::-1], axis=1).astype(bool)
+        in_range = (pos + G < n_hist) & (pos + G + K <= W)
+        start = jnp.int32(0)
+        found_any = jnp.bool_(False)
+        # Ladder from the longest gram down: take the first length with
+        # any match (static unroll over G <= ngram-1 lengths). Sentinel
+        # -G-1 sits below every legal origin (>= -(G-1)), so "no match"
+        # stays distinguishable now that origins go negative.
+        for g in range(G, 0, -1):
+            ok_g = suffix_ok[:, g - 1] & in_range & (pos + G - g >= 0)
+            m_g = jnp.where(ok_g, pos, -G - 1).max()
+            found_g = m_g > -G
+            take = found_g & ~found_any
+            start = jnp.where(take, m_g + G, start)
+            found_any = found_any | found_g
+        cand = jax.lax.dynamic_slice(h, (start,), (K,))
+        # Ladder exhausted (token never seen before): repeat the last
+        # token (often right for byte-level runs).
+        last = jax.lax.dynamic_index_in_dim(h, n_hist - 1, keepdims=False)
+        return jnp.where(found_any, cand, jnp.full((K,), last))
+
+    return jax.vmap(row)(hist)
+
+
 @functools.partial(
     jax.jit,
     static_argnums=(0,),
@@ -96,51 +155,7 @@ def _spec_jit(
     done0 = (cur == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
 
     def draft(hist, n_hist):
-        """Per-row prompt lookup with an n-gram LADDER: the K tokens that
-        followed the most recent earlier occurrence of the trailing
-        (ngram-1)-gram; when that gram never recurs, retry with shorter
-        and shorter grams down to 1 (natural text rarely repeats long
-        grams but constantly repeats short ones — the ladder keeps
-        acceptance above the repeat-last-token floor). Wrong drafts only
-        cost speed, never correctness: the verify forward arbitrates.
-        ``n_hist`` = tokens valid in hist (prompt + committed + cur)."""
-        pos = jnp.arange(W)
-
-        def row(h):
-            # One fused scan over the history computes, for EVERY gram
-            # length g <= G at once, whether each window position matches
-            # the trailing g-gram (suffix-aligned comparisons share the
-            # same equality matrix).
-            tail = jax.vmap(
-                lambda o: jax.lax.dynamic_index_in_dim(h, o, keepdims=False)
-            )(n_hist - G + jnp.arange(G))
-            idx = pos[:, None] + jnp.arange(G)[None, :]
-            windows = h[jnp.clip(idx, 0, W - 1)]
-            eq = windows == tail[None, :]  # (W, G)
-            # suffix_ok[i, g-1] = positions i..i+G-1 match the tail on its
-            # LAST g entries (i.e. a g-gram match ending at i+G).
-            suffix_ok = jnp.cumprod(eq[:, ::-1], axis=1).astype(bool)
-            in_range = (pos + G < n_hist) & (pos + G + K <= W)
-            start = jnp.int32(0)
-            found_any = jnp.bool_(False)
-            # Ladder from the longest gram down: take the first length
-            # with any match (static unroll over G <= ngram-1 lengths).
-            for g in range(G, 0, -1):
-                ok_g = suffix_ok[:, g - 1] & in_range
-                m_g = jnp.where(ok_g, pos, -1).max()
-                found_g = m_g >= 0
-                take = found_g & ~found_any
-                start = jnp.where(take, m_g + G, start)
-                found_any = found_any | found_g
-            cand = jax.lax.dynamic_slice(h, (start,), (K,))
-            # Ladder exhausted (token never seen before): repeat the last
-            # token (often right for byte-level runs).
-            last = jax.lax.dynamic_index_in_dim(
-                h, n_hist - 1, keepdims=False
-            )
-            return jnp.where(found_any, cand, jnp.full((K,), last))
-
-        return jax.vmap(row)(hist)
+        return _draft_ladder(hist, n_hist, K=K, G=G)
 
     def cond(state):
         n_out, _, _, _, done, _ = state

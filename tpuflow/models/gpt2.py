@@ -432,7 +432,13 @@ class GPT2(nn.Module):
                         f"unknown remat_policy {cfg.remat_policy!r}; valid "
                         "names are the jax.checkpoint_policies attributes"
                     ) from None
-            return nn.remat(mod, static_argnums=(2, 3, 4), policy=policy)
+            # Args (with the module at 0): x=1, train=2, decode=3,
+            # pad_lens=4, prefill=5. train/decode/prefill are Python bools
+            # that steer tracing — static. pad_lens is a DATA array (it is
+            # a tracer during ragged decode): marking it static, as
+            # (2, 3, 4) once did, crashed every remat=True decode-mode
+            # call with TracerBoolConversionError.
+            return nn.remat(mod, static_argnums=(2, 3, 5), policy=policy)
 
         if cfg.scan_layers:
             body = remat_wrap(_ScanBlock) if cfg.remat else _ScanBlock

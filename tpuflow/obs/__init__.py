@@ -1,0 +1,67 @@
+"""tpuflow.obs — dependency-free unified telemetry.
+
+The runtime's evidence trail (ISSUE 1; ROADMAP "as fast as the hardware
+allows" is unverifiable without it): spans, counters, gauges, and
+histograms recorded as structured JSONL under the run directory, merged
+across gang workers into one run timeline, summarized into headline
+metrics, and rendered as the flow's timeline card.
+
+Usage (emitters)::
+
+    from tpuflow import obs
+
+    with obs.span("ckpt.save", step=3) as sp:
+        ...
+        sp.set(bytes=nbytes, gbps=nbytes / dur / 1e9)
+    obs.counter("train.tokens", n_tokens)
+    obs.histogram("train.step_s", dt)
+
+Every name must be registered in ``tpuflow.obs.catalog`` — enforced by
+``tools/obs_lint.py``. Disabled (the default outside a flow run) every
+call is a single boolean check; enabled, events buffer in memory and
+flush on a background thread (see ``recorder``).
+"""
+
+from tpuflow.obs.catalog import CATALOG, is_registered, kind_of
+from tpuflow.obs.recorder import (
+    Recorder,
+    configure,
+    counter,
+    enabled,
+    event,
+    flush,
+    gauge,
+    histogram,
+    recorder,
+    span,
+    timed_iter,
+)
+from tpuflow.obs.timeline import (
+    load_run_events,
+    merge_run_events,
+    obs_dir,
+    read_events,
+    summarize,
+)
+
+__all__ = [
+    "CATALOG",
+    "Recorder",
+    "configure",
+    "counter",
+    "enabled",
+    "event",
+    "flush",
+    "gauge",
+    "histogram",
+    "is_registered",
+    "kind_of",
+    "load_run_events",
+    "merge_run_events",
+    "obs_dir",
+    "read_events",
+    "recorder",
+    "span",
+    "summarize",
+    "timed_iter",
+]

@@ -1,0 +1,72 @@
+"""Canonical catalog of telemetry names.
+
+Every span/counter/gauge/histogram/event name emitted anywhere in tpuflow
+is registered here, once, with its kind and meaning. The catalog is the
+contract between emitters (runner/trainer/ckpt/data/infer) and consumers
+(the timeline card, ``obs.summarize``, downstream flows reading a run's
+telemetry): names can't silently drift between the two sides because
+``tools/obs_lint.py`` (and its pytest twin) greps every literal emitter
+call in the tree and fails on any name missing from this table.
+
+Kinds:
+
+- ``span``      — a timed region: one event with ``ts`` (wall-clock start)
+                  and ``dur_s`` (monotonic duration).
+- ``counter``   — a monotonically accumulated amount (sum over the run).
+- ``gauge``     — a sampled instantaneous value (last/max are meaningful,
+                  sums are not).
+- ``histogram`` — raw observations; consumers compute count/mean/p50/max.
+- ``event``     — a point-in-time record (warnings, markers, reports).
+"""
+
+from __future__ import annotations
+
+CATALOG: dict[str, tuple[str, str]] = {
+    # ---------------------------------------------------------------- flow
+    "flow.run": ("span", "one whole flow run, start → terminal status"),
+    "flow.step": ("span", "one step/task execution (attempt granularity)"),
+    "flow.gang": ("span", "gang execution: members launched → all joined"),
+    "flow.gang_member": ("span", "one gang member process's step body"),
+    "flow.retry": ("counter", "step attempts that failed and were retried"),
+    "flow.card_render": ("span", "card HTML render at step completion"),
+    # --------------------------------------------------------------- train
+    "train.fit": ("span", "Trainer.fit: mesh build + worker loop + drain"),
+    "train.epoch": ("span", "one training epoch (steady-state steps only)"),
+    "train.compile": ("span", "first-step jit trace + compile, fenced"),
+    "train.step_s": ("histogram", "steady-state per-step wall time, fenced"),
+    "train.validation": ("span", "held-out validation pass"),
+    "train.tokens": ("counter", "steady-state tokens consumed by train steps"),
+    "train.report": ("event", "one TrainContext.report: step + metrics"),
+    # ---------------------------------------------------------------- ckpt
+    "ckpt.save": ("span", "checkpoint save, save() → commit; bytes + gbps"),
+    "ckpt.restore": ("span", "checkpoint restore; bytes + gbps when known"),
+    # ---------------------------------------------------------------- data
+    "data.batch_wait_s": ("histogram", "time the consumer blocked per batch"),
+    "data.prefetch_hit": ("counter", "batches ready with no consumer wait"),
+    "data.prefetch_miss": ("counter", "batches the consumer had to wait for"),
+    # --------------------------------------------------------------- infer
+    "infer.predict": ("span", "BatchPredictor forward over one batch"),
+    "infer.generate": ("span", "one generate() call; tokens + tokens/s"),
+    "infer.generate_batch": ("span", "GenerationPredictor batch decode"),
+    "infer.spec.forwards": ("counter", "speculative verify forwards"),
+    "infer.spec.committed": ("counter", "tokens committed by speculation"),
+    "infer.spec.acceptance": ("gauge", "realized tokens per verify forward"),
+    # -------------------------------------------------------------- device
+    "device.bytes_in_use": ("gauge", "sampled per-device HBM bytes in use"),
+    "device.peak_bytes_in_use": ("gauge", "per-device peak HBM bytes"),
+    # ------------------------------------------------------------ warnings
+    "warn.flash_min_seq_malformed": (
+        "event",
+        "TPUFLOW_FLASH_MIN_SEQ env var was set but unparsable; the "
+        "threshold fell through to the tuning file / shipped default",
+    ),
+}
+
+
+def kind_of(name: str) -> str:
+    """Registered kind of ``name``; raises KeyError for unknown names."""
+    return CATALOG[name][0]
+
+
+def is_registered(name: str) -> bool:
+    return name in CATALOG

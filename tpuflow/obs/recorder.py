@@ -1,0 +1,293 @@
+"""Process-local telemetry recorder: buffered structured JSONL events.
+
+One ``Recorder`` per process writes ``events.p<proc>.jsonl`` under the
+run's ``obs/`` directory (gang members inherit the directory through
+``TPUFLOW_OBS_DIR`` and their slot through ``TPUFLOW_OBS_PROC`` /
+``TPUFLOW_PROCESS_ID``); ``tpuflow.obs.timeline.merge_run_events`` unions
+the per-process files into one run timeline.
+
+Overhead contract (pinned by tests/test_obs.py):
+
+- **Disabled** (no obs dir configured): every API call is one module-level
+  boolean check — ``span()`` returns a shared no-op context manager,
+  ``counter``/``gauge``/``histogram``/``event`` return immediately. No
+  allocation, no locking, no I/O on the hot path.
+- **Enabled**: ``record()`` appends a dict to an in-memory buffer under a
+  lock — no file I/O on the caller's thread. A daemon thread flushes the
+  buffer every ``flush_interval`` seconds (and on ``flush()``/``close()``/
+  interpreter exit), so writes happen off the step critical path.
+
+Event schema (one JSON object per line)::
+
+    {"kind": "span|counter|gauge|histogram|event",
+     "name": "<catalog name>", "ts": <wall-clock start, s>,
+     "proc": <gang process index>, "pid": <os pid>,
+     "dur_s": <monotonic duration, spans only>,
+     "value": <counter/gauge/histogram payload>, ...attrs}
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any
+
+_ENABLED = False
+_RECORDER: "Recorder | None" = None
+_ENV_CHECKED = False
+_LOCK = threading.Lock()
+
+
+class _NoopSpan:
+    """Shared, reentrant no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):  # matches _Span.set
+        return self
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_rec", "_name", "_attrs", "_t0", "_ts")
+
+    def __init__(self, rec: "Recorder", name: str, attrs: dict):
+        self._rec = rec
+        self._name = name
+        self._attrs = attrs
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-span (e.g. bytes moved)."""
+        self._attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._ts = time.time()
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        dur = time.monotonic() - self._t0
+        if exc_type is not None:
+            self._attrs.setdefault("error", exc_type.__name__)
+        self._rec.record(
+            "span", self._name, ts=self._ts, dur_s=dur, **self._attrs
+        )
+        return False
+
+
+class Recorder:
+    """Buffered JSONL event writer for one process."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        proc: int = 0,
+        flush_interval: float = 0.5,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.proc = int(proc)
+        # pid in the name: the HEAD runner and gang member 0 both occupy
+        # logical slot 0 and may flush concurrently — distinct files make
+        # every append single-writer (no torn lines to skip at merge).
+        self.path = os.path.join(
+            self.directory, f"events.p{self.proc:05d}.{os.getpid()}.jsonl"
+        )
+        os.makedirs(self.directory, exist_ok=True)
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self._flush_interval = flush_interval
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="tpuflow-obs-flush"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- record
+    def record(self, kind: str, name: str, *, ts: float | None = None, **attrs) -> None:
+        ev = {
+            "kind": kind,
+            "name": name,
+            "ts": time.time() if ts is None else ts,
+            "proc": self.proc,
+            "pid": os.getpid(),
+            **attrs,
+        }
+        with self._lock:
+            if not self._closed:
+                self._buf.append(ev)
+
+    def span(self, name: str, **attrs) -> _Span:
+        return _Span(self, name, attrs)
+
+    # -------------------------------------------------------------- flush
+    def _drain(self) -> None:
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if not buf:
+            return
+        lines = "".join(
+            json.dumps(ev, default=_jsonable) + "\n" for ev in buf
+        )
+        try:
+            with open(self.path, "a") as f:
+                f.write(lines)
+        except OSError:
+            pass  # telemetry must never fail the run
+
+    def _flush_loop(self) -> None:
+        while not self._closed:
+            self._wake.wait(self._flush_interval)
+            self._wake.clear()
+            self._drain()
+
+    def flush(self) -> None:
+        self._drain()
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        self._drain()
+        self._thread.join(timeout=2)
+
+
+def _jsonable(v: Any):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+# ----------------------------------------------------------- module API
+def configure(
+    directory: str | None, *, proc: int | None = None
+) -> Recorder | None:
+    """(Re)point the process recorder at ``directory``; ``None`` disables.
+
+    The flow runner calls this at run start with ``<run_dir>/obs`` and at
+    run end with ``None``; gang member processes pick the directory up
+    from ``TPUFLOW_OBS_DIR`` automatically (see ``_maybe_init_from_env``).
+    """
+    global _ENABLED, _RECORDER, _ENV_CHECKED
+    with _LOCK:
+        _ENV_CHECKED = True  # explicit configure overrides env discovery
+        if _RECORDER is not None:
+            _RECORDER.close()
+            _RECORDER = None
+        _ENABLED = False
+        if directory is None:
+            return None
+        if proc is None:
+            proc = int(
+                os.environ.get("TPUFLOW_OBS_PROC")
+                or os.environ.get("TPUFLOW_PROCESS_ID")
+                or 0
+            )
+        _RECORDER = Recorder(directory, proc=proc)
+        _ENABLED = True
+        return _RECORDER
+
+
+def _maybe_init_from_env() -> None:
+    """One-time env discovery: a gang subprocess (or any process launched
+    with TPUFLOW_OBS_DIR set) starts recording without explicit wiring."""
+    global _ENV_CHECKED
+    if _ENV_CHECKED:
+        return
+    with _LOCK:
+        if _ENV_CHECKED:
+            return
+        _ENV_CHECKED = True
+    d = os.environ.get("TPUFLOW_OBS_DIR")
+    if d:
+        configure(d)
+
+
+def enabled() -> bool:
+    if not _ENV_CHECKED:
+        _maybe_init_from_env()
+    return _ENABLED
+
+
+def recorder() -> Recorder | None:
+    return _RECORDER if enabled() else None
+
+
+def span(name: str, **attrs):
+    """Timed region context manager; a shared no-op when disabled."""
+    if not _ENABLED:
+        if _ENV_CHECKED:
+            return _NOOP_SPAN
+        _maybe_init_from_env()
+        if not _ENABLED:
+            return _NOOP_SPAN
+    return _RECORDER.span(name, **attrs)
+
+
+def counter(name: str, value: float = 1, **attrs) -> None:
+    if _ENABLED or enabled():
+        _RECORDER.record("counter", name, value=value, **attrs)
+
+
+def gauge(name: str, value: float, **attrs) -> None:
+    if _ENABLED or enabled():
+        _RECORDER.record("gauge", name, value=value, **attrs)
+
+
+def histogram(name: str, value: float, **attrs) -> None:
+    if _ENABLED or enabled():
+        _RECORDER.record("histogram", name, value=value, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    if _ENABLED or enabled():
+        _RECORDER.record("event", name, **attrs)
+
+
+def flush() -> None:
+    if _RECORDER is not None:
+        _RECORDER.flush()
+
+
+def timed_iter(iterable, name: str):
+    """Yield from ``iterable``, recording the consumer-visible wait for
+    each item as a ``histogram`` observation of ``name``. When telemetry
+    is disabled this returns the original iterable untouched — zero
+    wrapper frames on the hot path."""
+    if not enabled():
+        return iterable
+
+    def _gen():
+        it = iter(iterable)
+        while True:
+            t0 = time.monotonic()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            histogram(name, time.monotonic() - t0)
+            yield item
+
+    return _gen()
+
+
+@atexit.register
+def _atexit_close() -> None:
+    if _RECORDER is not None:
+        try:
+            _RECORDER.close()
+        except Exception:
+            pass

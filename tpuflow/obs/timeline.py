@@ -1,0 +1,183 @@
+"""Run-level telemetry assembly: merge per-process event files, summarize.
+
+``merge_run_events(run_dir)`` unions every gang worker's
+``obs/events.p*.jsonl`` into one time-ordered ``events.jsonl`` at the run
+root — the single artifact downstream flows and the timeline card read.
+``summarize(events)`` folds the stream into headline metrics (step time,
+tokens/s, checkpoint GB/s, loader wait) plus per-name aggregates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable
+
+MERGED_NAME = "events.jsonl"
+OBS_SUBDIR = "obs"
+
+
+def obs_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, OBS_SUBDIR)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one JSONL event file, skipping unparsable lines (a crashed
+    writer may leave a torn tail — telemetry reads must stay best-effort)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return []
+    return out
+
+
+def merge_run_events(run_dir: str, *, out_name: str = MERGED_NAME) -> list[dict]:
+    """Merge every per-process event file under ``<run_dir>/obs`` into one
+    time-sorted ``<run_dir>/events.jsonl``; returns the merged events.
+
+    Idempotent: re-running re-reads the fragments and rewrites the merged
+    file (fragments are kept — they are the ground truth; the merge is a
+    view). A run with no telemetry yields an empty list and no file."""
+    d = obs_dir(run_dir)
+    events: list[dict] = []
+    try:
+        names = sorted(
+            n
+            for n in os.listdir(d)
+            if n.startswith("events.p") and n.endswith(".jsonl")
+        )
+    except OSError:
+        return []
+    for name in names:
+        events.extend(read_events(os.path.join(d, name)))
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("proc", 0)))
+    if events:
+        out = os.path.join(run_dir, out_name)
+        tmp = out + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                for ev in events:
+                    f.write(json.dumps(ev) + "\n")
+            os.replace(tmp, out)
+        except OSError:
+            pass
+    return events
+
+
+def load_run_events(run_dir: str) -> list[dict]:
+    """The run's merged event stream: the committed ``events.jsonl`` if the
+    runner already merged, else merged on the fly from the fragments."""
+    path = os.path.join(run_dir, MERGED_NAME)
+    if os.path.exists(path):
+        return read_events(path)
+    return merge_run_events(run_dir)
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def summarize(events: Iterable[dict]) -> dict[str, Any]:
+    """Fold an event stream into per-name aggregates + headline metrics.
+
+    Returns::
+
+        {"spans": {name: {count, total_s, mean_s, max_s}},
+         "counters": {name: total},
+         "gauges": {name: {last, max}},
+         "histograms": {name: {count, mean, p50, max, total}},
+         "headline": {...}}   # step time, tokens/s, ckpt GB/s, data wait
+    """
+    spans: dict[str, dict] = {}
+    counters: dict[str, float] = {}
+    gauges: dict[str, dict] = {}
+    hists: dict[str, list[float]] = {}
+    ckpt_saves: list[dict] = []
+    ckpt_restores: list[dict] = []
+    for ev in events:
+        kind, name = ev.get("kind"), ev.get("name")
+        if kind == "span":
+            s = spans.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            d = float(ev.get("dur_s", 0.0))
+            s["count"] += 1
+            s["total_s"] += d
+            s["max_s"] = max(s["max_s"], d)
+            if name == "ckpt.save":
+                ckpt_saves.append(ev)
+            elif name == "ckpt.restore":
+                ckpt_restores.append(ev)
+        elif kind == "counter":
+            counters[name] = counters.get(name, 0.0) + float(
+                ev.get("value", 1.0)
+            )
+        elif kind == "gauge":
+            g = gauges.setdefault(name, {"last": 0.0, "max": 0.0})
+            v = float(ev.get("value", 0.0))
+            g["last"] = v
+            g["max"] = max(g["max"], v)
+        elif kind == "histogram":
+            hists.setdefault(name, []).append(float(ev.get("value", 0.0)))
+    for s in spans.values():
+        s["mean_s"] = s["total_s"] / max(s["count"], 1)
+    hist_out = {}
+    for name, vals in hists.items():
+        vals.sort()
+        hist_out[name] = {
+            "count": len(vals),
+            "total": sum(vals),
+            "mean": sum(vals) / max(len(vals), 1),
+            "p50": _pctl(vals, 0.5),
+            "max": vals[-1] if vals else 0.0,
+        }
+
+    headline: dict[str, Any] = {}
+    step_h = hist_out.get("train.step_s")
+    if step_h:
+        headline["step_time_p50_s"] = step_h["p50"]
+        headline["steps_timed"] = step_h["count"]
+    tokens = counters.get("train.tokens")
+    if tokens and step_h and step_h["total"] > 0:
+        headline["tokens_per_s"] = tokens / step_h["total"]
+    if ckpt_saves:
+        b = sum(float(e.get("bytes", 0.0)) for e in ckpt_saves)
+        d = sum(float(e.get("dur_s", 0.0)) for e in ckpt_saves)
+        headline["ckpt_save_bytes"] = b
+        if d > 0 and b > 0:
+            headline["ckpt_save_gbps"] = b / d / 1e9
+    if ckpt_restores:
+        b = sum(float(e.get("bytes", 0.0)) for e in ckpt_restores)
+        d = sum(float(e.get("dur_s", 0.0)) for e in ckpt_restores)
+        if d > 0 and b > 0:
+            headline["ckpt_restore_gbps"] = b / d / 1e9
+    wait = hist_out.get("data.batch_wait_s")
+    if wait:
+        headline["data_wait_total_s"] = wait["total"]
+    hits = counters.get("data.prefetch_hit", 0.0)
+    misses = counters.get("data.prefetch_miss", 0.0)
+    if hits + misses > 0:
+        headline["prefetch_hit_rate"] = hits / (hits + misses)
+    fwds = counters.get("infer.spec.forwards", 0.0)
+    committed = counters.get("infer.spec.committed", 0.0)
+    if fwds > 0:
+        headline["spec_tokens_per_forward"] = committed / fwds
+    return {
+        "spans": spans,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hist_out,
+        "headline": headline,
+    }

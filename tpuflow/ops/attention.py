@@ -47,6 +47,7 @@ def xla_attention(q, k, v, *, causal: bool = True):
 
 _DEFAULT_FLASH_MIN_SEQ = 2048
 _flash_tuning_cache: dict | None = None
+_warned_malformed_env = False
 
 
 def flash_tuning_path() -> str:
@@ -63,18 +64,33 @@ def flash_tuning_path() -> str:
 
 def _flash_min_seq() -> int:
     """Dispatch threshold resolution: TPUFLOW_FLASH_MIN_SEQ env var beats
-    the host's measured tuning file beats the shipped default. The file
-    read is cached per process (this runs at trace time)."""
+    the host's measured tuning file beats the shipped default. A MALFORMED
+    env var falls through to the tuning-file lookup (the host's measured
+    crossover — strictly better information than the shipped constant)
+    and warns once per process, through the obs stream when one is live.
+    The file read is cached per process (this runs at trace time)."""
     import json
     import os
 
-    global _flash_tuning_cache
+    global _flash_tuning_cache, _warned_malformed_env
     env = os.environ.get("TPUFLOW_FLASH_MIN_SEQ")
     if env is not None:
         try:
             return int(env)
         except ValueError:
-            return _DEFAULT_FLASH_MIN_SEQ  # malformed knob: keep default
+            if not _warned_malformed_env:
+                _warned_malformed_env = True
+                import warnings
+
+                from tpuflow import obs
+
+                warnings.warn(
+                    f"TPUFLOW_FLASH_MIN_SEQ={env!r} is not an integer; "
+                    "falling through to the tuning file / default",
+                    stacklevel=2,
+                )
+                obs.event("warn.flash_min_seq_malformed", value=env)
+            # fall through to the measured tuning file below
     if _flash_tuning_cache is None:
         try:
             with open(flash_tuning_path()) as f:

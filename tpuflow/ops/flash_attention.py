@@ -26,6 +26,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 spells it TPUCompilerParams; alias so call sites stay on
+    # the current name.
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 _NEG_INF = -1e30
 
 

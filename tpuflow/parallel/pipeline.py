@@ -37,7 +37,14 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map_old(f, **kw)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_STAGE = "stage"
@@ -119,32 +126,45 @@ def make_pipeline_loss(
             state = jax.lax.ppermute(y, AXIS_STAGE, right)
             return (state, loss_acc, aux_acc), None
 
+        # Accumulators are rank-1 ((1,) not scalar): device-varying rank-0
+        # residuals of the scan can't be concatenated by shard_map's grad
+        # machinery on older jax (_check_names rejects names on a rank-0
+        # aval) — the singleton axis costs nothing and transposes cleanly
+        # everywhere.
         (_, loss_acc, aux_acc), _ = jax.lax.scan(
             tick,
-            (state0, jnp.float32(0.0), jnp.float32(0.0)),
+            (
+                state0,
+                jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1,), jnp.float32),
+            ),
             jnp.arange(M + S - 1),
         )
-        # Only the last stage accumulated task losses; every stage holds its
-        # own layers' aux. psum replicates the totals everywhere. Mean over
-        # microbatches and data shards.
-        total = jax.lax.psum(loss_acc + aux_acc, AXIS_STAGE)
-        if D > 1:
-            total = jax.lax.psum(total, data_axis) / D
-        return total / M
+        # Only the last stage accumulated task losses; every stage holds
+        # its own layers' aux. Each shard emits its CONTRIBUTION as one
+        # cell of an (S, D) grid; the replicated global mean is taken
+        # OUTSIDE the shard_map (sum over a sharded array is an ordinary
+        # XLA reduction) — device-varying out_specs transpose cleanly
+        # under grad on every jax version, where an in-body psum to a
+        # replicated P() output trips old shard_map's rep tracking.
+        return (loss_acc + aux_acc).reshape(1, 1)
 
     def loss_fn(stacked_blocks, other, tokens, targets):
         # check_vma=False: the scan carries (activation buffer, loss
         # accumulator) start as replicated zeros and become device-varying
-        # on the first tick — intended here, the masking/psum make the
-        # final output replicated again.
+        # on the first tick — intended here, the contributions grid out
+        # spec declares the output varying.
         f = shard_map(
             spmd,
             mesh=mesh,
             in_specs=(P(AXIS_STAGE), P(), P(data_axis), P(data_axis)),
-            out_specs=P(),
+            out_specs=P(AXIS_STAGE, data_axis),
             check_vma=False,
         )
-        return f(stacked_blocks, other, tokens, targets)
+        contrib = f(stacked_blocks, other, tokens, targets)  # (S, D)
+        # Mean over microbatches and data shards (the stage dimension is a
+        # sum by construction: only valid cells accumulated anything).
+        return jnp.sum(contrib) / (D * M)
 
     return loss_fn
 

@@ -306,12 +306,21 @@ def _train_fsdp(
         rng = jax.random.PRNGKey(1)
         history = []
         epoch_records = []
+        # Telemetry (tpuflow.obs): per-step wall times + tokens ride the
+        # fences the loop already pays; batch-wait rides the loader
+        # iterator. All no-ops when obs is disabled.
+        from tpuflow import obs
+        from tpuflow.train.step import StepClock
+
+        clock = StepClock()
         for epoch in range(cfg.epochs):
             t_epoch = time.monotonic()
+            ts_epoch = time.time()
             loader.set_epoch(epoch)
             losses = []
             n_tokens = 0
-            for i, b in enumerate(loader):
+            clock.reset()
+            for i, b in enumerate(obs.timed_iter(loader, "data.batch_wait_s")):
                 batch = {
                     "x": jax.device_put(b["x"], batch_sharding),
                     "y": jax.device_put(b["y"], batch_sharding),
@@ -324,25 +333,36 @@ def _train_fsdp(
                     # are excluded from the rate accordingly.
                     jax.block_until_ready(metrics["loss"])
                     t_epoch = time.monotonic()
+                    ts_epoch = time.time()
+                    clock.compile_done(preset=cfg.preset)
                 else:
                     dist.step_fence(metrics["loss"])
                     n_tokens += int(np.prod(b["y"].shape))
+                    clock.step_done(tokens=int(np.prod(b["y"].shape)))
             jax.block_until_ready(state.params)
             epoch_s = time.monotonic() - t_epoch
             tok_s = n_tokens / max(epoch_s, 1e-9) if n_tokens else None
             epoch_loss = float(jnp.stack(losses).mean())
             history.append(epoch_loss)
+            rec = obs.recorder()
+            if rec is not None:
+                rec.record(
+                    "span", "train.epoch", ts=ts_epoch, dur_s=epoch_s,
+                    epoch=epoch, loss=epoch_loss,
+                    tokens_per_s=round(tok_s, 1) if tok_s else None,
+                )
             # Held-out validation: token-level loss -> perplexity over
             # EVERY test window (padded tail masked out). The best/retention
             # policy keys on real val loss, matching the reference's
             # save-best-on-val semantics (my_ray_module.py:190-201), not
             # the train loss.
-            val_loss = run_validation(
-                state,
-                val_loader,
-                eval_step,
-                place=lambda x: jax.device_put(x, batch_sharding),
-            )
+            with obs.span("train.validation", epoch=epoch):
+                val_loss = run_validation(
+                    state,
+                    val_loader,
+                    eval_step,
+                    place=lambda x: jax.device_put(x, batch_sharding),
+                )
             ppl = math.exp(min(val_loss, 30.0))
             epoch_records.append(
                 {
@@ -516,10 +536,16 @@ def _train_pipeline(
         )
         history = []
         global_step = start_step
+        from tpuflow import obs
+        from tpuflow.train.step import StepClock
+
+        clock = StepClock()
         for epoch in range(cfg.epochs):
             loader.set_epoch(epoch)
             losses = []
-            for b in loader:
+            first = epoch == 0
+            clock.reset()
+            for b in obs.timed_iter(loader, "data.batch_wait_s"):
                 params, opt_state, loss = pp_step(
                     params,
                     opt_state,
@@ -527,6 +553,11 @@ def _train_pipeline(
                     jax.device_put(b["y"], data_sharding),
                 )
                 dist.step_fence(loss)
+                if first:
+                    clock.compile_done(mode="pipeline")
+                    first = False
+                else:
+                    clock.step_done(tokens=int(b["y"].size))
                 losses.append(loss)
                 global_step += 1
             jax.block_until_ready(params)

@@ -11,6 +11,7 @@ collective call, matching the reference's encapsulation of NCCL behind
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable
 
 import flax
@@ -18,7 +19,56 @@ import jax
 import jax.numpy as jnp
 from flax.training import train_state
 
+from tpuflow import obs
 from tpuflow.models.losses import accuracy, cross_entropy_loss
+
+
+class StepClock:
+    """Per-step wall-time telemetry for a fenced step loop.
+
+    The epoch loops (tpuflow.train.gpt) fence every step (dist.step_fence),
+    so host-side monotonic deltas between fences ARE per-step wall time —
+    this clock turns them into the unified telemetry stream: a
+    ``train.compile`` span for the cold first step (jit trace + compile +
+    first execution, the part that must be split out or it poisons every
+    throughput number), a ``train.step_s`` histogram observation per
+    steady-state step, and a ``train.tokens`` counter for tokens/sec
+    derivation. Every method is a no-op when telemetry is disabled — the
+    loop pays one attribute check per step, nothing else (pinned by
+    tests/test_obs.py overhead guard).
+    """
+
+    def __init__(self):
+        self._on = obs.enabled()
+        self._last = time.monotonic() if self._on else 0.0
+        self._t0 = self._last
+        self._ts0 = time.time() if self._on else 0.0
+
+    def reset(self) -> None:
+        """Restart the clock (epoch boundary / after the compile fence)."""
+        if self._on:
+            self._last = time.monotonic()
+
+    def compile_done(self, **attrs) -> None:
+        """The cold first step just fenced: record it as train.compile."""
+        if self._on:
+            now = time.monotonic()
+            rec = obs.recorder()
+            if rec is not None:
+                rec.record(
+                    "span", "train.compile", ts=self._ts0,
+                    dur_s=now - self._t0, **attrs,
+                )
+            self._last = now
+
+    def step_done(self, tokens: int = 0) -> None:
+        """A steady-state step just fenced: record its wall time."""
+        if self._on:
+            now = time.monotonic()
+            obs.histogram("train.step_s", now - self._last)
+            if tokens:
+                obs.counter("train.tokens", tokens)
+            self._last = now
 
 
 class TrainState(train_state.TrainState):

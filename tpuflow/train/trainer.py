@@ -30,7 +30,7 @@ from typing import Any, Callable
 
 import jax
 
-from tpuflow import dist
+from tpuflow import dist, obs
 from tpuflow.ckpt import Checkpoint, CheckpointManager
 
 logger = logging.getLogger("tpuflow.train")
@@ -190,6 +190,15 @@ class TrainContext:
         }
         self._reported.append(metrics)
         save_step = step if step is not None else len(self._reported)
+        # Unified telemetry: every report lands in the run's event stream
+        # beside the step spans (numeric metrics only — the event must
+        # stay one JSON line).
+        obs.event(
+            "train.report",
+            step=save_step,
+            **{k: v for k, v in metrics.items()
+               if isinstance(v, (int, float))},
+        )
         if state is not None and self._manager is not None:
             self._manager.save(save_step, state, metrics=metrics)
         if self.run_config.storage_path and jax.process_index() == 0:
@@ -282,7 +291,9 @@ class Trainer:
         _ACTIVE_CONTEXT = ctx
         start = time.monotonic()
         try:
-            with mesh:
+            with obs.span(
+                "train.fit", workers=dist.data_axis_size(mesh)
+            ), mesh:
                 self.train_loop_per_worker(dict(self.train_loop_config))
         finally:
             _ACTIVE_CONTEXT = None
