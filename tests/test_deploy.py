@@ -67,7 +67,16 @@ def test_materialize_writes_jobset_job_cron_and_lock(tmp_path):
     # v5e-16 = 4 hosts x 4 chips: gang of 4 indexed pods, 4 chips each.
     assert job["parallelism"] == 4 and job["completions"] == 4
     assert job["backoffLimit"] == 3  # @retry(times=3)
+    # Preemption parity: a drained member's requeue exit must not consume
+    # backoffLimit (= the @retry budget) — mirrors runner.StepPreempted.
+    from tpuflow.utils.preempt import REQUEUE_EXIT_CODE
+
+    (rule,) = job["podFailurePolicy"]["rules"]
+    assert rule["action"] == "Ignore"
+    assert rule["onExitCodes"]["values"] == [REQUEUE_EXIT_CODE]
     pod = job["template"]["spec"]
+    # Preemption grace surfaces the gang timeout: SIGTERM → drain → exit.
+    assert pod["terminationGracePeriodSeconds"] == 120
     assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
     assert pod["nodeSelector"]["cloud.google.com/gke-nodepool"] == "tpu-pool"
     c = pod["containers"][0]

@@ -1,0 +1,549 @@
+"""Fault-tolerance chaos suite (ISSUE 2).
+
+Covers the supervisor's fail-fast + heartbeat-stall detection, retry
+backoff (injected clock — no real sleeps), preemption requeue semantics,
+checkpoint integrity (crc32 verify, fallback, opt-out), the launch-loop
+leak fix, the store-artifact commit marker, and the ``TPUFLOW_FAULT``
+injection harness end to end on real subprocess gangs."""
+
+import glob
+import json
+import os
+import signal
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.flow import store
+from tpuflow.flow.runner import FlowRunner, StepFailed, StepPreempted
+from tpuflow.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def isolated_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path / "home"))
+    monkeypatch.setenv("TPUFLOW_FORCE_CPU", "1")
+    monkeypatch.delenv("TPUFLOW_FAULT", raising=False)
+    monkeypatch.delenv("TPUFLOW_ATTEMPT", raising=False)
+    faults.reset()
+    yield tmp_path
+    faults.reset()
+
+
+def _write_flow(tmp_path, body: str) -> str:
+    path = tmp_path / "faultflow.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path.write_text(
+        textwrap.dedent(
+            f"""
+            import sys
+            sys.path.insert(0, {repo!r})
+            from tpuflow.flow import FlowSpec, retry, step, tpu, current
+            """
+        )
+        + textwrap.dedent(body)
+    )
+    return str(path)
+
+
+def _load_flow(path: str, name: str):
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location("faultflow_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["faultflow_test"] = mod
+    spec.loader.exec_module(mod)
+    return getattr(mod, name)
+
+
+def _run_events(flow_name: str, run_id: int = 1) -> list[dict]:
+    path = os.path.join(store.run_dir(flow_name, run_id), "events.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------ spec parsing
+def test_fault_spec_parsing():
+    specs = faults.parse(
+        "member_exit:1@step3,heartbeat_stall:0,rendezvous_delay:2.5@1,"
+        "ckpt_flip_byte,preempt:0@step2,rendezvous_delay:7"
+    )
+    by_kind = {}
+    for f in specs:
+        by_kind.setdefault(f.kind, []).append(f)
+    assert by_kind["member_exit"][0] == faults.Fault(
+        "member_exit", rank=1, step=3
+    )
+    assert by_kind["heartbeat_stall"][0].rank == 0
+    assert by_kind["rendezvous_delay"][0] == faults.Fault(
+        "rendezvous_delay", rank=1, value=2.5
+    )
+    assert by_kind["rendezvous_delay"][1].rank is None
+    assert by_kind["preempt"][0].step == 2
+    assert by_kind["ckpt_flip_byte"][0].rank is None
+    with pytest.raises(ValueError):
+        faults.parse("explode:1")
+    with pytest.raises(ValueError):
+        faults.parse("member_exit:1@epoch3")
+    with pytest.raises(ValueError):
+        faults.parse("ckpt_truncate:5")
+
+
+# ------------------------------------------------------- backoff (no sleeps)
+def test_backoff_jitter_bounds():
+    from tpuflow.flow.runner import _backoff_delay
+
+    for attempt in (1, 2, 3, 6):
+        base = min(60.0, 2.0 * 2 ** (attempt - 1))
+        for _ in range(50):
+            d = _backoff_delay(attempt, 2.0, 60.0)
+            assert base * 0.5 <= d <= base
+
+
+def test_retry_backoff_injected_clock(monkeypatch):
+    """@retry backoff follows min(max, base·2^(n-1)) with the jitter
+    pinned — and the runner uses the injectable sleep, so the test takes
+    milliseconds, not the 11 s the schedule nominally spans."""
+    from tpuflow.flow import FlowSpec, retry, step
+    from tpuflow.flow import runner as runner_mod
+
+    sleeps: list[float] = []
+    monkeypatch.setattr(runner_mod, "_sleep", sleeps.append)
+    monkeypatch.setattr(runner_mod, "_random", lambda: 1.0)  # jitter → 1.0
+
+    class BackoffFlow(FlowSpec):
+        @retry(times=3, backoff_s=2.0, max_backoff_s=5.0)
+        @step
+        def start(self):
+            raise RuntimeError("boom")
+
+        @step
+        def end(self):
+            pass
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        FlowRunner(BackoffFlow).run({})
+    assert sleeps == [2.0, 4.0, 5.0]
+    assert time.monotonic() - t0 < 30.0
+
+
+# ------------------------------------------------------------- preemption
+def test_sigterm_sets_preemption_flag():
+    from tpuflow.utils import preempt
+
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        preempt.clear_preemption()
+        assert preempt.install_sigterm_handler()
+        assert not preempt.preemption_requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not preempt.preemption_requested():
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        preempt.clear_preemption()
+
+
+def test_requeue_does_not_consume_retry_budget(monkeypatch):
+    """A preempted step reruns with zero @retry budget left; a cap bounds
+    requeue storms."""
+    from tpuflow.flow import FlowSpec, retry, step
+
+    calls = {"n": 0}
+
+    class PreemptyFlow(FlowSpec):
+        @retry(times=0)
+        @step
+        def start(self):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise StepPreempted("simulated requeue")
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    FlowRunner(PreemptyFlow).run({})
+    assert calls["n"] == 3  # two requeues, zero retries consumed
+
+    calls["n"] = -10  # would need 12 more launches than the cap allows
+    monkeypatch.setenv("TPUFLOW_MAX_REQUEUES", "1")
+    with pytest.raises(StepPreempted):
+        FlowRunner(PreemptyFlow).run({}, run_id=2)
+
+
+# ------------------------------------------------------ checkpoint integrity
+def _flip_byte(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_flipped_byte_falls_back_to_previous_step(tmp_path):
+    """Acceptance: one flipped byte in a committed raw shard → restore
+    never silently returns corrupted weights; with an earlier committed
+    step it falls back there, recording ckpt.corrupt."""
+    from tpuflow import obs
+    from tpuflow.ckpt import CheckpointManager
+
+    mgr = CheckpointManager(
+        str(tmp_path / "ck"), async_save=False, max_to_keep=None
+    )
+    w1 = np.arange(4096, dtype=np.float32)
+    mgr.save(1, {"w": w1}, metrics={"val_loss": 1.0})
+    mgr.save(2, {"w": w1 * 2}, metrics={"val_loss": 0.5})
+    mgr.wait_until_finished()
+
+    (shard,) = glob.glob(str(tmp_path / "ck" / "step_2" / "state" / "*.bin"))
+    _flip_byte(shard)
+
+    obs_dir = str(tmp_path / "obs")
+    obs.configure(obs_dir, proc=0)
+    try:
+        assert mgr.verify_step(1) is True
+        assert mgr.verify_step(2) is False
+        out = mgr.restore()  # latest (2) is corrupt → falls back to 1
+        np.testing.assert_array_equal(out["w"], w1)
+        obs.flush()
+    finally:
+        obs.configure(None)
+    (events_path,) = glob.glob(os.path.join(obs_dir, "events.p*.jsonl"))
+    with open(events_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    names = [e["name"] for e in events]
+    assert "ckpt.corrupt" in names
+    verifies = [e for e in events if e["name"] == "ckpt.verify"]
+    assert {e["step"]: e["ok"] for e in verifies} == {1: True, 2: False}
+    mgr.close()
+
+
+def test_flipped_byte_sole_step_raises_and_verify_opt_out(
+    tmp_path, monkeypatch
+):
+    from tpuflow.ckpt import CheckpointManager, CorruptShardError
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    mgr.save(1, {"w": np.arange(4096, dtype=np.float32)}, metrics={})
+    mgr.wait_until_finished()
+    (shard,) = glob.glob(str(tmp_path / "ck" / "step_1" / "state" / "*.bin"))
+    _flip_byte(shard)
+    with pytest.raises(CorruptShardError):
+        mgr.restore()
+    # Opt-out restores without the checksum pass (and without protection).
+    monkeypatch.setenv("TPUFLOW_CKPT_VERIFY", "0")
+    out = mgr.restore()
+    assert out["w"].shape == (4096,)
+    mgr.close()
+
+
+def test_fault_injected_ckpt_corruption(tmp_path, monkeypatch):
+    """The harness's saver-side corruptions are caught by restore-side
+    verification: flip_byte → crc mismatch, truncate → short-file check."""
+    from tpuflow.ckpt import CheckpointManager, CorruptShardError
+
+    for i, kind in enumerate(("ckpt_flip_byte", "ckpt_truncate")):
+        faults.reset()
+        monkeypatch.setenv("TPUFLOW_FAULT", kind)
+        mgr = CheckpointManager(str(tmp_path / f"ck{i}"), async_save=False)
+        mgr.save(1, {"w": np.arange(4096, dtype=np.float32)}, metrics={})
+        mgr.wait_until_finished()
+        with pytest.raises(CorruptShardError):
+            mgr.restore(1)
+        monkeypatch.delenv("TPUFLOW_FAULT")
+        mgr.close()
+
+
+# ------------------------------------------------------- launch-loop leak
+def test_gang_launch_failure_kills_spawned_members(tmp_path, monkeypatch):
+    """If Popen raises mid-launch-loop, already-spawned members are killed
+    and their log files closed — not leaked until interpreter exit."""
+    import subprocess as real_subprocess
+
+    from tpuflow.flow import runner as runner_mod
+
+    spawned = []
+    calls = {"n": 0}
+
+    class FakeSubprocess:
+        TimeoutExpired = real_subprocess.TimeoutExpired
+        STDOUT = real_subprocess.STDOUT
+
+        @staticmethod
+        def Popen(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise OSError("injected spawn failure")
+            p = real_subprocess.Popen(*args, **kwargs)
+            spawned.append(p)
+            return p
+
+    monkeypatch.setattr(runner_mod, "subprocess", FakeSubprocess)
+    flow_path = _write_flow(
+        tmp_path,
+        """
+        class Leak(FlowSpec):
+            @step
+            def start(self):
+                self.next(self.work, num_parallel=2)
+
+            @tpu(all_hosts_started_timeout=60)
+            @step
+            def work(self):
+                self.next(self.end)
+
+            @step
+            def end(self):
+                pass
+        """,
+    )
+    Leak = _load_flow(flow_path, "Leak")
+    with pytest.raises(OSError, match="injected spawn failure"):
+        FlowRunner(Leak).run({})
+    assert len(spawned) == 1
+    assert spawned[0].poll() is not None, "member 0 leaked past the failure"
+    # No open fd still points at a gang log (the launcher closed them).
+    open_logs = []
+    for fd in os.listdir("/proc/self/fd"):
+        try:
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue
+        if "gang_" in target and target.endswith(".log"):
+            open_logs.append(target)
+    assert not open_logs
+
+
+# ------------------------------------------------- store-artifact staleness
+def test_store_artifacts_ignores_uncommitted_saves():
+    """Only artifact dirs with the commit marker (written after the JSON +
+    blobs) are candidates — a failed attempt's partial artifacts can't be
+    resurrected by winning on mtime."""
+    from tpuflow.flow import gang_exec
+
+    flow, run_id = "MarkerFlow", "r1"
+    os.makedirs(store.run_dir(flow, run_id), exist_ok=True)
+    store.write_run_meta(flow, run_id, {"run_id": run_id, "status": "running"})
+    store.save_artifacts(flow, run_id, "upstream", 0, {"x": 1})
+    time.sleep(0.02)
+    # A NEWER partial save (no marker: crashed between json and marker).
+    partial = store.task_dir(flow, run_id, "crashed", 1)
+    os.makedirs(partial)
+    with open(os.path.join(partial, "artifacts.json"), "w") as f:
+        json.dump({"x": {"__type__": "json", "value": 999}}, f)
+    arts = gang_exec._store_artifacts(flow, run_id, "downstream")
+    assert arts == {"x": 1}
+    # The marker carries the launch attempt stamped from the env.
+    with open(
+        os.path.join(store.task_dir(flow, run_id, "upstream", 0), "artifacts.ok")
+    ) as f:
+        assert json.load(f)["attempt"] == 0
+
+
+# =================================================== subprocess gang chaos
+_CHAOS_FLOW = """
+    from tpuflow.flow import retry
+
+    class Chaos(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.train, num_parallel=2)
+
+        @retry(times={times}, backoff_s=0.2, max_backoff_s=0.4)
+        @tpu(all_hosts_started_timeout=120)
+        @step
+        def train(self):
+            import os
+            import numpy as np
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from tpuflow.train import RunConfig, Trainer, get_context
+
+            def loop(cfg):
+                ctx = get_context()
+                start = ctx.latest_step()
+                self.resumed_from = start
+                sh = NamedSharding(ctx.mesh, P("data"))
+                for stp in range(start + 1, 4):
+                    local = np.full((2,), float(stp), np.float32)
+                    w = jax.make_array_from_process_local_data(sh, local)
+                    ctx.report(
+                        {{"val_loss": 1.0 / stp}}, state={{"w": w}}, step=stp
+                    )
+
+            # Default ASYNC checkpointing on purpose: multi-host commits
+            # are deferred to the next drain, which is exactly the config
+            # that livelocked deterministic crashes before the
+            # eager-commit-on-retry fix (utils.preempt.launch_attempt).
+            result = Trainer(
+                loop,
+                run_config=RunConfig(
+                    storage_path=os.path.join(
+                        current.tpu_storage_path, "trainer"
+                    ),
+                ),
+            ).fit()
+            self.history_steps = [m["step"] for m in result.metrics_history]
+            self.final_val = result.metrics_history[-1]["val_loss"]
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+"""
+
+
+def test_chaos_member_exit_fail_fast_backoff_resume(tmp_path, monkeypatch):
+    """THE acceptance chaos test: member 1 of a 2-member gang train step
+    dies after step 1. The step must fail fast (well under the old
+    ``timeout + 600`` deadline), the @retry must back off (recorded
+    gauge), and a retried attempt must resume from the committed step-1
+    checkpoint with a CONTINUOUS metrics history — no step-0 restart.
+
+    With the production-default async checkpointing this also pins the
+    eager-commit-on-retry fix: attempt 1 dies before step 1's deferred
+    commit (nothing to resume), attempt 2 commits step 1 eagerly before
+    the same fault kills it, attempt 3 resumes past the fault — without
+    the fix, every attempt would die at step 1 forever (livelock)."""
+    monkeypatch.setenv("TPUFLOW_FAULT", "member_exit:1@step1")
+    monkeypatch.setenv("TPUFLOW_KILL_GRACE_S", "2")
+    flow_path = _write_flow(tmp_path, _CHAOS_FLOW.format(times=2))
+    Chaos = _load_flow(flow_path, "Chaos")
+    t0 = time.monotonic()
+    pathspec = FlowRunner(Chaos).run({})
+    elapsed = time.monotonic() - t0
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    assert run.successful
+    # The retry resumed from step 1's checkpoint, not step 0...
+    assert run.data.resumed_from == 1
+    # ...and the result's history is continuous across the retry.
+    assert run.data.history_steps == [1, 2, 3]
+    assert abs(run.data.final_val - 1.0 / 3.0) < 1e-6
+    # Fail-fast: the whole run (two gang launches) completes far inside
+    # the single old worst-case deadline of 120 + 600 s.
+    assert elapsed < 300, f"chaos run took {elapsed:.0f}s"
+    events = _run_events("Chaos")
+    # Which member the supervisor observed first is a race between the
+    # injected death (member 1) and its peer erroring out of the dead
+    # collective — either way the failure was recorded with a culprit.
+    failed = [e for e in events if e["name"] == "flow.member_failed"]
+    assert failed and failed[0]["member"] in (0, 1) and failed[0]["rc"] != 0
+    backoffs = sorted(
+        e["value"] for e in events if e["name"] == "flow.retry_backoff_s"
+    )
+    assert len(backoffs) == 2  # three launches: crash, crash+commit, done
+    assert 0.1 <= backoffs[0] <= 0.2 and 0.2 <= backoffs[1] <= 0.4
+
+
+def test_fail_fast_latency_on_member_crash(tmp_path, monkeypatch):
+    """Killing member 1 of a 2-member gang fails the step in seconds: the
+    supervisor reaps the surviving (sleeping) member instead of waiting
+    out the old flat ``timeout + 600`` deadline."""
+    monkeypatch.setenv("TPUFLOW_KILL_GRACE_S", "2")
+    flow_path = _write_flow(
+        tmp_path,
+        """
+        class FF(FlowSpec):
+            @step
+            def start(self):
+                self.next(self.work, num_parallel=2)
+
+            @tpu(all_hosts_started_timeout=60)
+            @step
+            def work(self):
+                import os, time
+                import jax
+                if jax.process_index() == 1:
+                    os._exit(7)
+                time.sleep(300)  # survivor: must be killed, not joined
+
+            @step
+            def end(self):
+                pass
+        """,
+    )
+    FF = _load_flow(flow_path, "FF")
+    t0 = time.monotonic()
+    with pytest.raises(StepFailed, match="member 1 exited 7"):
+        FlowRunner(FF).run({})
+    elapsed = time.monotonic() - t0
+    # Old behavior: ≥ 60 + 600 s (the sleeping survivor held the join).
+    assert elapsed < 90, f"fail-fast took {elapsed:.0f}s"
+    events = _run_events("FF")
+    failed = [e for e in events if e["name"] == "flow.member_failed"]
+    assert failed and failed[0]["member"] == 1 and failed[0]["rc"] == 7
+
+
+def test_heartbeat_stall_detected_and_killed(tmp_path, monkeypatch):
+    """A member that stops stamping its heartbeat (livelock injected inside
+    the first beat) is detected via stall timeout ≪ the rendezvous
+    deadline, named as the culprit, and the gang is killed fast."""
+    monkeypatch.setenv("TPUFLOW_FAULT", "heartbeat_stall:1")
+    monkeypatch.setenv("TPUFLOW_KILL_GRACE_S", "2")
+    flow_path = _write_flow(
+        tmp_path,
+        """
+        class HB(FlowSpec):
+            @step
+            def start(self):
+                self.next(self.work, num_parallel=2)
+
+            @tpu(all_hosts_started_timeout=120, heartbeat_timeout=2)
+            @step
+            def work(self):
+                # Member 1 stamps ONCE and then hangs inside that first
+                # beat() (the injected livelock). Member 0 keeps stamping,
+                # so the supervisor must finger member 1 (oldest stamp).
+                import time
+                from tpuflow.utils.heartbeat import beat
+                for _ in range(150):
+                    beat()
+                    time.sleep(0.2)
+
+            @step
+            def end(self):
+                pass
+        """,
+    )
+    HB = _load_flow(flow_path, "HB")
+    t0 = time.monotonic()
+    with pytest.raises(StepFailed, match="heartbeat stalled"):
+        FlowRunner(HB).run({})
+    elapsed = time.monotonic() - t0
+    assert elapsed < 90, f"stall detection took {elapsed:.0f}s"
+    events = _run_events("HB")
+    stalls = [e for e in events if e["name"] == "flow.heartbeat_stall"]
+    assert stalls and stalls[0]["member"] == 1
+    assert stalls[0]["age_s"] > 2.0
+
+
+@pytest.mark.slow
+def test_preemption_drains_and_requeues_gang_end_to_end(tmp_path, monkeypatch):
+    """Full preemption path on a real gang: the injected preemption (both
+    members, like a real slice preemption) makes them drain + exit with
+    the requeue code; the step reruns with ZERO retry budget (times=0)
+    and resumes from the drained checkpoint."""
+    monkeypatch.setenv("TPUFLOW_FAULT", "preempt:0@step2,preempt:1@step2")
+    monkeypatch.setenv("TPUFLOW_KILL_GRACE_S", "2")
+    flow_path = _write_flow(tmp_path, _CHAOS_FLOW.format(times=0))
+    Chaos = _load_flow(flow_path, "Chaos")
+    pathspec = FlowRunner(Chaos).run({})
+    from tpuflow.flow import Run
+
+    run = Run(pathspec)
+    assert run.successful
+    assert run.data.resumed_from == 2
+    assert run.data.history_steps == [1, 2, 3]
+    events = _run_events("Chaos")
+    assert any(e["name"] == "flow.preempt" for e in events)

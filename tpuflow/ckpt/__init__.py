@@ -11,10 +11,12 @@ from tpuflow.ckpt.manager import (
     prewarm_restore_handle,
     restore_from_handle,
 )
+from tpuflow.ckpt.raw import CorruptShardError
 
 __all__ = [
     "Checkpoint",
     "CheckpointManager",
+    "CorruptShardError",
     "prewarm_restore_handle",
     "restore_from_handle",
 ]

@@ -167,10 +167,25 @@ class CheckpointManager:
         if jax.process_count() > 1:
             multihost_utils.sync_global_devices("tpuflow_ckpt_mgr_swept")
         # Rebuild history from existing steps (in-run resume after retry).
-        for step in self.all_steps():
+        # The newest step's metadata embeds the FULL accumulated history —
+        # including steps retention has since deleted — so a retried run's
+        # metrics history stays continuous from the first save, not from
+        # the oldest still-retained checkpoint.
+        steps = self.all_steps()
+        seen_steps: set[int] = set()
+        if steps:
+            newest = self._read_meta(steps[-1]) or {}
+            for m in newest.get("metrics_history", []):
+                if "step" in m:
+                    self._metrics_history.append(dict(m))
+                    seen_steps.add(m["step"])
+        for step in steps:
+            if step in seen_steps:
+                continue
             meta = self._read_meta(step)
             if meta and "metrics" in meta:
                 self._metrics_history.append({"step": step, **meta["metrics"]})
+        self._metrics_history.sort(key=lambda m: m.get("step", 0))
 
     def prewarm(self, state) -> None:
         """Back recycle-pool pages for the steady-state footprint in the
@@ -566,24 +581,70 @@ class CheckpointManager:
         ``zero_copy``: raw format only — restored arrays alias the mapped
         shard files (no read copy); see raw.restore_raw for the safety
         contract (read-only consumers of finished/owned runs).
+
+        Integrity: raw-format shards are crc32-verified as they are read
+        (``TPUFLOW_CKPT_VERIFY=0`` opts out). A corrupted step records a
+        ``ckpt.corrupt`` event and falls back to the newest earlier
+        committed step; with no earlier step the CorruptShardError
+        propagates — corrupted weights are never silently returned.
         """
         from tpuflow.ckpt import raw as raw_fmt
 
         chosen = self._resolve_step(step, best)
-        state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
-        t0, ts0 = time.monotonic(), time.time()
-        if raw_fmt.is_raw(state_dir):
-            out = raw_fmt.restore_raw(
-                state_dir,
-                _abstractify(abstract_state) if abstract_state is not None else None,
-                zero_copy=zero_copy,
-            )
-        elif abstract_state is not None:
-            out = self._ckptr.restore(state_dir, _abstractify(abstract_state))
-        else:
-            out = self._ckptr.restore(state_dir)
-        _record_restore(state_dir, t0, ts0, step=chosen)
-        return out
+        while True:
+            state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
+            t0, ts0 = time.monotonic(), time.time()
+            try:
+                if raw_fmt.is_raw(state_dir):
+                    out = raw_fmt.restore_raw(
+                        state_dir,
+                        _abstractify(abstract_state)
+                        if abstract_state is not None
+                        else None,
+                        zero_copy=zero_copy,
+                    )
+                elif abstract_state is not None:
+                    out = self._ckptr.restore(
+                        state_dir, _abstractify(abstract_state)
+                    )
+                else:
+                    out = self._ckptr.restore(state_dir)
+            except raw_fmt.CorruptShardError as e:
+                obs.event("ckpt.corrupt", step=chosen, error=str(e)[:300])
+                prev = [s for s in self._all_steps() if s < chosen]
+                if not prev:
+                    raise
+                print(
+                    f"[tpuflow] checkpoint step {chosen} corrupt, falling "
+                    f"back to step {prev[-1]}: {e}"
+                )
+                chosen = prev[-1]
+                continue
+            _record_restore(state_dir, t0, ts0, step=chosen)
+            return out
+
+    def verify_step(self, step: int | None = None, *, best: bool = False) -> bool:
+        """Audit one step's shard files against the manifest crc32s.
+
+        Reads every shard byte once and recomputes the checksums (an
+        explicit integrity audit — e.g. before promoting a checkpoint or
+        after copying it across storage tiers). Records a ``ckpt.verify``
+        event with the outcome plus one ``ckpt.corrupt`` event per bad
+        shard. Orbax-format steps and shards saved before integrity
+        stamping verify vacuously. Returns True when every checked shard
+        matches."""
+        from tpuflow.ckpt import raw as raw_fmt
+
+        chosen = self._resolve_step(step, best)
+        checked, bad = raw_fmt.verify_dir(
+            os.path.join(self._step_dir(chosen), _STATE_DIR)
+        )
+        obs.event(
+            "ckpt.verify", step=chosen, shards=checked, ok=not bad
+        )
+        for fname in bad:
+            obs.event("ckpt.corrupt", step=chosen, file=fname)
+        return not bad
 
     def restore_metadata(self, step: int | None = None, *, best: bool = False) -> dict:
         chosen = self._resolve_step(step, best)
@@ -706,14 +767,22 @@ def restore_from_handle(
     """Restore state from a flow-level ``Checkpoint`` handle (see
     ``_restore_from_handle_inner`` for semantics). Records one
     ``ckpt.restore`` telemetry span around the restore when obs is on."""
+    from tpuflow.ckpt import raw as raw_fmt
+
     t0, ts0 = time.monotonic(), time.time()
-    out = _restore_from_handle_inner(
-        checkpoint,
-        abstract_state=abstract_state,
-        weights_only=weights_only,
-        subtree=subtree,
-        zero_copy=zero_copy,
-    )
+    try:
+        out = _restore_from_handle_inner(
+            checkpoint,
+            abstract_state=abstract_state,
+            weights_only=weights_only,
+            subtree=subtree,
+            zero_copy=zero_copy,
+        )
+    except raw_fmt.CorruptShardError as e:
+        # A handle pins ONE checkpoint — there is no previous step to fall
+        # back to; record the corruption and let the error propagate.
+        obs.event("ckpt.corrupt", error=str(e)[:300])
+        raise
     if obs.enabled():
         acct_subtree = subtree or (("params",) if weights_only else None)
         _record_restore(

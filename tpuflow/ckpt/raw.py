@@ -31,6 +31,7 @@ import json
 import os
 import threading
 import weakref
+import zlib
 from typing import Any
 
 import jax
@@ -40,6 +41,44 @@ from tpuflow import _native
 
 MANIFEST = "manifest.json"
 FORMAT_NAME = "tpuflow-raw-v2"
+
+
+class CorruptShardError(RuntimeError):
+    """A shard file's bytes do not match the manifest (crc32 mismatch or
+    truncation). Raised by restore-side verification so corrupted weights
+    are never silently returned; the CheckpointManager catches it to fall
+    back to the previous committed step."""
+
+
+def _verify_enabled() -> bool:
+    """Restore-side integrity verification (per-shard crc32 recorded in
+    the manifest at save). On by default; ``TPUFLOW_CKPT_VERIFY=0`` opts
+    out (e.g. to reclaim the checksum pass on trusted local storage or to
+    keep zero-copy restores from touching every page)."""
+    return os.environ.get("TPUFLOW_CKPT_VERIFY", "1") not in ("0", "false")
+
+
+def _crc32(arr: np.ndarray) -> int:
+    a = np.ascontiguousarray(arr)
+    try:
+        buf = memoryview(a).cast("B")
+    except (TypeError, ValueError):
+        buf = a.tobytes()  # extended dtypes without a buffer interface
+    return zlib.crc32(buf)
+
+
+def _check_shard_bytes(path: str, shard: dict, buf, nbytes: int) -> None:
+    """Compare just-read shard bytes against the manifest record; shards
+    saved before integrity stamping (no ``crc32`` key) pass vacuously."""
+    want = shard.get("crc32")
+    if want is None:
+        return
+    got = zlib.crc32(buf)
+    if got != int(want):
+        raise CorruptShardError(
+            f"{path}: crc32 mismatch (manifest {int(want)}, file {got}, "
+            f"{nbytes} bytes) — shard corrupted on storage"
+        )
 
 # (st_dev, st_ino) -> live-mapping refcount for shard files whose mapped
 # pages escaped to a caller via zero_copy restore in this process: live
@@ -618,14 +657,20 @@ def _gather_host(tree):
 def _write_one(directory: str, fname: str, arr, pool: RecyclePool | None) -> None:
     dst = os.path.join(directory, fname)
     recycled = pool.take(arr.nbytes) if pool is not None else None
+    written = False
     if recycled is not None:
         try:
             os.rename(recycled, dst)
             _native.write_bytes(dst, arr, inplace=True)
-            return
+            written = True
         except OSError:
             pass  # fall through to a fresh write
-    _native.write_bytes(dst, arr)
+    if not written:
+        _native.write_bytes(dst, arr)
+    if os.environ.get("TPUFLOW_FAULT"):
+        from tpuflow.testing import faults
+
+        faults.corrupt_after_write(dst)
 
 
 def _fs_is_memory_backed(path: str) -> bool:
@@ -679,7 +724,16 @@ def _write_entries(
             fname = f"leaf_{i:05d}_{coord}.bin"
             jobs.append((fname, arr))
             entry["shards"].append(
-                {"file": fname, "start": starts, "shape": list(arr.shape)}
+                {
+                    "file": fname,
+                    "start": starts,
+                    "shape": list(arr.shape),
+                    # Content-integrity stamp, verified on restore
+                    # (_check_shard_bytes). Computed here — on the async
+                    # saver's thread — so the checksum pass never lands on
+                    # the training critical path.
+                    "crc32": _crc32(arr),
+                }
             )
         manifest["leaves"].append(entry)
     width = int(os.environ.get("TPUFLOW_WRITE_CONCURRENCY", "0")) or (
@@ -836,6 +890,47 @@ def manifest_shard_sizes(
     return sizes
 
 
+def verify_dir(directory: str) -> tuple[int, list[str]]:
+    """Recompute every shard file's crc32 against the manifest.
+
+    Returns ``(shards_checked, bad_files)``. Shards without a recorded
+    crc32 (checkpoints saved before integrity stamping) are skipped, and a
+    non-raw directory checks nothing — both verify vacuously. Reads every
+    byte once: an explicit audit, independent of the restore-time
+    ``TPUFLOW_CKPT_VERIFY`` setting.
+    """
+    if not is_raw(directory):
+        return 0, []
+    manifest = _read_manifest(directory)
+    checked = 0
+    bad: list[str] = []
+    seen: set[str] = set()
+    for entry in manifest["leaves"]:
+        dtype = np.dtype(entry["dtype"])
+        for shard in entry["shards"]:
+            fname = shard["file"]
+            if fname in seen or shard.get("crc32") is None:
+                continue
+            seen.add(fname)
+            checked += 1
+            nbytes = (
+                int(np.prod(shard["shape"])) * dtype.itemsize
+                if shard["shape"]
+                else dtype.itemsize
+            )
+            try:
+                with open(os.path.join(directory, fname), "rb") as f:
+                    data = f.read()
+            except OSError:
+                bad.append(fname)
+                continue
+            if len(data) < nbytes or zlib.crc32(data[:nbytes]) != int(
+                shard["crc32"]
+            ):
+                bad.append(fname)
+    return checked, bad
+
+
 def is_raw(directory: str) -> bool:
     return os.path.exists(os.path.join(directory, MANIFEST))
 
@@ -865,6 +960,19 @@ def _read_shard(
     """
     nbytes = int(np.prod(shard["shape"]) * dtype.itemsize) if shard["shape"] else dtype.itemsize
     path = os.path.join(directory, shard["file"])
+    verify = _verify_enabled() and shard.get("crc32") is not None
+    if verify:
+        # Truncation pre-check: a torn/short file must fail loudly here,
+        # not as an opaque native-reader error (or worse, garbage bytes).
+        try:
+            size = os.path.getsize(path)
+        except OSError as e:
+            raise CorruptShardError(f"{path}: unreadable shard ({e})") from e
+        if size < nbytes:
+            raise CorruptShardError(
+                f"{path}: truncated shard ({size} bytes, manifest expects "
+                f"{nbytes})"
+            )
     if _mmap_enabled() if allow_mmap is None else allow_mmap:
         # Zero-copy: map the file's pages instead of reading into a fresh
         # buffer (copy-on-write so callers get a writable array without
@@ -906,12 +1014,18 @@ def _read_shard(
         else:
             if key is not None:
                 weakref.finalize(flat, _unregister_alias, key)
+            if verify:
+                # Forces the mapped pages in — the price of verifying a
+                # zero-copy restore; TPUFLOW_CKPT_VERIFY=0 keeps it lazy.
+                _check_shard_bytes(path, shard, flat, nbytes)
             return flat.view(dtype).reshape(shard["shape"])
     # Escaping reads draw their destination from the restore arena when a
     # pre-backed buffer of this exact size is available (transient reads —
     # escapes=False, copied into a full-leaf buffer — must not consume them).
     out = _ARENA.take(nbytes) if escapes else None
     buf = _native.read_bytes(path, nbytes, threads=threads, out=out)
+    if verify:
+        _check_shard_bytes(path, shard, buf, nbytes)
     return buf.view(dtype).reshape(shard["shape"])
 
 

@@ -635,7 +635,22 @@ def replicate(tree, mesh: Mesh):
     """Place a pytree fully-replicated on the mesh (parity: DDP's replicated
     params + rank-0 broadcast at wrap time, reference my_ray_module.py:135).
     Also normalizes mixed/committed device placements after a restore."""
-    return jax.device_put(tree, replicated(mesh))
+    sharding = replicated(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    # Multi-host: device_put rejects shardings that span non-addressable
+    # (remote-host) devices. Host leaves become global replicated arrays
+    # from the identical per-process copies (same mechanism shard_batch
+    # uses for scalar leaves); already-global arrays — e.g. a multi-host
+    # restore's output — reshard through a jitted identity, which XLA
+    # lowers to whatever collective the move needs.
+    def place(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return jax.jit(lambda a: a, out_shardings=sharding)(x)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(place, tree)
 
 
 def serialize_steps() -> bool:

@@ -13,31 +13,54 @@ from __future__ import annotations
 from typing import Callable
 
 
-def retry(times: int = 3):
+def retry(
+    times: int = 3, backoff_s: float = 2.0, max_backoff_s: float = 60.0
+):
     """Step-level retry (↔ @retry(times=3), train_flow.py:41): a failed step
     reruns up to ``times`` extra attempts; combined with in-run checkpoint
-    resume this bounds lost work to one epoch (SURVEY.md §5)."""
+    resume this bounds lost work to one epoch (SURVEY.md §5).
+
+    Between attempts the runner sleeps an exponentially growing, jittered
+    delay: attempt ``n`` waits ``min(max_backoff_s, backoff_s * 2**(n-1))``
+    scaled by a uniform 0.5–1.0 jitter, so a gang of retrying flows does
+    not stampede shared storage or the rendezvous coordinator. Preemption
+    requeues (a member exiting with the requeue code) rerun the step
+    WITHOUT consuming ``times`` — see tpuflow.utils.preempt."""
 
     def wrap(fn: Callable) -> Callable:
         fn.__retry_times__ = times
+        fn.__retry_backoff_s__ = backoff_s
+        fn.__retry_max_backoff_s__ = max_backoff_s
         return fn
 
     return wrap
 
 
-def tpu(num_parallel: int | None = None, all_hosts_started_timeout: float = 300.0):
+def tpu(
+    num_parallel: int | None = None,
+    all_hosts_started_timeout: float = 300.0,
+    heartbeat_timeout: float | None = None,
+):
     """Gang step (↔ @metaflow_ray(all_nodes_started_timeout=60*5),
     train_flow.py:42): the step body runs as a gang of processes forming one
     ``jax.distributed`` world — process 0 is the head, and only the head's
     artifacts persist (the join step tolerates headless inputs exactly like
     train_flow.py:85-88). Locally the gang is simulated with N host processes
     on CPU devices; on a real pod slice each host runs the same step and the
-    rendezvous happens over DCN."""
+    rendezvous happens over DCN.
+
+    ``heartbeat_timeout``: a member whose heartbeat file (stamped at
+    rendezvous and every fenced train step/report, tpuflow.utils.heartbeat)
+    goes silent for this many seconds is treated as hung and the gang is
+    killed promptly — well inside the flat rendezvous deadline. ``None``
+    falls back to ``TPUFLOW_STALL_TIMEOUT_S`` (default 600). Members that
+    never stamp are never judged."""
 
     def wrap(fn: Callable) -> Callable:
         fn.__gang__ = {
             "num_parallel": num_parallel,
             "timeout": all_hosts_started_timeout,
+            "heartbeat_timeout": heartbeat_timeout,
         }
         return fn
 

@@ -29,6 +29,27 @@ from __future__ import annotations
 import os
 from typing import Any
 
+from tpuflow.utils.preempt import REQUEUE_EXIT_CODE
+
+
+def _requeue_pod_failure_policy() -> dict:
+    """Preemption parity with the local supervisor: a member that drained
+    and exited with the requeue code must rerun WITHOUT consuming the
+    Job's ``backoffLimit`` (= the @retry budget), exactly like
+    runner.StepPreempted locally. ``Ignore`` makes Kubernetes recreate the
+    pod without counting the failure."""
+    return {
+        "rules": [
+            {
+                "action": "Ignore",
+                "onExitCodes": {
+                    "operator": "In",
+                    "values": [REQUEUE_EXIT_CODE],
+                },
+            }
+        ]
+    }
+
 # chips per host and default 2-D ICI topology per v5e/v6e slice size; v4/v5p
 # use 4-chip hosts with 3-D topologies (coarse entries for the common ones).
 _TPU_SLICES: dict[str, dict[int, str]] = {
@@ -189,11 +210,20 @@ def _gang_jobset(
                             "backoffLimit": int(
                                 getattr(step_fn, "__retry_times__", 0)
                             ),
+                            "podFailurePolicy": _requeue_pod_failure_policy(),
                             "completionMode": "Indexed",
                             "template": {
                                 "spec": {
                                     "nodeSelector": node_selector,
                                     "restartPolicy": "Never",
+                                    # Preemption grace mirrors the gang
+                                    # rendezvous budget: SIGTERM → drain a
+                                    # final checkpoint → requeue exit, with
+                                    # at least as long as members wait for
+                                    # each other before the SIGKILL.
+                                    "terminationGracePeriodSeconds": int(
+                                        gang.get("timeout", 300.0) or 300
+                                    ),
                                     "containers": [container],
                                 }
                             },
@@ -235,6 +265,7 @@ def _plain_job(
         "metadata": {"name": name},
         "spec": {
             "backoffLimit": int(getattr(step_fn, "__retry_times__", 0)),
+            "podFailurePolicy": _requeue_pod_failure_policy(),
             "template": {"spec": spec},
         },
     }

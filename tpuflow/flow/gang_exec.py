@@ -60,10 +60,16 @@ def _store_artifacts(flow_name: str, run_id: str, step_name: str) -> dict:
     for root, _dirs, files in os.walk(rd):
         if "artifacts.json" not in files:
             continue
+        # Only COMMITTED artifact saves count: the marker is written
+        # strictly after artifacts.json + blobs (store.save_artifacts), so
+        # a task that crashed mid-save — a failed attempt's partial
+        # artifacts — can never be resurrected here by winning on mtime.
+        if "artifacts.ok" not in files:
+            continue
         parts = root.rstrip(os.sep).split(os.sep)
         if len(parts) < 2 or parts[-2] == step_name:
             continue  # not a task dir / the step being (re)run
-        mtime = os.path.getmtime(os.path.join(root, "artifacts.json"))
+        mtime = os.path.getmtime(os.path.join(root, "artifacts.ok"))
         if best is None or mtime > best[0]:
             best = (mtime, parts[-2], parts[-1])
     if best is None:
@@ -73,6 +79,21 @@ def _store_artifacts(flow_name: str, run_id: str, step_name: str) -> dict:
 
 def main(argv: list[str]) -> None:
     flow_file, class_name, step_name, run_id, task_id, state_path = argv
+    # Preemption contract: SIGTERM (from the infrastructure, or from the
+    # supervisor's grace-kill of a gang whose peer died) only SETS A FLAG;
+    # the train loops check it at step boundaries, drain + commit a final
+    # checkpoint, and raise Preempted — converted below into the requeue
+    # exit code the supervisor treats as retry-without-budget.
+    from tpuflow.utils.preempt import (
+        REQUEUE_EXIT_CODE,
+        Preempted,
+        install_sigterm_handler,
+    )
+
+    install_sigterm_handler()
+    from tpuflow.testing import faults
+
+    faults.maybe_rendezvous_delay()
     _bootstrap_jax()
 
     spec = importlib.util.spec_from_file_location("_tpuflow_gang_flow", flow_file)
@@ -95,6 +116,12 @@ def main(argv: list[str]) -> None:
 
     timeout = float(os.environ.get("TPUFLOW_GANG_TIMEOUT", "300"))
     dist.initialize(timeout_s=timeout)
+    # Deliberately NO heartbeat here: the first stamp comes from the train
+    # loops (fenced steps / reports), so only members that demonstrably
+    # adopted the protocol are ever judged for staleness — an arbitrary
+    # quiet step body must not be reaped by the default stall timeout.
+    # (A member hung in rendezvous itself is bounded by dist.initialize's
+    # own timeout, which exits non-zero → supervisor fail-fast.)
 
     import jax
 
@@ -119,13 +146,23 @@ def main(argv: list[str]) -> None:
     from tpuflow import obs
 
     fn = flow_cls.steps()[step_name]
-    with obs.span(
-        "flow.gang_member",
-        step=step_name,
-        gang_index=jax.process_index(),
-        gang_size=jax.process_count(),
-    ):
-        fn(flow)
+    try:
+        with obs.span(
+            "flow.gang_member",
+            step=step_name,
+            gang_index=jax.process_index(),
+            gang_size=jax.process_count(),
+        ):
+            fn(flow)
+    except Preempted as e:
+        # The loop already drained and committed its final checkpoint;
+        # exit with the requeue code — os._exit, because surviving this
+        # far with a possibly-dead peer means the shutdown barrier below
+        # could hang until the collective timeout.
+        print(f"[tpuflow] gang member preempted, requeueing: {e}")
+        obs.flush()
+        sys.stdout.flush()
+        os._exit(REQUEUE_EXIT_CODE)
     obs.flush()
 
     # Every member persists its own artifacts; the head's land at the gang

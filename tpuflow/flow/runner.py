@@ -17,6 +17,8 @@ import inspect
 import json
 import os
 import pickle
+import random
+import signal
 import socket
 import subprocess
 import sys
@@ -30,10 +32,34 @@ from tpuflow.flow import store
 from tpuflow.flow.cards import CardBuffer
 from tpuflow.flow.client import Run
 from tpuflow.flow.spec import FlowSpec, current
+from tpuflow.utils.preempt import REQUEUE_EXIT_CODE
 
 
 class StepFailed(Exception):
     pass
+
+
+class StepPreempted(StepFailed):
+    """A gang member exited with the requeue code (preemption drain): the
+    step should rerun without consuming the @retry budget."""
+
+
+# Injectable time sources: tests pin the jitter and capture the sleeps so
+# backoff behavior is provable without real waiting (tier-1 has no sleeps).
+_sleep = time.sleep
+_random = random.random
+
+# Supervisor poll cadence: bounds added per-gang-step latency while keeping
+# fail-fast reaction in tens of milliseconds.
+_GANG_POLL_S = 0.05
+
+
+def _backoff_delay(
+    attempt: int, backoff_s: float, max_backoff_s: float
+) -> float:
+    """Exponential backoff with 0.5–1.0 jitter for retry ``attempt`` (1-based)."""
+    base = min(max_backoff_s, backoff_s * (2.0 ** (attempt - 1)))
+    return base * (0.5 + 0.5 * _random())
 
 
 class _GangInput:
@@ -236,7 +262,13 @@ class FlowRunner:
                 object.__setattr__(flow, "_next", None)
 
                 retries = getattr(fn, "__retry_times__", 0)
+                backoff_s = getattr(fn, "__retry_backoff_s__", 2.0)
+                max_backoff_s = getattr(fn, "__retry_max_backoff_s__", 60.0)
                 attempt = 0
+                requeues = 0
+                max_requeues = int(
+                    os.environ.get("TPUFLOW_MAX_REQUEUES", "8")
+                )
                 while True:
                     try:
                         with obs.span(
@@ -248,6 +280,10 @@ class FlowRunner:
                                     flow, step_name, run_id, task_id,
                                     num_parallel,
                                     timeout=(gang or {}).get("timeout", 300.0),
+                                    stall_timeout=(gang or {}).get(
+                                        "heartbeat_timeout"
+                                    ),
+                                    attempt=attempt + requeues,
                                 )
                             else:
                                 self._exec_local(
@@ -260,17 +296,39 @@ class FlowRunner:
                                     _GangInput(dict(flow._artifacts))
                                 ]
                         break
+                    except StepPreempted:
+                        # Preemption is routine, not a failure: the member
+                        # drained a checkpoint and asked to be requeued, so
+                        # the rerun does not consume the retry budget. A cap
+                        # bounds pathological preemption storms.
+                        requeues += 1
+                        if requeues > max_requeues:
+                            raise
+                        print(
+                            f"[tpuflow] step {step_name} preempted "
+                            f"(requeue {requeues}/{max_requeues}), "
+                            "relaunching without consuming retry budget"
+                        )
                     except Exception:
                         attempt += 1
                         if attempt > retries:
                             raise
                         obs.counter("flow.retry", step=step_name,
                                     attempt=attempt)
+                        delay = _backoff_delay(
+                            attempt, backoff_s, max_backoff_s
+                        )
+                        obs.gauge(
+                            "flow.retry_backoff_s", delay, step=step_name,
+                            attempt=attempt,
+                        )
                         print(
                             f"[tpuflow] step {step_name} failed "
-                            f"(attempt {attempt}/{retries}), retrying:\n"
+                            f"(attempt {attempt}/{retries}), retrying in "
+                            f"{delay:.1f}s:\n"
                             f"{traceback.format_exc(limit=3)}"
                         )
+                        _sleep(delay)
 
                 meta["steps"].append(
                     {"step": step_name, "head_task": task_id, "tasks": num_parallel}
@@ -418,9 +476,14 @@ class FlowRunner:
         num_parallel: int,
         *,
         timeout: float,
+        stall_timeout: float | None = None,
+        attempt: int = 0,
     ) -> list[_GangInput]:
         """Launch N processes running the step body as one jax.distributed
-        world (local simulation of the pod-slice gang, SURVEY.md §2b D8)."""
+        world (local simulation of the pod-slice gang, SURVEY.md §2b D8),
+        then supervise them: fail fast on the first non-zero exit, detect
+        hung members via heartbeat staleness, and classify requeue exits
+        (preemption drains) separately from crashes."""
         tdir = store.task_dir(self.flow_name, run_id, step_name, task_id)
         os.makedirs(tdir, exist_ok=True)
         state_path = os.path.join(tdir, "gang_state.pkl")
@@ -434,61 +497,84 @@ class FlowRunner:
                 {"artifacts": flow._artifacts, "module": self._flow_module()}, f
             )
         port = _free_port()
-        procs = []
+        procs: list[tuple[subprocess.Popen, Any]] = []
         import tpuflow
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(tpuflow.__file__)))
-        for i in range(num_parallel):
-            env = dict(os.environ)
-            env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-            env.update(
-                TPUFLOW_NUM_PROCESSES=str(num_parallel),
-                TPUFLOW_PROCESS_ID=str(i),
-                TPUFLOW_COORDINATOR=f"127.0.0.1:{port}",
-                TPUFLOW_GANG_TIMEOUT=str(timeout),
-                TPUFLOW_FORCE_CPU=env_force_cpu(),
-            )
-            if getattr(self, "_obs_dir", None):
-                # Each member records its own events.p<i>.jsonl in the
-                # run's obs dir; the end-of-run merge unions them.
-                env["TPUFLOW_OBS_DIR"] = self._obs_dir
-                env["TPUFLOW_OBS_PROC"] = str(i)
-            cmd = [
-                sys.executable,
-                "-m",
-                "tpuflow.flow.gang_exec",
-                self._flow_module(),
-                self.flow_cls.__name__,
-                step_name,
-                str(run_id),
-                str(task_id + i),
-                state_path,
-            ]
-            log = open(os.path.join(tdir, f"gang_{i}.log"), "w")
-            procs.append(
-                (
-                    subprocess.Popen(
+        launched = False
+        try:
+            for i in range(num_parallel):
+                # Stale heartbeats from a previous attempt would read as an
+                # instant stall — clear before every launch.
+                hb_path = os.path.join(tdir, f"heartbeat_{i}")
+                try:
+                    os.unlink(hb_path)
+                except FileNotFoundError:
+                    pass
+                env = dict(os.environ)
+                env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+                env.update(
+                    TPUFLOW_NUM_PROCESSES=str(num_parallel),
+                    TPUFLOW_PROCESS_ID=str(i),
+                    TPUFLOW_COORDINATOR=f"127.0.0.1:{port}",
+                    TPUFLOW_GANG_TIMEOUT=str(timeout),
+                    TPUFLOW_FORCE_CPU=env_force_cpu(),
+                    TPUFLOW_ATTEMPT=str(attempt),
+                    TPUFLOW_HEARTBEAT_FILE=hb_path,
+                )
+                if getattr(self, "_obs_dir", None):
+                    # Each member records its own events.p<i>.jsonl in the
+                    # run's obs dir; the end-of-run merge unions them.
+                    env["TPUFLOW_OBS_DIR"] = self._obs_dir
+                    env["TPUFLOW_OBS_PROC"] = str(i)
+                cmd = [
+                    sys.executable,
+                    "-m",
+                    "tpuflow.flow.gang_exec",
+                    self._flow_module(),
+                    self.flow_cls.__name__,
+                    step_name,
+                    str(run_id),
+                    str(task_id + i),
+                    state_path,
+                ]
+                log = open(os.path.join(tdir, f"gang_{i}.log"), "w")
+                try:
+                    p = subprocess.Popen(
                         cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
                         cwd=os.getcwd(),
-                    ),
-                    log,
-                )
-            )
-        deadline = time.time() + timeout + 600
-        failed = False
+                    )
+                except BaseException:
+                    log.close()
+                    raise
+                procs.append((p, log))
+            launched = True
+        finally:
+            if not launched:
+                # A mid-loop launch failure must not leak already-spawned
+                # members or their open log files.
+                for p, log in procs:
+                    try:
+                        p.kill()
+                        p.wait(timeout=10)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                    log.close()
         with obs.span(
             "flow.gang", step=step_name, num_parallel=num_parallel
         ) as gang_span:
-            for p, log in procs:
-                try:
-                    rc = p.wait(timeout=max(deadline - time.time(), 1))
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    rc = -9
-                log.close()
-                failed = failed or rc != 0
-            gang_span.set(failed=failed)
-        if failed:
+            failure = self._supervise_gang(
+                procs, tdir, step_name,
+                timeout=timeout, stall_timeout=stall_timeout,
+            )
+            gang_span.set(failed=failure is not None)
+        if failure is not None:
+            kind, member, detail = failure
+            if kind == "preempt":
+                raise StepPreempted(
+                    f"gang step {step_name!r} preempted (member {member} "
+                    f"exited with requeue code {REQUEUE_EXIT_CODE})"
+                )
             logs = []
             for i in range(num_parallel):
                 lp = os.path.join(tdir, f"gang_{i}.log")
@@ -497,7 +583,8 @@ class FlowRunner:
                         tail = f.read()[-2000:]
                     logs.append(f"--- gang member {i} ---\n{tail}")
             raise StepFailed(
-                f"gang step {step_name!r} failed:\n" + "\n".join(logs)
+                f"gang step {step_name!r} failed ({detail}):\n"
+                + "\n".join(logs)
             )
         # Load head artifacts back into the in-process flow to continue.
         head_artifacts = store.load_artifacts(
@@ -518,6 +605,143 @@ class FlowRunner:
             )
             inputs.append(_GangInput(arts))
         return inputs
+
+    def _supervise_gang(
+        self,
+        procs: list,
+        tdir: str,
+        step_name: str,
+        *,
+        timeout: float,
+        stall_timeout: float | None,
+    ):
+        """Poll all gang members until they all exit cleanly or one fails.
+
+        Replaces the old sequential ``p.wait()`` join, whose worst case was
+        every surviving peer hanging in a dead collective until the flat
+        ``timeout + 600`` deadline. Here the first non-zero exit (or a
+        heartbeat stall) kills the survivors promptly — SIGTERM (so they
+        can drain a checkpoint) escalating to SIGKILL after
+        ``TPUFLOW_KILL_GRACE_S``.
+
+        Returns ``None`` on success or ``(kind, member, detail)`` where
+        kind ∈ {"member_failed", "heartbeat_stall", "timeout", "preempt"}.
+        """
+        if stall_timeout is None:
+            stall_timeout = float(
+                os.environ.get("TPUFLOW_STALL_TIMEOUT_S", "600")
+            )
+        deadline = time.monotonic() + timeout + 600.0
+        n = len(procs)
+        rcs: list[int | None] = [None] * n
+        failure = None
+        try:
+            while any(rc is None for rc in rcs):
+                for i, (p, log) in enumerate(procs):
+                    if rcs[i] is not None:
+                        continue
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    rcs[i] = rc
+                    log.close()
+                    if rc != 0 and failure is None:
+                        if rc == REQUEUE_EXIT_CODE:
+                            failure = ("preempt", i, "requeue")
+                            obs.event(
+                                "flow.preempt", step=step_name, member=i
+                            )
+                        else:
+                            failure = (
+                                "member_failed", i, f"member {i} exited {rc}"
+                            )
+                            obs.event(
+                                "flow.member_failed", step=step_name,
+                                member=i, rc=rc,
+                                log_tail=self._log_tail(tdir, i),
+                            )
+                if failure is not None:
+                    break
+                if stall_timeout and stall_timeout > 0:
+                    # Judge only members that ever stamped: arbitrary step
+                    # bodies owe no heartbeats. The member with the OLDEST
+                    # stamp is the culprit — its peers went silent later,
+                    # blocked in collectives waiting for it.
+                    now = time.time()
+                    stalled: list[tuple[float, int]] = []
+                    for i, (p, _log) in enumerate(procs):
+                        if rcs[i] is not None:
+                            continue
+                        try:
+                            age = now - os.path.getmtime(
+                                os.path.join(tdir, f"heartbeat_{i}")
+                            )
+                        except OSError:
+                            continue
+                        if age > stall_timeout:
+                            stalled.append((age, i))
+                    if stalled:
+                        age, culprit = max(stalled)
+                        failure = (
+                            "heartbeat_stall", culprit,
+                            f"member {culprit} heartbeat stalled "
+                            f"{age:.1f}s (> {stall_timeout:.0f}s)",
+                        )
+                        obs.event(
+                            "flow.heartbeat_stall", step=step_name,
+                            member=culprit, age_s=round(age, 2),
+                            log_tail=self._log_tail(tdir, culprit),
+                        )
+                        break
+                if time.monotonic() > deadline:
+                    failure = (
+                        "timeout", None,
+                        f"gang deadline exceeded ({timeout:.0f}s + 600s)",
+                    )
+                    break
+                time.sleep(_GANG_POLL_S)
+        finally:
+            if failure is not None or any(rc is None for rc in rcs):
+                self._kill_survivors(procs, rcs)
+            for _p, log in procs:
+                log.close()  # idempotent
+        return failure
+
+    @staticmethod
+    def _log_tail(tdir: str, member: int, limit: int = 500) -> str:
+        try:
+            with open(os.path.join(tdir, f"gang_{member}.log")) as f:
+                return f.read()[-limit:]
+        except OSError:
+            return ""
+
+    @staticmethod
+    def _kill_survivors(procs: list, rcs: list) -> None:
+        """SIGTERM surviving members (their preemption handler drains a
+        final checkpoint), escalate to SIGKILL after the grace window."""
+        grace = float(os.environ.get("TPUFLOW_KILL_GRACE_S", "5"))
+        live = [i for i, rc in enumerate(rcs) if rc is None]
+        for i in live:
+            try:
+                procs[i][0].send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        t_end = time.monotonic() + grace
+        while live and time.monotonic() < t_end:
+            live = [i for i in live if procs[i][0].poll() is None]
+            if live:
+                time.sleep(_GANG_POLL_S)
+        for i in live:
+            try:
+                procs[i][0].kill()
+            except OSError:
+                pass
+        for i, rc in enumerate(rcs):
+            if rc is None:
+                try:
+                    rcs[i] = procs[i][0].wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    rcs[i] = -9
 
     def _flow_module(self) -> str:
         mod = inspect.getmodule(self.flow_cls)

@@ -167,6 +167,20 @@ def save_artifacts(
     encoded = {k: _encode(k, v, d) for k, v in artifacts.items()}
     with open(os.path.join(d, "artifacts.json"), "w") as f:
         json.dump(encoded, f, indent=1)
+    # Commit marker, written strictly AFTER artifacts.json and its blobs:
+    # a task that died mid-save leaves an unmarked dir, which the
+    # store-sourced artifact scan (gang_exec._store_artifacts) ignores —
+    # a failed attempt's partial artifacts are never resurrected. The
+    # launch attempt (TPUFLOW_ATTEMPT, stamped by the gang launcher) rides
+    # along for diagnosis of which attempt produced the bytes.
+    marker = {
+        "attempt": int(os.environ.get("TPUFLOW_ATTEMPT", "0") or 0),
+        "ts": time.time(),
+    }
+    tmp = os.path.join(d, "artifacts.ok.tmp")
+    with open(tmp, "w") as f:
+        json.dump(marker, f)
+    os.replace(tmp, os.path.join(d, "artifacts.ok"))
 
 
 def load_artifacts(flow: str, run_id, step: str, task_id: int) -> dict[str, Any]:

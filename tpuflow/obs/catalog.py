@@ -28,6 +28,25 @@ CATALOG: dict[str, tuple[str, str]] = {
     "flow.gang": ("span", "gang execution: members launched → all joined"),
     "flow.gang_member": ("span", "one gang member process's step body"),
     "flow.retry": ("counter", "step attempts that failed and were retried"),
+    "flow.retry_backoff_s": (
+        "gauge",
+        "jittered exponential backoff slept before a retry attempt",
+    ),
+    "flow.member_failed": (
+        "event",
+        "gang supervisor: first non-zero member exit (member, rc, log "
+        "tail); surviving peers are killed promptly",
+    ),
+    "flow.heartbeat_stall": (
+        "event",
+        "gang supervisor: a member's heartbeat went silent past the stall "
+        "timeout (member, age_s); the gang is killed",
+    ),
+    "flow.preempt": (
+        "event",
+        "a gang member exited with the requeue code after a preemption "
+        "drain; the step reruns without consuming the retry budget",
+    ),
     "flow.card_render": ("span", "card HTML render at step completion"),
     # --------------------------------------------------------------- train
     "train.fit": ("span", "Trainer.fit: mesh build + worker loop + drain"),
@@ -40,6 +59,16 @@ CATALOG: dict[str, tuple[str, str]] = {
     # ---------------------------------------------------------------- ckpt
     "ckpt.save": ("span", "checkpoint save, save() → commit; bytes + gbps"),
     "ckpt.restore": ("span", "checkpoint restore; bytes + gbps when known"),
+    "ckpt.verify": (
+        "event",
+        "explicit integrity audit of one step (verify_step): shard count "
+        "checked + outcome",
+    ),
+    "ckpt.corrupt": (
+        "event",
+        "a shard failed crc32/truncation verification; restore fell back "
+        "to the previous committed step or raised — never silent",
+    ),
     # ---------------------------------------------------------------- data
     "data.batch_wait_s": ("histogram", "time the consumer blocked per batch"),
     "data.prefetch_hit": ("counter", "batches ready with no consumer wait"),

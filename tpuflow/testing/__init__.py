@@ -1,0 +1,12 @@
+"""Test-support subpackage: fault injection for the failure paths.
+
+``tpuflow.testing.faults`` is shipped (not test-only) because the hooks
+must live inside the production code paths they exercise — gang exec, the
+train loops, the raw checkpoint saver — and activate purely from the
+``TPUFLOW_FAULT`` environment variable, so chaos tests drive real
+subprocess gangs with no monkeypatching across process boundaries.
+"""
+
+from tpuflow.testing import faults
+
+__all__ = ["faults"]

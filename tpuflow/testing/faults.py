@@ -1,0 +1,198 @@
+"""Env-driven fault injection: every failure path exercisable in tests.
+
+Activated by ``TPUFLOW_FAULT`` — a comma-separated list of fault specs
+that the gang launcher's environment propagates into every member
+process. Grammar (one spec per entry)::
+
+    member_exit:<rank>@step<k>   die (os._exit(1)) on member <rank> at the
+                                 end of train step/report <k>
+    preempt:<rank>@step<k>       set the preemption flag on member <rank>
+                                 at the end of step <k> (simulated SIGTERM:
+                                 the loop drains a checkpoint and exits
+                                 with the requeue code)
+    heartbeat_stall:<rank>       member <rank> stops stamping heartbeats
+                                 and hangs (simulated livelock) — the
+                                 supervisor must detect and kill it
+    rendezvous_delay:<seconds>[@<rank>]
+                                 sleep before joining the jax.distributed
+                                 rendezvous (all members, or just <rank>)
+    ckpt_truncate                truncate the first raw shard file written
+                                 after the spec activates (torn write)
+    ckpt_flip_byte               flip one byte in the first raw shard file
+                                 written after the spec activates (silent
+                                 storage corruption — caught by the
+                                 per-shard crc32 on restore)
+
+Hooks are threaded through gang exec (``maybe_rendezvous_delay``), the
+train loops (``step_boundary`` — called by ``TrainContext.report`` and
+the GPT epoch loops), the heartbeat stamp (``maybe_stall_heartbeat``) and
+the raw saver (``corrupt_after_write``). Every hook is a no-op costing
+one env lookup when ``TPUFLOW_FAULT`` is unset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    rank: int | None = None
+    step: int | None = None
+    value: float | None = None
+
+
+KINDS = (
+    "member_exit",
+    "preempt",
+    "heartbeat_stall",
+    "rendezvous_delay",
+    "ckpt_truncate",
+    "ckpt_flip_byte",
+)
+
+# Parse cache keyed on the raw env string (tests flip the env between
+# cases in one process); fired-once bookkeeping for the single-shot
+# checkpoint corruptions.
+_CACHE: tuple[str, list[Fault]] | None = None
+_FIRED: set[str] = set()
+
+
+def reset() -> None:
+    """Forget fired single-shot faults (test isolation within a process)."""
+    global _CACHE
+    _CACHE = None
+    _FIRED.clear()
+
+
+def parse(raw: str) -> list[Fault]:
+    out = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, payload = entry.partition(":")
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in TPUFLOW_FAULT={raw!r}; "
+                f"known: {KINDS}"
+            )
+        rank = step = value = None
+        if kind in ("member_exit", "preempt"):
+            rank_s, _, step_s = payload.partition("@")
+            rank = int(rank_s)
+            if not step_s.startswith("step"):
+                raise ValueError(
+                    f"{kind} spec needs '<rank>@step<k>', got {entry!r}"
+                )
+            step = int(step_s[len("step"):])
+        elif kind == "heartbeat_stall":
+            rank = int(payload)
+        elif kind == "rendezvous_delay":
+            secs_s, _, rank_s = payload.partition("@")
+            value = float(secs_s)
+            rank = int(rank_s) if rank_s else None
+        elif payload:
+            raise ValueError(f"fault {kind} takes no payload, got {entry!r}")
+        out.append(Fault(kind, rank=rank, step=step, value=value))
+    return out
+
+
+def _specs() -> list[Fault]:
+    global _CACHE
+    raw = os.environ.get("TPUFLOW_FAULT", "")
+    if not raw:
+        return []
+    if _CACHE is None or _CACHE[0] != raw:
+        _CACHE = (raw, parse(raw))
+    return _CACHE[1]
+
+
+def matching(kind: str) -> list[Fault]:
+    return [f for f in _specs() if f.kind == kind]
+
+
+def active(kind: str) -> Fault | None:
+    for f in _specs():
+        if f.kind == kind:
+            return f
+    return None
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("TPUFLOW_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+# ------------------------------------------------------------------ hooks
+def step_boundary(step: int) -> None:
+    """Train-loop hook: called after step/report ``step`` committed."""
+    if not os.environ.get("TPUFLOW_FAULT"):
+        return
+    rank = _rank()
+    for f in matching("preempt"):
+        if f.rank == rank and f.step == step:
+            from tpuflow.utils.preempt import request_preemption
+
+            print(
+                f"[faults] preempt injected at step {step}", file=sys.stderr
+            )
+            request_preemption()
+    for f in matching("member_exit"):
+        if f.rank == rank and f.step == step:
+            print(
+                f"[faults] member_exit injected at step {step}",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            os._exit(1)
+
+
+def maybe_rendezvous_delay() -> None:
+    """Gang-bootstrap hook: called before ``jax.distributed`` rendezvous."""
+    for f in matching("rendezvous_delay"):
+        if f.rank is None or f.rank == _rank():
+            time.sleep(f.value or 0.0)
+
+
+def maybe_stall_heartbeat() -> None:
+    """Heartbeat hook: a matching member hangs here (never stamps again),
+    simulating a livelocked process the supervisor must reap."""
+    for f in matching("heartbeat_stall"):
+        if f.rank == _rank():
+            print("[faults] heartbeat_stall: hanging", file=sys.stderr)
+            sys.stderr.flush()
+            time.sleep(3600.0)
+
+
+def corrupt_after_write(path: str) -> None:
+    """Raw-saver hook: single-shot corruption of the first shard written
+    after the spec activates (crc32 in the manifest was computed from the
+    in-memory bytes, so restore-side verification must catch this)."""
+    if not os.environ.get("TPUFLOW_FAULT"):
+        return
+    for kind in ("ckpt_truncate", "ckpt_flip_byte"):
+        if active(kind) is None or kind in _FIRED:
+            continue
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        if size == 0:
+            continue
+        _FIRED.add(kind)
+        if kind == "ckpt_truncate":
+            os.truncate(path, size // 2)
+        else:
+            with open(path, "r+b") as f:
+                f.seek(size // 2)
+                byte = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]))
+        print(f"[faults] {kind} applied to {path}", file=sys.stderr)

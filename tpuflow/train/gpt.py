@@ -21,8 +21,15 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 import time
 from typing import Any
+
+from tpuflow.utils.preempt import (
+    Preempted,
+    launch_attempt,
+    preemption_requested,
+)
 
 
 @dataclasses.dataclass
@@ -229,6 +236,17 @@ def _train_fsdp(
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
     with mesh:
+        mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
+        )
+        # In-run resume (retry / preemption requeue): a previous attempt of
+        # THIS run left committed checkpoints in ckpt_dir — continue from
+        # the newest instead of restarting at step 0 (the manager already
+        # rebuilt the full metrics history from it at construction). An
+        # explicit resume_checkpoint handle (cross-run --from-run) wins.
+        resume_step = (
+            mgr.latest_step() if resume_checkpoint is None else None
+        )
         t_phase = time.monotonic()
         state, shardings = create_sharded_state(
             init_fn,
@@ -244,16 +262,12 @@ def _train_fsdp(
             # materializing 355M random params + zeroed moments just to
             # overwrite every leaf with the restore doubled resume wall
             # time (MEDIUM_RUNS.md r3: fresh 103 s vs resume 206 s).
-            materialize=resume_checkpoint is None,
+            materialize=resume_checkpoint is None and resume_step is None,
         )
-        log(f"[gpt] state {'template' if resume_checkpoint is not None else 'init'}:"
+        resuming = resume_checkpoint is not None or resume_step is not None
+        log(f"[gpt] state {'template' if resuming else 'init'}:"
             f" {time.monotonic() - t_phase:.1f}s")
-        mgr = CheckpointManager(
-            ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
-        )
-        if resume_checkpoint is not None:
-            from tpuflow.ckpt import restore_from_handle
-
+        if resuming:
             # state IS the abstract template here (materialize=False
             # returns sharding-annotated ShapeDtypeStructs).
             tmpl = {
@@ -267,9 +281,16 @@ def _train_fsdp(
                 # leaf structure includes them).
                 tmpl["ema_params"] = state.params
             t_phase = time.monotonic()
-            restored = restore_from_handle(
-                resume_checkpoint, abstract_state=tmpl
-            )
+            if resume_checkpoint is not None:
+                from tpuflow.ckpt import restore_from_handle
+
+                restored = restore_from_handle(
+                    resume_checkpoint, abstract_state=tmpl
+                )
+            else:
+                # crc-verified; falls back to the previous committed step
+                # (with a ckpt.corrupt event) if the newest is damaged.
+                restored = mgr.restore(resume_step, abstract_state=tmpl)
             jax.block_until_ready(restored)
             # Direct construction — no init ran, there is no state to
             # .replace() over. batch_stats: GPT has none.
@@ -284,7 +305,8 @@ def _train_fsdp(
                 # restore errors on any structure mismatch).
                 ema_params=restored.get("ema_params", {}),
             )
-            log(f"[gpt] full sharded state restored:"
+            log(f"[gpt] full sharded state restored"
+                f"{' (in-run resume)' if resume_step is not None else ''}:"
                 f" {time.monotonic() - t_phase:.1f}s")
 
         loader, val_loader = _loaders(cfg, model_cfg.vocab_size)
@@ -306,14 +328,60 @@ def _train_fsdp(
         rng = jax.random.PRNGKey(1)
         history = []
         epoch_records = []
+        # In-run resume: seed the returned histories from the manager's
+        # rebuilt metrics history, so the result is continuous across the
+        # retry (no gap, no step-0 restart). Drain-only checkpoints (a
+        # preemption's final save carries no metrics) are skipped.
+        if resume_step is not None:
+            for m in mgr._metrics_history:
+                if "train_loss" not in m:
+                    continue
+                history.append(m["train_loss"])
+                epoch_records.append(
+                    {
+                        "epoch": len(epoch_records),
+                        "train_loss": m.get("train_loss"),
+                        "val_loss": m.get("val_loss"),
+                        "ppl": m.get("ppl"),
+                        "tokens_per_s": None,
+                    }
+                )
+        start_epoch = 0
+        if resume_step is not None:
+            start_epoch = min(
+                int(state.step) // cfg.steps_per_epoch, cfg.epochs
+            )
+            log(
+                f"[gpt] in-run resume from step {int(state.step)} "
+                f"→ epoch {start_epoch}"
+            )
+        opt_step = int(state.step)
         # Telemetry (tpuflow.obs): per-step wall times + tokens ride the
         # fences the loop already pays; batch-wait rides the loader
         # iterator. All no-ops when obs is disabled.
         from tpuflow import obs
         from tpuflow.train.step import StepClock
 
+        def drain_preempt() -> None:
+            # SIGTERM landed (or was injected): commit a final checkpoint
+            # at the current step and hand back Preempted — gang_exec
+            # converts it into the requeue exit code, and the supervisor
+            # reruns the step without consuming the retry budget.
+            payload = {
+                "step": state.step,
+                "params": state.params,
+                "opt_state": state.opt_state,
+            }
+            if cfg.ema_decay > 0.0:
+                payload["ema_params"] = state.ema_params
+            mgr.save(opt_step, payload, metrics={})
+            mgr.wait_until_finished()
+            mgr.close()
+            raise Preempted(f"drained checkpoint at step {opt_step}")
+
         clock = StepClock()
-        for epoch in range(cfg.epochs):
+        cold = True
+        for epoch in range(start_epoch, cfg.epochs):
             t_epoch = time.monotonic()
             ts_epoch = time.time()
             loader.set_epoch(epoch)
@@ -327,7 +395,7 @@ def _train_fsdp(
                 }
                 state, metrics = train_step(state, batch, rng)
                 losses.append(metrics["loss"])
-                if epoch == 0 and i == 0:
+                if cold:
                     # Fence out jit compilation so throughput numbers are
                     # comparable across epochs; the first batch's tokens
                     # are excluded from the rate accordingly.
@@ -335,10 +403,18 @@ def _train_fsdp(
                     t_epoch = time.monotonic()
                     ts_epoch = time.time()
                     clock.compile_done(preset=cfg.preset)
+                    cold = False
                 else:
                     dist.step_fence(metrics["loss"])
                     n_tokens += int(np.prod(b["y"].shape))
                     clock.step_done(tokens=int(np.prod(b["y"].shape)))
+                opt_step += 1
+                if os.environ.get("TPUFLOW_FAULT"):
+                    from tpuflow.testing import faults
+
+                    faults.step_boundary(opt_step)
+                if preemption_requested():
+                    drain_preempt()
             jax.block_until_ready(state.params)
             epoch_s = time.monotonic() - t_epoch
             tok_s = n_tokens / max(epoch_s, 1e-9) if n_tokens else None
@@ -394,6 +470,12 @@ def _train_fsdp(
                     "ppl": ppl,
                 },
             )
+            if launch_attempt() > 0:
+                # Retried attempt: commit eagerly so this epoch is durable
+                # before the crashing step reruns (see utils.preempt.
+                # launch_attempt — deferred commits livelock deterministic
+                # crashes).
+                mgr.wait_until_finished()
         mgr.wait_until_finished()
         result = GptTrainResult(
             checkpoint=mgr.checkpoint(),
@@ -476,7 +558,15 @@ def _train_pipeline(
         opt_shardings = gpt2_pipeline_shardings(mesh, opt_shape)
         start_step = 0
 
-        if resume_checkpoint is None:
+        mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
+        )
+        # In-run resume after a retry/requeue: continue from this run's
+        # newest committed step (cross-run handles still win).
+        resume_step = (
+            mgr.latest_step() if resume_checkpoint is None else None
+        )
+        if resume_checkpoint is None and resume_step is None:
             # Params born sharded: init is jitted with the pipeline
             # shardings as out_shardings, so no host ever materializes
             # the full replicated tree. Resumes skip this entirely — the
@@ -486,11 +576,7 @@ def _train_pipeline(
                 jax.random.PRNGKey(0)
             )
             opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
-
-        mgr = CheckpointManager(
-            ckpt_dir, max_to_keep=2, save_dtype=cfg.ckpt_dtype or None
-        )
-        if resume_checkpoint is not None:
+        else:
             abstract = {
                 "step": jax.ShapeDtypeStruct((), jnp.int32),
                 "params": jax.tree_util.tree_map(
@@ -508,16 +594,22 @@ def _train_pipeline(
                     opt_shardings,
                 ),
             }
-            restored = restore_from_handle(
-                resume_checkpoint, abstract_state=abstract
-            )
+            if resume_checkpoint is not None:
+                restored = restore_from_handle(
+                    resume_checkpoint, abstract_state=abstract
+                )
+            else:
+                restored = mgr.restore(resume_step, abstract_state=abstract)
             # Normalize placement: scalar/replicated leaves may come back
             # single-device; device_put onto the target shardings is
             # idempotent for already-placed shards.
             params = jax.device_put(restored["params"], shardings)
             opt_state = jax.device_put(restored["opt_state"], opt_shardings)
             start_step = int(restored["step"])
-            log("[gpt] pipeline-sharded state restored")
+            log(
+                "[gpt] pipeline-sharded state restored"
+                + (" (in-run resume)" if resume_step is not None else "")
+            )
         mgr.prewarm({"params": params, "opt_state": opt_state})
 
         # Donated params/opt_state: old and new state never coexist in HBM
@@ -535,15 +627,46 @@ def _train_pipeline(
             mesh, jax.sharding.PartitionSpec("data")
         )
         history = []
+        if resume_step is not None:
+            # Seed continuity across the retry: each committed epoch's
+            # train loss was recorded as its save metric.
+            history += [
+                m["val_loss"]
+                for m in mgr._metrics_history
+                if "val_loss" in m
+            ]
         global_step = start_step
+        start_epoch = 0
+        if resume_step is not None:
+            start_epoch = min(
+                start_step // cfg.steps_per_epoch, cfg.epochs
+            )
+            log(
+                f"[gpt] pipeline in-run resume from step {start_step} "
+                f"→ epoch {start_epoch}"
+            )
         from tpuflow import obs
         from tpuflow.train.step import StepClock
 
+        def drain_preempt() -> None:
+            mgr.save(
+                global_step,
+                {
+                    "step": jnp.int32(global_step),
+                    "params": params,
+                    "opt_state": opt_state,
+                },
+                metrics={},
+            )
+            mgr.wait_until_finished()
+            mgr.close()
+            raise Preempted(f"drained checkpoint at step {global_step}")
+
         clock = StepClock()
-        for epoch in range(cfg.epochs):
+        first = True
+        for epoch in range(start_epoch, cfg.epochs):
             loader.set_epoch(epoch)
             losses = []
-            first = epoch == 0
             clock.reset()
             for b in obs.timed_iter(loader, "data.batch_wait_s"):
                 params, opt_state, loss = pp_step(
@@ -560,6 +683,12 @@ def _train_pipeline(
                     clock.step_done(tokens=int(b["y"].size))
                 losses.append(loss)
                 global_step += 1
+                if os.environ.get("TPUFLOW_FAULT"):
+                    from tpuflow.testing import faults
+
+                    faults.step_boundary(global_step)
+                if preemption_requested():
+                    drain_preempt()
             jax.block_until_ready(params)
             epoch_loss = float(jnp.stack(losses).mean())
             history.append(epoch_loss)
@@ -573,6 +702,10 @@ def _train_pipeline(
                 },
                 metrics={"val_loss": epoch_loss},
             )
+            if launch_attempt() > 0:
+                # Retried attempt: eager commit for monotonic progress
+                # (see utils.preempt.launch_attempt).
+                mgr.wait_until_finished()
         mgr.wait_until_finished()
         result = GptTrainResult(
             checkpoint=mgr.checkpoint(),

@@ -21,6 +21,21 @@ from flax.training import train_state
 
 from tpuflow import obs
 from tpuflow.models.losses import accuracy, cross_entropy_loss
+from tpuflow.utils.heartbeat import beat as _heartbeat
+
+# Preemption surface of the train layer (ISSUE 2): gang_exec installs the
+# SIGTERM handler; the epoch loops check ``preemption_requested()`` at step
+# boundaries, drain + commit a final checkpoint, and raise ``Preempted`` —
+# which gang_exec converts into REQUEUE_EXIT_CODE so the flow supervisor
+# reruns the step without consuming the @retry budget.
+from tpuflow.utils.preempt import (  # noqa: F401  (re-exported API)
+    REQUEUE_EXIT_CODE,
+    Preempted,
+    clear_preemption,
+    install_sigterm_handler,
+    preemption_requested,
+    request_preemption,
+)
 
 
 class StepClock:
@@ -51,6 +66,7 @@ class StepClock:
 
     def compile_done(self, **attrs) -> None:
         """The cold first step just fenced: record it as train.compile."""
+        _heartbeat()
         if self._on:
             now = time.monotonic()
             rec = obs.recorder()
@@ -62,7 +78,11 @@ class StepClock:
             self._last = now
 
     def step_done(self, tokens: int = 0) -> None:
-        """A steady-state step just fenced: record its wall time."""
+        """A steady-state step just fenced: record its wall time. Also
+        stamps this gang member's heartbeat — the step fence is the
+        liveness signal the gang supervisor watches (no-op outside a
+        supervised gang)."""
+        _heartbeat()
         if self._on:
             now = time.monotonic()
             obs.histogram("train.step_s", now - self._last)

@@ -32,6 +32,12 @@ import jax
 
 from tpuflow import dist, obs
 from tpuflow.ckpt import Checkpoint, CheckpointManager
+from tpuflow.utils.heartbeat import beat as _heartbeat
+from tpuflow.utils.preempt import (
+    Preempted,
+    launch_attempt,
+    preemption_requested,
+)
 
 logger = logging.getLogger("tpuflow.train")
 
@@ -201,6 +207,12 @@ class TrainContext:
         )
         if state is not None and self._manager is not None:
             self._manager.save(save_step, state, metrics=metrics)
+            if launch_attempt() > 0:
+                # Retried attempt: commit THIS step before returning to
+                # the loop (see launch_attempt — the async deferred commit
+                # would otherwise livelock a deterministic crash: the
+                # dying step never becomes the resume point).
+                self._manager.wait_until_finished()
         if self.run_config.storage_path and jax.process_index() == 0:
             # Observability stream (SURVEY.md §5): one JSON line per report,
             # aggregated on process 0, appendable/tail-able during the run.
@@ -215,6 +227,42 @@ class TrainContext:
         if self.run_config.verbose:
             logger.info("report[%d]: %s", len(self._reported), metrics)
         dist.barrier("report")
+        # Step boundary: stamp this member's liveness for the gang
+        # supervisor, give the fault harness its injection point, then
+        # honor a pending preemption — the state just saved above IS the
+        # drain checkpoint, so committing it and raising is all that's
+        # left (gang_exec turns Preempted into the requeue exit code).
+        _heartbeat()
+        if os.environ.get("TPUFLOW_FAULT"):
+            from tpuflow.testing import faults
+
+            faults.step_boundary(save_step)
+        if preemption_requested():
+            if self._manager is not None:
+                self._manager.wait_until_finished()
+            raise Preempted(
+                f"preempted; drained checkpoint at step {save_step}"
+            )
+
+    def latest_step(self) -> int:
+        """Newest committed checkpoint step, 0 when none exists yet.
+
+        The resume point for a retried or requeued gang attempt: the
+        launcher passes the attempt through ``TPUFLOW_ATTEMPT`` and the
+        loop continues from ``latest_step() + 1`` instead of step 0 (the
+        manager already rebuilt the metrics history from the same
+        checkpoint at construction)."""
+        if self._manager is None:
+            return 0
+        return self._manager.latest_step() or 0
+
+    def restore_latest(self, abstract_state=None):
+        """Restore the newest committed checkpoint (crc-verified, with
+        fallback to the previous step on corruption); None when no
+        checkpoint exists — start from scratch."""
+        if self._manager is None or self._manager.latest_step() is None:
+            return None
+        return self._manager.restore(abstract_state=abstract_state)
 
     def latest_metrics(self) -> dict[str, Any]:
         return self._reported[-1] if self._reported else {}
@@ -313,9 +361,19 @@ class Trainer:
             if mgr.best_step() is not None:
                 best = mgr.checkpoint(best=True)
             mgr.close()
+        # Metrics-history continuity across retries: a retried/requeued
+        # attempt re-reported only its own steps, while the manager's
+        # history — rebuilt from the latest committed checkpoint at
+        # construction, extended by this attempt's saves — is continuous
+        # from the first attempt's first save. Prefer it when it knows
+        # more (reports without ``state=`` still fall back to _reported).
+        if mgr is not None and len(mgr._metrics_history) > len(ctx._reported):
+            metrics_history = [dict(m) for m in mgr._metrics_history]
+        else:
+            metrics_history = list(ctx._reported)
         return Result(
             metrics=ctx.latest_metrics(),
-            metrics_history=list(ctx._reported),
+            metrics_history=metrics_history,
             checkpoint=latest,
             best_checkpoint=best,
             path=self.run_config.storage_path,

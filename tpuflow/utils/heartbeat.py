@@ -1,0 +1,46 @@
+"""Per-member gang heartbeats.
+
+The gang supervisor (tpuflow.flow.runner) cannot tell a member that is
+compiling from one that is deadlocked in a collective whose peer died —
+both are silent. Heartbeat files break the tie: the runner hands every
+member a private path via ``TPUFLOW_HEARTBEAT_FILE``; the member stamps it
+(mtime touch) at cheap progress points — every fenced train step
+(StepClock), every ``TrainContext.report`` — and the supervisor treats a
+member whose *last* stamp is older than the stall timeout as hung,
+killing the gang promptly instead of waiting out the flat rendezvous
+deadline.
+
+Contract: a member that never stamps is never monitored (arbitrary step
+bodies owe no heartbeats, and a train loop's first compile is not judged
+either — its first stamp lands only at the first fence), so the
+supervisor only judges members that have demonstrably adopted the
+protocol and then gone silent.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def heartbeat_file() -> str | None:
+    return os.environ.get("TPUFLOW_HEARTBEAT_FILE") or None
+
+
+def beat() -> None:
+    """Stamp this member's heartbeat file; no-op outside a supervised gang.
+    Never raises — a heartbeat must not fail the step it reports on."""
+    path = heartbeat_file()
+    if not path:
+        return
+    try:
+        with open(path, "a"):
+            pass
+        os.utime(path, None)
+    except OSError:
+        return
+    if os.environ.get("TPUFLOW_FAULT"):
+        from tpuflow.testing import faults
+
+        # After the stamp, so a stalled member shows exactly one beat and
+        # then goes silent — the signature the supervisor must detect.
+        faults.maybe_stall_heartbeat()

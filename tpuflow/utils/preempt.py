@@ -1,0 +1,77 @@
+"""Preemption primitives shared by the flow and train layers.
+
+Podracer-style gang-scheduled TPU architectures treat preemption as
+routine, not exceptional (PAPERS.md): the infrastructure SIGTERMs a host,
+the training loop drains a final checkpoint at the next step boundary,
+and the process exits with a *requeue* code the supervisor distinguishes
+from a crash — the step reruns without consuming the retry budget (and,
+deployed, without consuming the k8s Job ``backoffLimit``; see
+tpuflow.flow.deploy).
+
+This module is dependency-free on purpose: the flow runner and the gang
+bootstrap import it without pulling in jax/flax, while the train loops
+re-export the checking API from ``tpuflow.train.step``.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+# BSD EX_TEMPFAIL: "try again later". Distinct from every exit code a crash
+# produces (Python exceptions → 1, signals → 128+N / negative), so the gang
+# supervisor can classify a member's death as requeue-not-failure.
+REQUEUE_EXIT_CODE = 75
+
+
+class Preempted(RuntimeError):
+    """Raised by a train loop at a step boundary after it drained and
+    committed its final checkpoint; the gang bootstrap converts it into a
+    ``REQUEUE_EXIT_CODE`` process exit."""
+
+
+def launch_attempt() -> int:
+    """Which launch of the current step this process belongs to (0 = first
+    try; retries and requeues increment). Stamped into ``TPUFLOW_ATTEMPT``
+    by the gang launcher. Train loops use it to switch checkpointing from
+    overlap-optimal to durability-optimal on retried attempts: an async
+    multi-host save only *commits* at the next drain point, so a
+    deterministic crash at step K would otherwise die before step K ever
+    commits — every retry restarts at the same step and the retry budget
+    burns with zero forward progress (a livelock, observed end-to-end).
+    Draining eagerly on retries makes each completed step durable before
+    the crashing one reruns."""
+    import os
+
+    try:
+        return int(os.environ.get("TPUFLOW_ATTEMPT", "0") or 0)
+    except ValueError:
+        return 0
+
+
+_FLAG = threading.Event()
+
+
+def request_preemption(signum=None, frame=None) -> None:
+    """Mark this process preempted (signal-handler compatible signature).
+    Checked by the train loops at step boundaries; idempotent."""
+    _FLAG.set()
+
+
+def preemption_requested() -> bool:
+    return _FLAG.is_set()
+
+
+def clear_preemption() -> None:
+    _FLAG.clear()
+
+
+def install_sigterm_handler() -> bool:
+    """Route SIGTERM to ``request_preemption``. Main-thread only (signal
+    module restriction) — returns False instead of raising elsewhere, so
+    library code may call it opportunistically."""
+    try:
+        signal.signal(signal.SIGTERM, request_preemption)
+        return True
+    except ValueError:  # not the main thread
+        return False
