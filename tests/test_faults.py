@@ -69,7 +69,8 @@ def _run_events(flow_name: str, run_id: int = 1) -> list[dict]:
 def test_fault_spec_parsing():
     specs = faults.parse(
         "member_exit:1@step3,heartbeat_stall:0,rendezvous_delay:2.5@1,"
-        "ckpt_flip_byte,preempt:0@step2,rendezvous_delay:7"
+        "ckpt_flip_byte,preempt:0@step2,rendezvous_delay:7,"
+        "nan_grad:0@step4,loss_spike:1@step6"
     )
     by_kind = {}
     for f in specs:
@@ -84,12 +85,75 @@ def test_fault_spec_parsing():
     assert by_kind["rendezvous_delay"][1].rank is None
     assert by_kind["preempt"][0].step == 2
     assert by_kind["ckpt_flip_byte"][0].rank is None
+    assert by_kind["nan_grad"][0] == faults.Fault("nan_grad", rank=0, step=4)
+    assert by_kind["loss_spike"][0].step == 6
     with pytest.raises(ValueError):
         faults.parse("explode:1")
     with pytest.raises(ValueError):
         faults.parse("member_exit:1@epoch3")
     with pytest.raises(ValueError):
         faults.parse("ckpt_truncate:5")
+    with pytest.raises(ValueError):
+        faults.parse("nan_grad:0@epoch3")
+
+
+def test_grad_poison_single_shot(monkeypatch):
+    """nan_grad/loss_spike fire exactly once per spec: after a health
+    rollback the replayed step must run clean or rollback loops forever."""
+    import math
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "nan_grad:0@step3,loss_spike:0@step5")
+    assert faults.grad_poison(2) is None
+    p = faults.grad_poison(3)
+    assert p is not None and math.isnan(p)
+    assert faults.grad_poison(3) is None  # single-shot
+    assert faults.grad_poison(5) == 1e3
+    assert faults.grad_poison(5) is None
+    # Other ranks never fire.
+    faults.reset()
+    monkeypatch.setenv("TPUFLOW_PROCESS_ID", "1")
+    assert faults.grad_poison(3) is None
+
+
+def test_member_exit_flushes_obs_before_death(tmp_path, monkeypatch):
+    """Satellite: os._exit skips atexit, so without an explicit drain the
+    dying member's buffered telemetry vanished. step_boundary now flushes
+    before exiting — pinned deterministically by intercepting os._exit
+    with a dormant background flusher (nothing else could have drained)."""
+    from tpuflow import obs
+
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    rec = obs.recorder()
+    rec._flush_interval = 3600  # background flusher dormant
+    obs.event("train.report", step=1, val_loss=1.0)
+
+    died = {}
+
+    def fake_exit(code):
+        # Snapshot what is ON DISK at the exact moment the process would
+        # die — anything flushed later (e.g. by test cleanup) must not
+        # mask a missing pre-exit drain.
+        events = []
+        for name in os.listdir(d):
+            events += obs.read_events(os.path.join(d, name))
+        died["code"] = code
+        died["events"] = events
+        raise SystemExit(code)
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    monkeypatch.setenv("TPUFLOW_FAULT", "member_exit:0@step1")
+    try:
+        with pytest.raises(SystemExit):
+            faults.step_boundary(1)
+    finally:
+        monkeypatch.delenv("TPUFLOW_FAULT")
+        obs.configure(None)
+    assert died["code"] == 1
+    reports = [e for e in died["events"] if e["name"] == "train.report"]
+    assert reports and reports[0]["step"] == 1, (
+        "pre-death events were not flushed before os._exit"
+    )
 
 
 # ------------------------------------------------------- backoff (no sleeps)
@@ -525,7 +589,9 @@ def test_heartbeat_stall_detected_and_killed(tmp_path, monkeypatch):
     events = _run_events("HB")
     stalls = [e for e in events if e["name"] == "flow.heartbeat_stall"]
     assert stalls and stalls[0]["member"] == 1
-    assert stalls[0]["age_s"] > 2.0
+    # >= not >: the supervisor polls every 50 ms, so detection can land
+    # at age 2.00x s, which the event's round(age, 2) records as 2.0.
+    assert stalls[0]["age_s"] >= 2.0
 
 
 @pytest.mark.slow
@@ -547,3 +613,13 @@ def test_preemption_drains_and_requeues_gang_end_to_end(tmp_path, monkeypatch):
     assert run.data.history_steps == [1, 2, 3]
     events = _run_events("Chaos")
     assert any(e["name"] == "flow.preempt" for e in events)
+    # Satellite (ISSUE 3): the preempted attempt's LAST steps are in the
+    # merged stream — the exit-75 requeue path drains the obs buffer, so
+    # steps 1 and 2 (reported right before the drain) survive from BOTH
+    # gang members even though those processes died via os._exit.
+    reports = [e for e in events if e["name"] == "train.report"]
+    assert {int(e["step"]) for e in reports} >= {1, 2, 3}
+    pre_drain = [e for e in reports if int(e["step"]) == 2]
+    assert {e["proc"] for e in pre_drain} == {0, 1}, (
+        "a preempted member's pre-drain telemetry is missing from the merge"
+    )

@@ -1,0 +1,484 @@
+"""Training-health observatory (ISSUE 3): detector units, on-device
+numerics in the jitted step, windowed profiler capture, the trainer's
+skip-save-on-divergence contract, and THE acceptance chaos cases —
+``TPUFLOW_FAULT=nan_grad:0@step3`` on a real ``train_gpt`` run emits
+``health.anomaly``, auto-rolls-back to the last crc-verified step, and
+finishes with a continuous finite ``metrics_history``; with rollback
+disabled it halts with a diagnostic instead of reporting NaN losses."""
+
+import glob
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tpuflow import obs
+from tpuflow.obs import health
+from tpuflow.testing import faults
+
+HEALTH_ENVS = (
+    "TPUFLOW_HEALTH",
+    "TPUFLOW_HEALTH_ROLLBACK",
+    "TPUFLOW_HEALTH_NAN_BUDGET",
+    "TPUFLOW_HEALTH_WINDOW",
+    "TPUFLOW_HEALTH_WARMUP",
+    "TPUFLOW_HEALTH_SPIKE_MADS",
+    "TPUFLOW_HEALTH_GRAD_MAX",
+    "TPUFLOW_HEALTH_MAX_ROLLBACKS",
+    "TPUFLOW_HEALTH_LR_BACKOFF",
+    "TPUFLOW_PROFILE",
+    "TPUFLOW_PROFILE_DIR",
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path / "home"))
+    for name in HEALTH_ENVS:
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.delenv("TPUFLOW_FAULT", raising=False)
+    faults.reset()
+    obs.configure(None)
+    yield
+    faults.reset()
+    obs.configure(None)
+
+
+def _events(d):
+    out = []
+    for path in glob.glob(os.path.join(d, "events.p*.jsonl")):
+        with open(path) as f:
+            out += [json.loads(line) for line in f if line.strip()]
+    return out
+
+
+# ------------------------------------------------------------ config/env
+def test_health_config_from_env(monkeypatch):
+    assert health.HealthConfig.from_env() == health.HealthConfig()
+    monkeypatch.setenv("TPUFLOW_HEALTH_NAN_BUDGET", "3")
+    monkeypatch.setenv("TPUFLOW_HEALTH_SPIKE_MADS", "6.5")
+    monkeypatch.setenv("TPUFLOW_HEALTH_GRAD_MAX", "100")
+    monkeypatch.setenv("TPUFLOW_HEALTH_ROLLBACK", "0")
+    cfg = health.HealthConfig.from_env()
+    assert cfg.nan_budget == 3
+    assert cfg.spike_mads == 6.5
+    assert cfg.grad_norm_max == 100.0
+    assert not cfg.rollback
+    # Malformed values fall back to defaults instead of crashing a run.
+    monkeypatch.setenv("TPUFLOW_HEALTH_SPIKE_MADS", "not-a-float")
+    monkeypatch.setenv("TPUFLOW_HEALTH_NAN_BUDGET", "many")
+    cfg = health.HealthConfig.from_env()
+    assert cfg.spike_mads == 12.0 and cfg.nan_budget == 1
+
+
+def test_monitor_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("TPUFLOW_HEALTH", "0")
+    assert health.HealthMonitor.from_env() is None
+    monkeypatch.setenv("TPUFLOW_HEALTH", "1")
+    assert health.HealthMonitor.from_env() is not None
+
+
+# -------------------------------------------------------------- detectors
+def test_nonfinite_budget_and_streak_reset():
+    mon = health.HealthMonitor(health.HealthConfig(nan_budget=2))
+    assert mon.observe(1, float("nan"), 1.0) is None  # streak 1 < budget
+    assert mon.observe(2, 2.0, 1.0) is None           # finite resets
+    assert mon.observe(3, float("nan"), 1.0) is None
+    a = mon.observe(4, 2.0, float("inf"))             # inf grad counts too
+    assert a is not None and a.kind == "nonfinite" and a.step == 4
+    assert a.detail["streak"] == 2
+
+
+def test_loss_spike_median_mad():
+    mon = health.HealthMonitor(
+        health.HealthConfig(window=8, warmup=4, spike_mads=8.0)
+    )
+    for i, v in enumerate([2.0, 2.1, 1.9, 2.0, 2.05]):
+        assert mon.observe(i, v, 1.0) is None
+    # Small jitter stays below the floored threshold.
+    assert mon.observe(6, 2.2, 1.0) is None
+    a = mon.observe(7, 50.0, 1.0)
+    assert a is not None and a.kind == "loss_spike"
+    # The spike was not absorbed into the window: an identical follow-up
+    # spike is still judged against the pre-spike baseline.
+    a2 = mon.observe(8, 50.0, 1.0)
+    assert a2 is not None and a2.kind == "loss_spike"
+
+
+def test_grad_explosion_threshold():
+    mon = health.HealthMonitor(
+        health.HealthConfig(grad_norm_max=100.0, warmup=1000)
+    )
+    assert mon.observe(1, 2.0, 50.0) is None
+    a = mon.observe(2, 2.0, 500.0)
+    assert a is not None and a.kind == "grad_explosion"
+    # Off by default: no threshold, no anomaly.
+    mon2 = health.HealthMonitor(health.HealthConfig())
+    assert mon2.observe(1, 2.0, 1e12) is None
+
+
+def test_anomaly_event_recorded(tmp_path):
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    try:
+        mon = health.HealthMonitor(health.HealthConfig())
+        assert mon.observe(7, float("nan"), 1.0) is not None
+        obs.flush()
+    finally:
+        obs.configure(None)
+    (ev,) = [e for e in _events(d) if e["name"] == "health.anomaly"]
+    assert ev["kind"] == "event"  # record type
+    assert ev["detector"] == "nonfinite" and ev["step"] == 7
+
+
+def test_handle_anomaly_policy(tmp_path):
+    from tpuflow.ckpt import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    mgr.save(1, {"w": np.arange(64, dtype=np.float32)}, metrics={})
+    mgr.save(2, {"w": np.arange(64, dtype=np.float32) * 2}, metrics={})
+    mgr.wait_until_finished()
+    anomaly = health.Anomaly("nonfinite", 3, {})
+
+    mon = health.HealthMonitor(health.HealthConfig(rollback=False))
+    with pytest.raises(health.TrainingDiverged, match="ROLLBACK=0"):
+        health.handle_anomaly(mon, anomaly, mgr)
+
+    mon = health.HealthMonitor(health.HealthConfig(max_rollbacks=1))
+    assert health.handle_anomaly(mon, anomaly, mgr) == 2
+    assert mon.rollbacks == 1
+    with pytest.raises(health.TrainingDiverged, match="budget exhausted"):
+        health.handle_anomaly(mon, anomaly, mgr)
+
+    # The rollback target must be VERIFIED: corrupt the newest step and
+    # the handler falls through to the older intact one.
+    (shard,) = glob.glob(str(tmp_path / "ck" / "step_2" / "state" / "*.bin"))
+    size = os.path.getsize(shard)
+    with open(shard, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    mon = health.HealthMonitor(health.HealthConfig())
+    assert health.handle_anomaly(mon, anomaly, mgr) == 1
+    mgr.close()
+
+
+# --------------------------------------------------- jitted-step numerics
+def test_train_step_emits_numerics():
+    import jax
+    import optax
+
+    from tpuflow.models.mlp import NeuralNetwork
+    from tpuflow.train import create_train_state, make_train_step
+
+    model = NeuralNetwork(hidden_dim=8, num_classes=4, final_relu=False)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((2, 6), np.float32),
+        optax.sgd(0.1),
+    )
+    batch = {
+        "x": np.random.default_rng(0).standard_normal((4, 6)).astype(
+            np.float32
+        ),
+        "y": np.array([0, 1, 2, 3]),
+    }
+    step = make_train_step(donate=False)
+    _, m = step(state, batch, jax.random.PRNGKey(1))
+    for key in ("grad_norm", "update_norm", "param_norm", "nonfinite"):
+        assert key in m, f"missing numerics metric {key}"
+    assert float(m["nonfinite"]) == 0.0
+    assert float(m["update_norm"]) > 0.0
+    assert float(m["param_norm"]) > 0.0
+    # SGD with lr 0.1 and no momentum: update = -0.1 * grad exactly.
+    np.testing.assert_allclose(
+        float(m["update_norm"]), 0.1 * float(m["grad_norm"]), rtol=1e-5
+    )
+    # NaN params → the fused flag fires inside the compiled step.
+    poisoned = state.replace(
+        params=jax.tree_util.tree_map(
+            lambda p: p * float("nan"), state.params
+        )
+    )
+    _, m = step(poisoned, batch, jax.random.PRNGKey(1))
+    assert float(m["nonfinite"]) == 1.0
+
+
+# ----------------------------------------------------- windowed profiler
+def test_profile_window_parse(monkeypatch, tmp_path):
+    assert health.ProfileWindow.from_env() is None  # unset
+    monkeypatch.setenv("TPUFLOW_PROFILE", "banana")
+    assert health.ProfileWindow.from_env() is None  # malformed
+    monkeypatch.setenv("TPUFLOW_PROFILE", "5:3")
+    assert health.ProfileWindow.from_env() is None  # empty window
+    monkeypatch.setenv("TPUFLOW_PROFILE", "3:5")
+    assert health.ProfileWindow.from_env() is None  # no obs, no dir
+    monkeypatch.setenv("TPUFLOW_PROFILE_DIR", str(tmp_path / "prof"))
+    pw = health.ProfileWindow.from_env()
+    assert pw is not None and (pw.start, pw.stop) == (3, 5)
+    # With obs configured the capture lands under <obs_dir>/profile.
+    monkeypatch.delenv("TPUFLOW_PROFILE_DIR")
+    obs.configure(str(tmp_path / "obs"), proc=0)
+    try:
+        pw = health.ProfileWindow.from_env()
+        assert pw is not None
+        assert pw.out_dir == os.path.join(str(tmp_path / "obs"), "profile")
+    finally:
+        obs.configure(None)
+
+
+def test_profile_window_captures_trace(monkeypatch, tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("TPUFLOW_PROFILE", "2:3")
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    try:
+        pw = health.ProfileWindow.from_env()
+        f = jax.jit(lambda x: x * 2)
+        for step in range(1, 5):
+            pw.maybe_start(step)
+            jax.block_until_ready(f(jnp.ones(8)))
+            pw.maybe_stop(step)
+        assert pw._done and not pw._active
+        obs.flush()
+    finally:
+        obs.configure(None)
+    traces = glob.glob(
+        os.path.join(d, "profile", "**", "*.xplane.pb"), recursive=True
+    )
+    assert traces, "no trace files captured"
+    (ev,) = [e for e in _events(d) if e["name"] == "health.profile"]
+    assert ev["start_step"] == 2 and ev["stop_step"] == 3
+    assert ev["dir"] == os.path.join(d, "profile")
+
+
+# ----------------------------------------------------- summaries/clients
+def test_health_summary_and_summarize():
+    events = [
+        {"kind": "event", "name": "health.anomaly", "ts": 1.0, "proc": 0,
+         "detector": "nonfinite", "step": 3},
+        {"kind": "event", "name": "health.rollback", "ts": 2.0, "proc": 0,
+         "step": 2, "from_step": 3},
+        {"kind": "gauge", "name": "health.grad_norm", "ts": 3.0,
+         "value": 1.5},
+        {"kind": "counter", "name": "health.nonfinite", "ts": 3.1,
+         "value": 1},
+        {"kind": "event", "name": "obs.dropped", "ts": 9.0, "value": 7},
+    ]
+    s = obs.summarize(events)
+    h = s["health"]
+    assert len(h["anomalies"]) == 1 and h["anomalies"][0]["step"] == 3
+    assert len(h["rollbacks"]) == 1 and h["rollbacks"][0]["step"] == 2
+    assert h["last"]["grad_norm"] == 1.5
+    assert h["nonfinite_steps"] == 1
+    assert h["dropped_events"] == 7
+    assert s["headline"]["health_anomalies"] == 1
+    assert s["headline"]["health_rollbacks"] == 1
+    assert s["headline"]["obs_dropped_events"] == 7
+
+
+def test_timeline_card_health_section():
+    from tpuflow.flow.cards import CardBuffer, timeline_card
+
+    events = [
+        {"kind": "span", "name": "flow.step", "ts": 0.0, "dur_s": 1.0,
+         "proc": 0, "step": "train"},
+        {"kind": "event", "name": "health.anomaly", "ts": 0.5, "proc": 0,
+         "detector": "nonfinite", "step": 3, "loss": 99.0},
+        {"kind": "event", "name": "health.rollback", "ts": 0.6, "proc": 0,
+         "detector": "nonfinite", "step": 2, "from_step": 3,
+         "lr_scale": 0.5},
+        {"kind": "event", "name": "health.profile", "ts": 0.7, "proc": 0,
+         "start_step": 1, "stop_step": 2, "dir": "/tmp/x"},
+    ]
+    buf = CardBuffer()
+    timeline_card(buf, events)
+    html = buf.render_html("t")
+    assert "Training health" in html
+    assert "rollback" in html and "from step 3" in html
+    assert "profile" in html and "1–2" in html
+
+
+# --------------------------------------------------------------- trainer
+def test_trainer_report_divergence_skips_save(tmp_path):
+    from tpuflow.ckpt import CheckpointManager
+    from tpuflow.train import RunConfig, ScalingConfig, Trainer, get_context
+
+    def loop(cfg):
+        ctx = get_context()
+        ctx.report(
+            {"val_loss": 1.0},
+            state={"w": np.ones(8, np.float32)}, step=1,
+        )
+        ctx.report(
+            {"val_loss": float("nan")},
+            state={"w": np.full(8, np.nan, np.float32)}, step=2,
+        )
+
+    storage = str(tmp_path / "runs")
+    trainer = Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=storage),
+    )
+    with pytest.raises(health.TrainingDiverged, match="nonfinite"):
+        trainer.fit()
+    # The diverged report never became a checkpoint: the newest committed
+    # step is the clean step 1 a gang retry would resume from.
+    mgr = CheckpointManager(os.path.join(storage, "checkpoints"))
+    assert mgr.latest_step() == 1
+    restored = mgr.restore(1)
+    assert np.isfinite(restored["w"]).all()
+    mgr.close()
+
+
+def test_trainer_report_health_disabled(tmp_path, monkeypatch):
+    """TPUFLOW_HEALTH=0 restores the old behavior: NaN metrics report and
+    save like any other value (the babysitter is opt-out-able)."""
+    monkeypatch.setenv("TPUFLOW_HEALTH", "0")
+    from tpuflow.train import RunConfig, ScalingConfig, Trainer, get_context
+
+    def loop(cfg):
+        ctx = get_context()
+        ctx.report({"val_loss": float("nan")}, step=1)
+
+    result = Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "runs")),
+    ).fit()
+    assert math.isnan(result.metrics["val_loss"])
+
+
+# ====================================================== acceptance chaos
+def _gpt_cfg(**kw):
+    from tpuflow.train import GptTrainConfig
+
+    base = dict(
+        preset="test", epochs=2, steps_per_epoch=2, batch_size=8,
+        seq_len=16, data_axis=4, fsdp_axis=2,
+    )
+    base.update(kw)
+    return GptTrainConfig(**base)
+
+
+def test_chaos_nan_grad_rollback_continuous_history(tmp_path, monkeypatch):
+    """THE acceptance chaos test: a NaN gradient injected at step 3 of a
+    real train_gpt run trips the fused nonfinite detector, auto-rolls-back
+    to the last crc-verified checkpoint (step 2 = epoch 0's save), and the
+    run finishes with a CONTINUOUS, finite metrics history — the NaN'd
+    trajectory never reaches the result or the checkpoint store."""
+    from tpuflow.train import train_gpt
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "nan_grad:0@step3")
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    try:
+        result = train_gpt(_gpt_cfg(), ckpt_dir=str(tmp_path / "ck"))
+        obs.flush()
+    finally:
+        obs.configure(None)
+    assert [m["epoch"] for m in result.metrics_history] == [0, 1]
+    for m in result.metrics_history:
+        assert math.isfinite(m["train_loss"]) and math.isfinite(m["val_loss"])
+    events = _events(d)
+    anomalies = [e for e in events if e["name"] == "health.anomaly"]
+    assert anomalies and anomalies[0]["detector"] == "nonfinite"
+    assert anomalies[0]["step"] == 3
+    rollbacks = [e for e in events if e["name"] == "health.rollback"]
+    assert rollbacks and rollbacks[0]["step"] == 2
+    assert rollbacks[0]["from_step"] == 3
+    # The nonfinite step was counted in the numerics stream too.
+    assert any(e["name"] == "health.nonfinite" for e in events)
+    # Rollback rewound the manager history: the final checkpoint's
+    # embedded metrics_history carries no duplicate steps.
+    from tpuflow.ckpt import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    steps = [m["step"] for m in mgr._metrics_history]
+    assert steps == sorted(set(steps)), f"duplicated steps {steps}"
+    mgr.close()
+
+
+def test_chaos_nan_grad_halts_when_rollback_disabled(tmp_path, monkeypatch):
+    """With TPUFLOW_HEALTH_ROLLBACK=0 the same fault halts the run with a
+    diagnostic naming the detector — instead of reporting NaN losses."""
+    from tpuflow.train import train_gpt
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "nan_grad:0@step3")
+    monkeypatch.setenv("TPUFLOW_HEALTH_ROLLBACK", "0")
+    with pytest.raises(health.TrainingDiverged) as exc:
+        train_gpt(_gpt_cfg(), ckpt_dir=str(tmp_path / "ck"))
+    msg = str(exc.value)
+    assert "nonfinite at step 3" in msg
+    assert "TPUFLOW_HEALTH_ROLLBACK=0" in msg
+
+
+def test_chaos_loss_spike_rollback(tmp_path, monkeypatch):
+    """The finite-spike injection (params ×1e3) trips the median+MAD
+    detector once the window has warmed up, and rolls back like the NaN
+    case. Longer epochs so the warmup fills from real steps."""
+    from tpuflow.train import train_gpt
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "loss_spike:0@step5")
+    monkeypatch.setenv("TPUFLOW_HEALTH_WINDOW", "8")
+    monkeypatch.setenv("TPUFLOW_HEALTH_WARMUP", "3")
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    try:
+        result = train_gpt(
+            _gpt_cfg(epochs=2, steps_per_epoch=4),
+            ckpt_dir=str(tmp_path / "ck"),
+        )
+        obs.flush()
+    finally:
+        obs.configure(None)
+    assert [m["epoch"] for m in result.metrics_history] == [0, 1]
+    for m in result.metrics_history:
+        assert m["train_loss"] < 20.0, "spiked epoch leaked into history"
+    events = _events(d)
+    anomalies = [e for e in events if e["name"] == "health.anomaly"]
+    assert anomalies and anomalies[0]["detector"] == "loss_spike"
+    assert any(e["name"] == "health.rollback" for e in events)
+
+
+@pytest.mark.slow
+def test_chaos_pipeline_nan_grad_rollback(tmp_path, monkeypatch):
+    """Pipeline leg twin of the acceptance chaos: the GPipe loop detects
+    the injected NaN and replays from its verified checkpoint."""
+    from tpuflow.train import train_gpt
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "nan_grad:0@step3")
+    result = train_gpt(
+        _gpt_cfg(
+            data_axis=4, fsdp_axis=1, stage_axis=2, microbatches=2,
+        ),
+        ckpt_dir=str(tmp_path / "ck"),
+    )
+    assert len(result.loss_history) == 2
+    assert all(math.isfinite(l) for l in result.loss_history)
+
+
+@pytest.mark.slow
+def test_chaos_lr_backoff_on_rollback(tmp_path, monkeypatch):
+    """TPUFLOW_HEALTH_LR_BACKOFF scales the optimizer on rollback; the
+    run still completes with a finite continuous history and records the
+    scale in the rollback event."""
+    from tpuflow.train import train_gpt
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "nan_grad:0@step3")
+    monkeypatch.setenv("TPUFLOW_HEALTH_LR_BACKOFF", "0.5")
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    try:
+        result = train_gpt(_gpt_cfg(), ckpt_dir=str(tmp_path / "ck"))
+        obs.flush()
+    finally:
+        obs.configure(None)
+    assert [m["epoch"] for m in result.metrics_history] == [0, 1]
+    (rb,) = [e for e in _events(d) if e["name"] == "health.rollback"]
+    assert rb["lr_scale"] == 0.5

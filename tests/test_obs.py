@@ -90,6 +90,82 @@ def test_gang_worker_merge(tmp_path):
     assert obs.load_run_events(run_dir) == events
 
 
+def test_merge_tiebreak_and_idempotence(tmp_path):
+    """Identical-``ts`` events from different gang members merge in a
+    stable order (proc breaks the tie; within one file the write order is
+    kept by the stable sort) and re-merging is byte-identical — consumers
+    diffing two reads of events.jsonl must never see phantom churn."""
+    run_dir = str(tmp_path / "run")
+    d = obs.obs_dir(run_dir)
+    r1 = obs.Recorder(d, proc=1, flush_interval=60)
+    r0 = obs.Recorder(d, proc=0, flush_interval=60)
+    # Same timestamp everywhere; per-proc write order distinct.
+    r1.record("event", "train.report", ts=5.0, seq="p1-first")
+    r1.record("event", "train.report", ts=5.0, seq="p1-second")
+    r0.record("event", "train.report", ts=5.0, seq="p0-first")
+    r0.record("counter", "train.tokens", ts=5.0, value=1)
+    r0.close()
+    r1.close()
+    first = obs.merge_run_events(run_dir)
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        first_bytes = f.read()
+    # Ties break by proc; same-proc events keep their file order.
+    assert [e["proc"] for e in first] == [0, 0, 1, 1]
+    assert [e.get("seq") for e in first if e["proc"] == 1] == [
+        "p1-first", "p1-second",
+    ]
+    # Idempotent: the merged file at the run root is NOT a fragment, so
+    # re-merging re-reads only the per-proc files and reproduces the
+    # exact same artifact.
+    second = obs.merge_run_events(run_dir)
+    assert second == first
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        assert f.read() == first_bytes
+
+
+def test_recorder_buffer_bound_counts_drops(tmp_path):
+    """Satellite: the in-memory buffer is bounded; overflowing events are
+    counted, and the count surfaces as a final obs.dropped event on
+    close instead of vanishing invisibly."""
+    d = str(tmp_path / "obs")
+    rec = obs.Recorder(d, proc=0, flush_interval=3600, max_buffered=10)
+    for i in range(25):
+        rec.record("counter", "train.tokens", value=i)
+    assert rec.dropped == 15
+    rec.close()
+    events = obs.read_events(rec.path)
+    kept = [e for e in events if e["name"] == "train.tokens"]
+    assert len(kept) == 10
+    (drop,) = [e for e in events if e["name"] == "obs.dropped"]
+    assert drop["value"] == 15
+    # A second close must not duplicate the accounting event.
+    rec.close()
+    assert len(
+        [e for e in obs.read_events(rec.path) if e["name"] == "obs.dropped"]
+    ) == 1
+
+
+def test_recorder_failed_flush_counts_lost_batch(tmp_path, monkeypatch):
+    """Satellite: an OSError on the append path used to silently lose the
+    whole drained batch — now it lands in the drop count."""
+    d = str(tmp_path / "obs")
+    rec = obs.Recorder(d, proc=0, flush_interval=3600)
+    rec.record("counter", "train.tokens", value=1)
+    rec.record("counter", "train.tokens", value=2)
+    # Make the append path fail: the target becomes a directory.
+    os.unlink(rec.path) if os.path.exists(rec.path) else None
+    os.makedirs(rec.path)
+    rec.flush()
+    assert rec.dropped == 2
+    os.rmdir(rec.path)  # restore writability for the close-time event
+    rec.record("counter", "train.tokens", value=3)
+    rec.close()
+    events = obs.read_events(rec.path)
+    assert [e["value"] for e in events if e["name"] == "train.tokens"] == [3]
+    (drop,) = [e for e in events if e["name"] == "obs.dropped"]
+    assert drop["value"] == 2
+
+
 def test_merge_tolerates_torn_tail(tmp_path):
     run_dir = str(tmp_path / "run")
     d = obs.obs_dir(run_dir)
@@ -132,14 +208,21 @@ def test_disabled_span_is_shared_noop():
     assert obs.recorder() is None
 
 
-def test_disabled_overhead_unmeasurable_per_step():
-    """Acceptance: with obs disabled, the instrumented hot paths add no
-    measurable per-step cost. The disabled fast path is one module-bool
-    check; bound it at ~5µs/call (two orders of magnitude above its real
-    cost, far below any train step) so the guard never flakes."""
+def test_disabled_overhead_unmeasurable_per_step(monkeypatch):
+    """Acceptance: with obs disabled, the instrumented hot paths — now
+    including the ISSUE 3 health hooks — add no measurable per-step cost.
+    The disabled fast path is one module-bool check (plus one ``is not
+    None`` for the health monitor); bound it at ~5µs/call (two orders of
+    magnitude above its real cost, far below any train step) so the
+    guard never flakes."""
+    from tpuflow.obs.health import HealthMonitor
     from tpuflow.train.step import StepClock
 
+    monkeypatch.setenv("TPUFLOW_HEALTH", "0")
+    monitor = HealthMonitor.from_env()
+    assert monitor is None  # TPUFLOW_HEALTH=0 removes the monitor
     clock = StepClock()
+    assert clock.recording is False
     n = 10_000
     t0 = time.perf_counter()
     for _ in range(n):
@@ -147,6 +230,16 @@ def test_disabled_overhead_unmeasurable_per_step():
             pass
         clock.step_done(tokens=64)
         obs.counter("train.tokens", 64)
+        # The loops' per-step health gate when both knobs are off: one
+        # None check + one bool — they never host-copy the numerics.
+        if monitor is not None or clock.recording:
+            raise AssertionError("disabled health path took the slow branch")
+        # And health_done itself is one bool check when obs is off (the
+        # monitor-on, obs-off configuration).
+        clock.health_done(
+            loss=0.0, grad_norm=0.0, update_norm=0.0, param_norm=0.0,
+            nonfinite=False,
+        )
     dt = time.perf_counter() - t0
     assert dt < 0.05 * (n / 10_000) * 10, f"disabled obs overhead {dt:.3f}s"
     # timed_iter must return the iterable UNTOUCHED when disabled (no
@@ -179,8 +272,23 @@ def test_obs_catalog_lint():
         ("histogram", "train.step_s"),
         ("span", "infer.generate"),
         ("counter", "infer.spec.committed"),
+        # Training-health observatory (ISSUE 3) with the right kinds.
+        ("gauge", "health.loss"),
+        ("gauge", "health.grad_norm"),
+        ("gauge", "health.update_norm"),
+        ("gauge", "health.param_norm"),
+        ("counter", "health.nonfinite"),
+        ("event", "health.anomaly"),
+        ("event", "health.rollback"),
+        ("event", "health.profile"),
     ):
         assert required in kinds, f"missing emitter {required}"
+    # Kind mismatches and dynamic (unlintable) names are errors, not just
+    # name-presence checks.
+    assert mod.dynamic_name_calls('obs.gauge(f"health.{k}", v)')
+    assert mod.dynamic_name_calls("obs.event(name, step=1)")
+    assert not mod.dynamic_name_calls('obs.gauge("health.loss", v)')
+    assert not mod.dynamic_name_calls('obs.gauge(\n    "health.loss", v)')
 
 
 def test_summarize_aggregates():

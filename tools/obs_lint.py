@@ -22,9 +22,12 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# obs.span("name", ...) / obs.counter("name") / ... (the module-level API)
+# obs.span("name", ...) / obs.counter("name") / ... (the module-level API;
+# `_rec.` covers tpuflow.obs.health, which imports the recorder module
+# under that alias to avoid a circular package import)
 _API_RE = re.compile(
-    r"\bobs\.(span|counter|gauge|histogram|event)\(\s*[\"']([a-z0-9_.]+)[\"']"
+    r"\b(?:obs|_rec)\.(span|counter|gauge|histogram|event)"
+    r"\(\s*[\"']([a-z0-9_.]+)[\"']"
 )
 # obs.timed_iter(loader, "name") — records histogram observations
 _TIMED_ITER_RE = re.compile(
@@ -37,9 +40,22 @@ _RECORD_RE = re.compile(
     r"\s*[\"']([a-z0-9_.]+)[\"']",
     re.S,
 )
+# An emitter whose NAME is not a string literal (f-string, variable,
+# concatenation) is invisible to this lint: its name could drift from the
+# catalog — or never be registered at all — without failing anything.
+# Flag it as an error; emit literal names (one call per name) instead.
+_DYNAMIC_RE = re.compile(
+    r"\b(?:obs|_rec)\.(span|counter|gauge|histogram|event)\(\s*(?![\"'])\S"
+)
 # self._rec.record(kind, self._name, ...) etc. carry no literal name —
 # those are the recorder's own internals, exempted by path below.
 _EXEMPT_FILES = {os.path.join("tpuflow", "obs", "recorder.py")}
+
+
+def dynamic_name_calls(src: str) -> list[str]:
+    """Emitter calls in ``src`` whose name argument is not a string
+    literal (unlintable — see _DYNAMIC_RE). Returns the matched heads."""
+    return [m.group(0) for m in _DYNAMIC_RE.finditer(src)]
 
 
 def emitted_names(root: str = REPO) -> list[tuple[str, str, str]]:
@@ -83,6 +99,23 @@ def lint(root: str = REPO) -> tuple[list[str], list[str]]:
                 f"{rel}: emits {name!r} as {kind} but the catalog "
                 f"registers it as {CATALOG[name][0]}"
             )
+    pkg = os.path.join(root, "tpuflow")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in _EXEMPT_FILES:
+                continue
+            with open(path) as f:
+                src = f.read()
+            for head in dynamic_name_calls(src):
+                errors.append(
+                    f"{rel}: emitter with a non-literal name "
+                    f"({head!r}...) is invisible to this lint — emit "
+                    "literal catalog names instead"
+                )
     warnings = [
         f"catalog name {name!r} has no literal emitter in tpuflow/"
         for name in sorted(set(CATALOG) - used)

@@ -344,6 +344,19 @@ class CheckpointManager:
         self.wait_until_finished()
         return self._best_step()
 
+    def rewind_history(self, step: int) -> None:
+        """Drop metrics-history entries NEWER than ``step``.
+
+        The divergence auto-rollback (tpuflow.obs.health) restores
+        ``step`` and replays the discarded trajectory; the replayed
+        epochs re-save their steps, so without the rewind the embedded
+        ``metrics_history`` would carry duplicate (and divergent-run)
+        entries forever. Disk is untouched — any newer step dirs are the
+        next save/retention cycle's problem."""
+        self._metrics_history = [
+            m for m in self._metrics_history if m.get("step", 0) <= step
+        ]
+
     # ------------------------------------------------------------------ save
     def save(self, step: int, state, metrics: dict | None = None) -> Checkpoint:
         """Asynchronously save ``state`` (a pytree) for ``step`` with metrics.

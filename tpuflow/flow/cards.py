@@ -201,6 +201,60 @@ def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> N
             )
         )
 
+    # Training health (ISSUE 3): anomalies, rollbacks, and profiler
+    # windows get their own section — the first thing a babysitter scans.
+    health = summary.get("health") or {}
+    if (
+        health.get("anomalies")
+        or health.get("rollbacks")
+        or health.get("profiles")
+    ):
+        buf.append(Markdown("## Training health"))
+        rows = []
+        for a in health.get("anomalies", []):
+            detail = ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(a.items())
+                if k not in ("ts", "proc", "detector", "step")
+            )
+            rows.append(
+                ["anomaly", a.get("detector", "?"), a.get("step", ""), detail]
+            )
+        for r in health.get("rollbacks", []):
+            rows.append(
+                [
+                    "rollback",
+                    r.get("detector", "?"),
+                    r.get("step", ""),
+                    f"from step {r.get('from_step', '?')}, "
+                    f"lr_scale {r.get('lr_scale', 1.0)}",
+                ]
+            )
+        for p in health.get("profiles", []):
+            rows.append(
+                [
+                    "profile",
+                    "trace",
+                    f"{p.get('start_step', '?')}–{p.get('stop_step', '?')}",
+                    p.get("dir", ""),
+                ]
+            )
+        buf.append(
+            Table(rows, headers=["event", "kind", "step", "detail"])
+        )
+        last = health.get("last") or {}
+        if last:
+            buf.append(
+                Table(
+                    [
+                        [k, f"{v:.6g}"]
+                        for k, v in sorted(last.items())
+                        if k != "step"
+                    ],
+                    headers=["last gauge", "value"],
+                )
+            )
+
     spans = [
         e for e in events if e.get("kind") == "span" and e.get("dur_s", 0) > 0
     ]

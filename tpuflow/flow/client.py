@@ -197,6 +197,16 @@ class Run:
 
         return obs.summarize(self.events())
 
+    def health(self) -> dict:
+        """The run's training-health view (``obs.health_summary`` of the
+        merged stream): anomaly/rollback/profile-capture events, the last
+        ``health.*`` numerics gauges, nonfinite-step and dropped-event
+        totals — how a babysitting tool answers "did this run diverge,
+        and what did the loop do about it" without scraping logs."""
+        from tpuflow import obs
+
+        return obs.health_summary(self.events())
+
 
 class Flow:
     """Handle to a flow's run history: ``Flow("TpuGptTrain")`` — the

@@ -354,7 +354,7 @@ class FlowRunner:
             meta["finished"] = time.time()
             run_span.set(status="failed")
             run_span.__exit__(None, None, None)
-            meta["telemetry"] = self._finalize_obs(rdir, pathspec)
+            self._finalize_obs(rdir, pathspec, meta)
             store.write_run_meta(self.flow_name, run_id, meta)
             print(f"[tpuflow] run {pathspec} FAILED: {e!r}")
             raise
@@ -362,7 +362,7 @@ class FlowRunner:
         meta["finished"] = time.time()
         run_span.set(status="success")
         run_span.__exit__(None, None, None)
-        meta["telemetry"] = self._finalize_obs(rdir, pathspec)
+        self._finalize_obs(rdir, pathspec, meta)
         store.write_run_meta(self.flow_name, run_id, meta)
         store.append_event(
             {"flow": self.flow_name, "run": pathspec, "status": "success"}
@@ -370,16 +370,18 @@ class FlowRunner:
         print(f"[tpuflow] run {pathspec} succeeded")
         return pathspec
 
-    def _finalize_obs(self, rdir: str, pathspec: str) -> dict:
+    def _finalize_obs(self, rdir: str, pathspec: str, meta: dict) -> None:
         """Close the run's recorder, merge gang-worker event files into
-        ``<rdir>/events.jsonl``, render the timeline card, and return the
-        headline summary (stored in run.json as the run-level
-        observability card's data). Telemetry must never fail the run."""
+        ``<rdir>/events.jsonl``, render the timeline card, and stamp the
+        headline summary (``meta["telemetry"]``) plus the training-health
+        view (``meta["health"]``, when anything happened) into run.json.
+        Telemetry must never fail the run."""
+        meta.setdefault("telemetry", {})
         try:
             obs.configure(None)  # flush + close the head recorder
             events = obs.merge_run_events(rdir)
             if not events:
-                return {}
+                return
             summary = obs.summarize(events)
             from tpuflow.flow.cards import timeline_card
 
@@ -387,10 +389,19 @@ class FlowRunner:
             timeline_card(buf, events, summary=summary)
             with open(os.path.join(rdir, "timeline.html"), "w") as f:
                 f.write(buf.render_html(f"{pathspec} timeline"))
-            return summary.get("headline", {})
+            meta["telemetry"] = summary.get("headline", {})
+            health = summary.get("health") or {}
+            if (
+                health.get("anomalies")
+                or health.get("rollbacks")
+                or health.get("profiles")
+                or health.get("dropped_events")
+            ):
+                # Only stamped when noteworthy: a clean run's run.json
+                # stays as small as before this section existed.
+                meta["health"] = health
         except Exception as e:
             print(f"[tpuflow] telemetry finalize failed (ignored): {e!r}")
-            return {}
 
     # ----------------------------------------------------- single-task exec
     def _exec_local(

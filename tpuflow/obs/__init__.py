@@ -23,6 +23,14 @@ flush on a background thread (see ``recorder``).
 """
 
 from tpuflow.obs.catalog import CATALOG, is_registered, kind_of
+from tpuflow.obs.health import (
+    Anomaly,
+    HealthConfig,
+    HealthMonitor,
+    ProfileWindow,
+    TrainingDiverged,
+    health_summary,
+)
 from tpuflow.obs.recorder import (
     Recorder,
     configure,
@@ -45,14 +53,20 @@ from tpuflow.obs.timeline import (
 )
 
 __all__ = [
+    "Anomaly",
     "CATALOG",
+    "HealthConfig",
+    "HealthMonitor",
+    "ProfileWindow",
     "Recorder",
+    "TrainingDiverged",
     "configure",
     "counter",
     "enabled",
     "event",
     "flush",
     "gauge",
+    "health_summary",
     "histogram",
     "is_registered",
     "kind_of",

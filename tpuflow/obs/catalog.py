@@ -83,6 +83,49 @@ CATALOG: dict[str, tuple[str, str]] = {
     # -------------------------------------------------------------- device
     "device.bytes_in_use": ("gauge", "sampled per-device HBM bytes in use"),
     "device.peak_bytes_in_use": ("gauge", "per-device peak HBM bytes"),
+    # -------------------------------------------------------------- health
+    # Training-health observatory (ISSUE 3): per-step numerics computed
+    # inside the jitted train step, emitted through the StepClock fences,
+    # plus the divergence-policy events (tpuflow.obs.health).
+    "health.loss": ("gauge", "per-step train loss (fenced host copy)"),
+    "health.grad_norm": (
+        "gauge",
+        "pre-clip global gradient norm of the fenced step (spikes "
+        "predict divergence; ~0 flags dead gradients)",
+    ),
+    "health.update_norm": (
+        "gauge",
+        "global norm of the applied parameter update (post-optimizer)",
+    ),
+    "health.param_norm": (
+        "gauge",
+        "global parameter norm after the step (drift/blowup evidence)",
+    ),
+    "health.nonfinite": (
+        "counter",
+        "steps whose fused on-device NaN/Inf flag fired (loss or grads)",
+    ),
+    "health.anomaly": (
+        "event",
+        "HealthMonitor detection: nonfinite streak, grad explosion, or "
+        "median+MAD loss spike (kind, step, detector detail)",
+    ),
+    "health.rollback": (
+        "event",
+        "divergence auto-rollback: restored the last crc-verified "
+        "checkpoint step (from_step → step, lr_scale when backed off)",
+    ),
+    "health.profile": (
+        "event",
+        "windowed jax.profiler capture committed (TPUFLOW_PROFILE="
+        "start:stop): step window + trace directory",
+    ),
+    # ----------------------------------------------------------------- obs
+    "obs.dropped": (
+        "event",
+        "telemetry events lost by this recorder (buffer overflow or a "
+        "failed flush), surfaced once at close — never silently",
+    ),
     # ------------------------------------------------------------ warnings
     "warn.flash_min_seq_malformed": (
         "event",

@@ -1,0 +1,440 @@
+"""Training-health observatory: numerics policy + divergence handling.
+
+The telemetry stream (ISSUE 1) answers "where did time go"; the fault
+machinery (ISSUE 2) answers "who died". This module closes the remaining
+gap — a run whose *numerics* go bad (NaN'd loss, exploding gradients, a
+loss spike after an optimizer misstep) used to burn its remaining budget
+producing garbage. Three pieces:
+
+- ``HealthMonitor`` — a host-side policy object fed one observation per
+  fenced train step (loss, global grad norm, the fused nonfinite flag
+  computed *inside* the jitted step — see ``tpuflow.train.optim.
+  health_stats``). Detectors: a consecutive-nonfinite budget, an absolute
+  grad-norm explosion threshold, and a rolling median+MAD loss-spike
+  test (robust statistics — one earlier spike must not inflate the
+  baseline the next one is judged against). On detection it records a
+  ``health.anomaly`` event and returns an ``Anomaly`` for the loop to
+  act on.
+
+- Divergence handling — ``handle_anomaly`` decides rollback vs halt:
+  with rollback enabled (the default) it returns the newest checkpoint
+  step whose shards pass crc32 verification (``last_verified_step``,
+  reusing the ISSUE 2 integrity machinery) for the loop to restore;
+  otherwise it raises ``TrainingDiverged`` with a diagnostic instead of
+  letting the run report NaN losses.
+
+- ``ProfileWindow`` — ``TPUFLOW_PROFILE=<start>:<stop>`` wraps exactly
+  those train steps in a ``jax.profiler`` trace saved under
+  ``<run_dir>/obs/profile/`` and recorded as a ``health.profile`` event
+  the timeline card references.
+
+Everything is env-configured (``TPUFLOW_HEALTH*`` knobs, see
+``HealthConfig``) so a babysitting policy can be changed per launch
+without a code change, and ``TPUFLOW_HEALTH=0`` removes the monitor
+entirely — the loops then pay one ``is not None`` check per step.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+import statistics
+from typing import Any
+
+from tpuflow.obs import recorder as _rec
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Env-tunable training-health policy (``TPUFLOW_HEALTH*``).
+
+    - ``TPUFLOW_HEALTH=0``            disable monitoring entirely
+    - ``TPUFLOW_HEALTH_ROLLBACK=0``   halt with a diagnostic instead of
+                                      auto-rolling-back to the last
+                                      verified checkpoint
+    - ``TPUFLOW_HEALTH_NAN_BUDGET``   consecutive nonfinite steps that
+                                      trip the detector (default 1: the
+                                      first NaN/Inf step is an anomaly —
+                                      a NaN update has already poisoned
+                                      params AND optimizer moments)
+    - ``TPUFLOW_HEALTH_WINDOW``       rolling loss window (default 64)
+    - ``TPUFLOW_HEALTH_WARMUP``       observations required before the
+                                      spike test judges (default 16)
+    - ``TPUFLOW_HEALTH_SPIKE_MADS``   spike threshold in robust sigmas
+                                      above the window median (default
+                                      12; sigma = 1.4826·MAD with a 1 %
+                                      -of-median floor so a flat window
+                                      can't make any jitter an anomaly)
+    - ``TPUFLOW_HEALTH_GRAD_MAX``     absolute grad-norm explosion
+                                      threshold (default 0 = off; early
+                                      training legitimately spikes, so
+                                      this knob is opt-in per run)
+    - ``TPUFLOW_HEALTH_MAX_ROLLBACKS`` rollback budget before anomalies
+                                      halt anyway (default 2 — a run
+                                      that keeps diverging needs a
+                                      human, not a loop)
+    - ``TPUFLOW_HEALTH_LR_BACKOFF``   LR multiplier applied on each
+                                      rollback (default 1.0 = off;
+                                      e.g. 0.5 halves the peak LR so
+                                      the replayed steps take a gentler
+                                      trajectory)
+    """
+
+    enabled: bool = True
+    rollback: bool = True
+    nan_budget: int = 1
+    window: int = 64
+    warmup: int = 16
+    spike_mads: float = 12.0
+    grad_norm_max: float = 0.0
+    max_rollbacks: int = 2
+    lr_backoff: float = 1.0
+
+    @classmethod
+    def from_env(cls) -> "HealthConfig":
+        return cls(
+            enabled=os.environ.get("TPUFLOW_HEALTH", "1")
+            not in ("0", "false"),
+            rollback=os.environ.get("TPUFLOW_HEALTH_ROLLBACK", "1")
+            not in ("0", "false"),
+            nan_budget=max(1, _env_int("TPUFLOW_HEALTH_NAN_BUDGET", 1)),
+            window=max(4, _env_int("TPUFLOW_HEALTH_WINDOW", 64)),
+            warmup=max(2, _env_int("TPUFLOW_HEALTH_WARMUP", 16)),
+            spike_mads=_env_float("TPUFLOW_HEALTH_SPIKE_MADS", 12.0),
+            grad_norm_max=_env_float("TPUFLOW_HEALTH_GRAD_MAX", 0.0),
+            max_rollbacks=_env_int("TPUFLOW_HEALTH_MAX_ROLLBACKS", 2),
+            lr_backoff=_env_float("TPUFLOW_HEALTH_LR_BACKOFF", 1.0),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    """One detected divergence: what tripped, at which optimizer step."""
+
+    kind: str          # nonfinite | grad_explosion | loss_spike
+    step: int
+    detail: dict[str, Any]
+
+    def describe(self) -> str:
+        parts = ", ".join(f"{k}={v}" for k, v in sorted(self.detail.items()))
+        return f"{self.kind} at step {self.step} ({parts})"
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised when an anomaly cannot (or must not) be rolled back: the
+    run halts with a diagnostic instead of reporting NaN losses."""
+
+    def __init__(self, anomaly: Anomaly, *, hint: str = ""):
+        self.anomaly = anomaly
+        msg = (
+            f"training diverged: {anomaly.describe()}. "
+            "No rollback was performed"
+        )
+        if hint:
+            msg += f" ({hint})"
+        msg += (
+            ". Knobs: TPUFLOW_HEALTH_ROLLBACK=1 re-enables checkpoint "
+            "rollback, TPUFLOW_HEALTH=0 disables monitoring, "
+            "TPUFLOW_HEALTH_NAN_BUDGET / _SPIKE_MADS / _GRAD_MAX tune "
+            "the detectors (README: Training health runbook)."
+        )
+        super().__init__(msg)
+
+
+class HealthMonitor:
+    """Rolling-statistics divergence detector, one instance per run.
+
+    Feed it one observation per fenced step; it returns an ``Anomaly``
+    when a detector trips (recording a ``health.anomaly`` event) and
+    ``None`` otherwise. Stateless consumers can rebuild the same view
+    from the ``health.*`` telemetry — this object exists so the training
+    loop itself can act before the run budget burns.
+    """
+
+    def __init__(self, cfg: HealthConfig | None = None):
+        self.cfg = cfg or HealthConfig.from_env()
+        self._window: collections.deque[float] = collections.deque(
+            maxlen=self.cfg.window
+        )
+        self._nan_streak = 0
+        self.rollbacks = 0
+        self.last: dict[str, float] = {}
+
+    @classmethod
+    def from_env(cls) -> "HealthMonitor | None":
+        """The run's monitor, or ``None`` when ``TPUFLOW_HEALTH=0`` — the
+        disabled path is one ``is not None`` check per step."""
+        cfg = HealthConfig.from_env()
+        return cls(cfg) if cfg.enabled else None
+
+    # -------------------------------------------------------------- observe
+    def observe(
+        self,
+        step: int,
+        loss: float,
+        grad_norm: float | None = None,
+        nonfinite: bool | None = None,
+    ) -> Anomaly | None:
+        if nonfinite is None:
+            nonfinite = not math.isfinite(loss) or (
+                grad_norm is not None and not math.isfinite(grad_norm)
+            )
+        self.last = {
+            "step": step,
+            "loss": loss,
+            "grad_norm": grad_norm if grad_norm is not None else float("nan"),
+        }
+        if nonfinite:
+            self._nan_streak += 1
+            if self._nan_streak >= self.cfg.nan_budget:
+                return self._anomaly(
+                    "nonfinite", step,
+                    loss=loss, grad_norm=grad_norm,
+                    streak=self._nan_streak, budget=self.cfg.nan_budget,
+                )
+            return None
+        self._nan_streak = 0
+        if (
+            self.cfg.grad_norm_max > 0.0
+            and grad_norm is not None
+            and grad_norm > self.cfg.grad_norm_max
+        ):
+            return self._anomaly(
+                "grad_explosion", step,
+                grad_norm=grad_norm, threshold=self.cfg.grad_norm_max,
+            )
+        if len(self._window) >= self.cfg.warmup:
+            med = statistics.median(self._window)
+            mad = statistics.median(abs(x - med) for x in self._window)
+            # Robust sigma with a relative floor: a perfectly flat window
+            # (MAD ~ 0) must not brand ordinary jitter an anomaly.
+            sigma = max(1.4826 * mad, 0.01 * abs(med), 1e-6)
+            threshold = med + self.cfg.spike_mads * sigma
+            if loss > threshold:
+                # The spike is NOT appended — the window stays a
+                # pre-spike baseline for any follow-up judgment.
+                return self._anomaly(
+                    "loss_spike", step,
+                    loss=loss, median=round(med, 6),
+                    threshold=round(threshold, 6), window=len(self._window),
+                )
+        self._window.append(loss)
+        return None
+
+    def rolled_back(self) -> None:
+        """Reset transient state after a checkpoint rollback: the streak
+        belongs to the discarded trajectory. The loss window is kept —
+        it is pre-anomaly history the replayed steps are judged against."""
+        self.rollbacks += 1
+        self._nan_streak = 0
+
+    def _anomaly(self, kind: str, step: int, **detail) -> Anomaly:
+        detail = {
+            k: (float(v) if isinstance(v, float) else v)
+            for k, v in detail.items()
+            if v is not None
+        }
+        # 'detector' not 'kind': the event schema already uses "kind" for
+        # the record type (span/counter/.../event).
+        _rec.event("health.anomaly", detector=kind, step=step, **detail)
+        return Anomaly(kind=kind, step=step, detail=detail)
+
+
+# ------------------------------------------------------------- rollback
+def last_verified_step(manager) -> int | None:
+    """Newest checkpoint step whose shards pass integrity verification
+    (``CheckpointManager.verify_step``, ISSUE 2): the only steps a
+    divergence rollback may restore — rolling back onto silently
+    corrupted weights would trade one failure for a worse one."""
+    for step in reversed(manager.all_steps()):
+        try:
+            if manager.verify_step(step):
+                return step
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def handle_anomaly(monitor: HealthMonitor, anomaly: Anomaly, manager) -> int:
+    """Decide the anomaly's fate: the verified step to roll back to, or
+    ``TrainingDiverged`` when policy forbids / budget is exhausted / no
+    verified checkpoint exists. The caller restores and records the
+    ``health.rollback`` event (it knows the restored state + LR scale)."""
+    cfg = monitor.cfg
+    if not cfg.rollback:
+        raise TrainingDiverged(anomaly, hint="TPUFLOW_HEALTH_ROLLBACK=0")
+    if monitor.rollbacks >= cfg.max_rollbacks:
+        raise TrainingDiverged(
+            anomaly,
+            hint=f"rollback budget exhausted "
+            f"({monitor.rollbacks}/{cfg.max_rollbacks})",
+        )
+    target = last_verified_step(manager)
+    if target is None:
+        raise TrainingDiverged(anomaly, hint="no verified checkpoint to restore")
+    monitor.rolled_back()
+    return target
+
+
+class _RollbackSignal(Exception):
+    """Internal control flow: unwind the epoch loop to the restore point.
+    Carries the verified target step and the anomaly that caused it."""
+
+    def __init__(self, target: int, anomaly: Anomaly):
+        self.target = target
+        self.anomaly = anomaly
+        super().__init__(f"rollback to step {target}: {anomaly.describe()}")
+
+
+# ------------------------------------------------------ windowed profiler
+class ProfileWindow:
+    """``TPUFLOW_PROFILE=<start>:<stop>`` — capture a ``jax.profiler``
+    trace of exactly train steps ``start..stop`` (1-based optimizer
+    steps, inclusive) into ``<obs_dir>/profile/``.
+
+    Windowed on purpose: whole-run traces are huge and skew steady-state
+    timing; two steps around a suspected stall are what a babysitter
+    actually opens. The capture is best-effort — a profiler failure
+    must never fail the run."""
+
+    def __init__(self, start: int, stop: int, out_dir: str):
+        self.start = start
+        self.stop = stop
+        self.out_dir = out_dir
+        self._active = False
+        self._done = False
+
+    @classmethod
+    def from_env(cls, out_dir: str | None = None) -> "ProfileWindow | None":
+        spec = os.environ.get("TPUFLOW_PROFILE", "")
+        if not spec:
+            return None
+        try:
+            start_s, _, stop_s = spec.partition(":")
+            start, stop = int(start_s), int(stop_s or start_s)
+        except ValueError:
+            print(
+                f"[tpuflow] malformed TPUFLOW_PROFILE={spec!r} "
+                "(want <startstep>:<stopstep>); profiling disabled"
+            )
+            return None
+        if start < 1 or stop < start:
+            print(
+                f"[tpuflow] TPUFLOW_PROFILE={spec!r} window is empty; "
+                "profiling disabled"
+            )
+            return None
+        if out_dir is None:
+            rec = _rec.recorder()
+            if rec is not None:
+                out_dir = os.path.join(rec.directory, "profile")
+            else:
+                out_dir = os.environ.get("TPUFLOW_PROFILE_DIR")
+        if not out_dir:
+            print(
+                "[tpuflow] TPUFLOW_PROFILE set but telemetry is disabled "
+                "and TPUFLOW_PROFILE_DIR is unset; profiling disabled"
+            )
+            return None
+        return cls(start, stop, out_dir)
+
+    def maybe_start(self, step: int) -> None:
+        """Call before executing optimizer step ``step``."""
+        if self._active or self._done or step < self.start:
+            return
+        try:
+            import jax
+
+            os.makedirs(self.out_dir, exist_ok=True)
+            jax.profiler.start_trace(self.out_dir)
+            self._active = True
+        except Exception as e:
+            self._done = True
+            print(f"[tpuflow] profiler start failed (ignored): {e!r}")
+
+    def maybe_stop(self, step: int) -> None:
+        """Call after fencing optimizer step ``step``."""
+        if not self._active or step < self.stop:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            print(f"[tpuflow] profiler stop failed (ignored): {e!r}")
+        self._active = False
+        self._done = True
+        _rec.event(
+            "health.profile",
+            start_step=self.start, stop_step=self.stop, dir=self.out_dir,
+        )
+
+    def close(self) -> None:
+        """End-of-run safety net: a window whose stop step was never
+        reached (short run, early halt) must not leave the process-wide
+        profiler running."""
+        if self._active:
+            self.maybe_stop(self.stop)
+
+
+# ------------------------------------------------------------- summaries
+_HEALTH_EVENTS = ("health.anomaly", "health.rollback", "health.profile")
+
+
+def health_summary(events) -> dict[str, Any]:
+    """Fold an event stream into the run-health view ``Run.health()``
+    serves and ``obs.summarize`` embeds: anomalies/rollbacks/profile
+    windows (compact dicts), the last numerics gauges, nonfinite-step
+    and dropped-event totals."""
+    out: dict[str, Any] = {
+        "anomalies": [],
+        "rollbacks": [],
+        "profiles": [],
+        "last": {},
+        "nonfinite_steps": 0.0,
+        "dropped_events": 0.0,
+    }
+    for ev in events:
+        name = ev.get("name", "")
+        kind = ev.get("kind")
+        if kind == "event":
+            compact = {
+                k: v
+                for k, v in ev.items()
+                if k not in ("kind", "name", "pid")
+            }
+            if name == "health.anomaly":
+                out["anomalies"].append(compact)
+            elif name == "health.rollback":
+                out["rollbacks"].append(compact)
+            elif name == "health.profile":
+                out["profiles"].append(compact)
+            elif name == "obs.dropped":
+                out["dropped_events"] += float(ev.get("value", 0.0))
+        elif kind == "counter" and name == "health.nonfinite":
+            out["nonfinite_steps"] += float(ev.get("value", 1.0))
+        elif kind == "gauge" and name.startswith("health."):
+            out["last"][name[len("health."):]] = float(ev.get("value", 0.0))
+    return out

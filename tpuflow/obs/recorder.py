@@ -87,6 +87,13 @@ class _Span:
         return False
 
 
+# Buffer bound: a wedged disk (every append raising OSError) must not let
+# the in-memory buffer grow without limit for the rest of the run. Beyond
+# the cap events are counted, not kept — and the count is surfaced as a
+# final ``obs.dropped`` event at close, so loss is visible, never silent.
+_DEFAULT_MAX_BUFFERED = 65536
+
+
 class Recorder:
     """Buffered JSONL event writer for one process."""
 
@@ -96,6 +103,7 @@ class Recorder:
         *,
         proc: int = 0,
         flush_interval: float = 0.5,
+        max_buffered: int | None = None,
     ):
         self.directory = os.path.abspath(directory)
         self.proc = int(proc)
@@ -107,6 +115,16 @@ class Recorder:
         )
         os.makedirs(self.directory, exist_ok=True)
         self._buf: list[dict] = []
+        if max_buffered is None:
+            try:
+                max_buffered = int(
+                    os.environ.get("TPUFLOW_OBS_MAX_BUFFERED", "")
+                    or _DEFAULT_MAX_BUFFERED
+                )
+            except ValueError:
+                max_buffered = _DEFAULT_MAX_BUFFERED
+        self._max_buffered = max(1, max_buffered)
+        self.dropped = 0  # events lost to overflow or failed flushes
         self._lock = threading.Lock()
         self._closed = False
         self._flush_interval = flush_interval
@@ -127,8 +145,14 @@ class Recorder:
             **attrs,
         }
         with self._lock:
-            if not self._closed:
-                self._buf.append(ev)
+            if self._closed:
+                return
+            if len(self._buf) >= self._max_buffered:
+                # Telemetry must never fail (or bloat) the run: beyond the
+                # cap events are counted and dropped, surfaced at close.
+                self.dropped += 1
+                return
+            self._buf.append(ev)
 
     def span(self, name: str, **attrs) -> _Span:
         return _Span(self, name, attrs)
@@ -146,7 +170,10 @@ class Recorder:
             with open(self.path, "a") as f:
                 f.write(lines)
         except OSError:
-            pass  # telemetry must never fail the run
+            # Telemetry must never fail the run — but the drained batch
+            # is gone; count it so close() can surface the loss.
+            with self._lock:
+                self.dropped += len(buf)
 
     def _flush_loop(self) -> None:
         while not self._closed:
@@ -158,9 +185,33 @@ class Recorder:
         self._drain()
 
     def close(self) -> None:
+        if self._closed:  # idempotent (configure() then atexit)
+            return
         self._closed = True
         self._wake.set()
         self._drain()
+        if self.dropped:
+            # Final accounting event, appended directly (the buffer is
+            # closed): a consumer summing ``obs.dropped`` values knows
+            # exactly how many events this process lost. Best-effort —
+            # a still-broken disk loses the accounting line too.
+            try:
+                with open(self.path, "a") as f:
+                    f.write(
+                        json.dumps(
+                            {
+                                "kind": "event",
+                                "name": "obs.dropped",
+                                "ts": time.time(),
+                                "proc": self.proc,
+                                "pid": os.getpid(),
+                                "value": self.dropped,
+                            }
+                        )
+                        + "\n"
+                    )
+            except OSError:
+                pass
         self._thread.join(timeout=2)
 
 

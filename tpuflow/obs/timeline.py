@@ -98,8 +98,10 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
          "counters": {name: total},
          "gauges": {name: {last, max}},
          "histograms": {name: {count, mean, p50, max, total}},
+         "health": {...},     # anomalies/rollbacks/profiles/last numerics
          "headline": {...}}   # step time, tokens/s, ckpt GB/s, data wait
     """
+    events = list(events)
     spans: dict[str, dict] = {}
     counters: dict[str, float] = {}
     gauges: dict[str, dict] = {}
@@ -174,10 +176,24 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
     committed = counters.get("infer.spec.committed", 0.0)
     if fwds > 0:
         headline["spec_tokens_per_forward"] = committed / fwds
+
+    # Training-health view (ISSUE 3): anomaly/rollback/profile events +
+    # last numerics gauges, with headline counts so a glance at run.json
+    # answers "did this run diverge".
+    from tpuflow.obs.health import health_summary
+
+    health = health_summary(events)
+    if health["anomalies"]:
+        headline["health_anomalies"] = len(health["anomalies"])
+    if health["rollbacks"]:
+        headline["health_rollbacks"] = len(health["rollbacks"])
+    if health["dropped_events"]:
+        headline["obs_dropped_events"] = health["dropped_events"]
     return {
         "spans": spans,
         "counters": counters,
         "gauges": gauges,
         "histograms": hist_out,
+        "health": health,
         "headline": headline,
     }

@@ -10,6 +10,14 @@ process. Grammar (one spec per entry)::
                                  at the end of step <k> (simulated SIGTERM:
                                  the loop drains a checkpoint and exits
                                  with the requeue code)
+    nan_grad:<rank>@step<k>      poison member <rank>'s params before step
+                                 <k> so that step's loss and gradients are
+                                 NaN (single-shot — the replayed step after
+                                 a health rollback runs clean); exercises
+                                 the fused nonfinite detector end to end
+    loss_spike:<rank>@step<k>    scale params ×1e3 before step <k>: a huge
+                                 but finite loss/grad spike (single-shot)
+                                 for the median+MAD spike detector
     heartbeat_stall:<rank>       member <rank> stops stamping heartbeats
                                  and hangs (simulated livelock) — the
                                  supervisor must detect and kill it
@@ -49,6 +57,8 @@ class Fault:
 KINDS = (
     "member_exit",
     "preempt",
+    "nan_grad",
+    "loss_spike",
     "heartbeat_stall",
     "rendezvous_delay",
     "ckpt_truncate",
@@ -82,7 +92,7 @@ def parse(raw: str) -> list[Fault]:
                 f"known: {KINDS}"
             )
         rank = step = value = None
-        if kind in ("member_exit", "preempt"):
+        if kind in ("member_exit", "preempt", "nan_grad", "loss_spike"):
             rank_s, _, step_s = payload.partition("@")
             rank = int(rank_s)
             if not step_s.startswith("step"):
@@ -151,7 +161,43 @@ def step_boundary(step: int) -> None:
                 file=sys.stderr,
             )
             sys.stderr.flush()
+            # os._exit skips atexit, so the recorder's close() never runs:
+            # drain the telemetry buffer here or the dying member's last
+            # steps vanish from events.jsonl (ISSUE 3 satellite).
+            from tpuflow import obs
+
+            obs.flush()
             os._exit(1)
+
+
+_POISON = {"nan_grad": float("nan"), "loss_spike": 1e3}
+
+
+def grad_poison(step: int) -> float | None:
+    """Train-loop hook: a parameter multiplier to apply BEFORE executing
+    optimizer step ``step``, or None. ``nan_grad`` poisons params with NaN
+    (→ NaN loss and gradients inside the jitted step, tripping the fused
+    nonfinite flag); ``loss_spike`` scales params ×1e3 (finite spike for
+    the median+MAD detector). Single-shot per spec: after a health
+    rollback the replayed step runs clean, so detection → rollback →
+    recovery is provable end to end."""
+    if not os.environ.get("TPUFLOW_FAULT"):
+        return None
+    rank = _rank()
+    for kind, mult in _POISON.items():
+        for f in matching(kind):
+            if f.rank != rank or f.step != step:
+                continue
+            key = f"{kind}:{f.rank}@{f.step}"
+            if key in _FIRED:
+                continue
+            _FIRED.add(key)
+            print(
+                f"[faults] {kind} injected before step {step}",
+                file=sys.stderr,
+            )
+            return mult
+    return None
 
 
 def maybe_rendezvous_delay() -> None:

@@ -1,5 +1,8 @@
 """Distributed training runtime (Trainer, configs, context, Result)."""
 
+# Re-exported for callers catching the health observatory's halt (its
+# home is tpuflow.obs.health, next to the detectors that raise it).
+from tpuflow.obs.health import TrainingDiverged
 from tpuflow.train.gpt import GptTrainConfig, GptTrainResult, train_gpt
 from tpuflow.train.optim import make_optimizer, make_schedule
 from tpuflow.train.step import (
@@ -31,6 +34,7 @@ __all__ = [
     "TrainContext",
     "TrainState",
     "Trainer",
+    "TrainingDiverged",
     "create_train_state",
     "get_context",
     "make_eval_step",

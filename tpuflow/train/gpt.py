@@ -360,7 +360,17 @@ def _train_fsdp(
         # fences the loop already pays; batch-wait rides the loader
         # iterator. All no-ops when obs is disabled.
         from tpuflow import obs
+        from tpuflow.obs import health as health_mod
         from tpuflow.train.step import StepClock
+
+        # Training-health observatory (ISSUE 3): the monitor judges each
+        # fenced step's numerics (None when TPUFLOW_HEALTH=0 — one
+        # ``is not None`` check per step), the profile window wraps the
+        # TPUFLOW_PROFILE step range in a jax.profiler trace.
+        monitor = health_mod.HealthMonitor.from_env()
+        profile = health_mod.ProfileWindow.from_env()
+        lr_scale = 1.0
+        fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
 
         def drain_preempt() -> None:
             # SIGTERM landed (or was injected): commit a final checkpoint
@@ -381,101 +391,213 @@ def _train_fsdp(
 
         clock = StepClock()
         cold = True
-        for epoch in range(start_epoch, cfg.epochs):
-            t_epoch = time.monotonic()
-            ts_epoch = time.time()
-            loader.set_epoch(epoch)
-            losses = []
-            n_tokens = 0
-            clock.reset()
-            for i, b in enumerate(obs.timed_iter(loader, "data.batch_wait_s")):
-                batch = {
-                    "x": jax.device_put(b["x"], batch_sharding),
-                    "y": jax.device_put(b["y"], batch_sharding),
-                }
-                state, metrics = train_step(state, batch, rng)
-                losses.append(metrics["loss"])
-                if cold:
-                    # Fence out jit compilation so throughput numbers are
-                    # comparable across epochs; the first batch's tokens
-                    # are excluded from the rate accordingly.
-                    jax.block_until_ready(metrics["loss"])
+        while True:
+            try:
+                for epoch in range(start_epoch, cfg.epochs):
                     t_epoch = time.monotonic()
                     ts_epoch = time.time()
-                    clock.compile_done(preset=cfg.preset)
-                    cold = False
-                else:
-                    dist.step_fence(metrics["loss"])
-                    n_tokens += int(np.prod(b["y"].shape))
-                    clock.step_done(tokens=int(np.prod(b["y"].shape)))
-                opt_step += 1
-                if os.environ.get("TPUFLOW_FAULT"):
-                    from tpuflow.testing import faults
+                    loader.set_epoch(epoch)
+                    losses = []
+                    n_tokens = 0
+                    clock.reset()
+                    for i, b in enumerate(
+                        obs.timed_iter(loader, "data.batch_wait_s")
+                    ):
+                        if fault_env:
+                            from tpuflow.testing import faults
 
-                    faults.step_boundary(opt_step)
-                if preemption_requested():
-                    drain_preempt()
-            jax.block_until_ready(state.params)
-            epoch_s = time.monotonic() - t_epoch
-            tok_s = n_tokens / max(epoch_s, 1e-9) if n_tokens else None
-            epoch_loss = float(jnp.stack(losses).mean())
-            history.append(epoch_loss)
-            rec = obs.recorder()
-            if rec is not None:
-                rec.record(
-                    "span", "train.epoch", ts=ts_epoch, dur_s=epoch_s,
-                    epoch=epoch, loss=epoch_loss,
-                    tokens_per_s=round(tok_s, 1) if tok_s else None,
-                )
-            # Held-out validation: token-level loss -> perplexity over
-            # EVERY test window (padded tail masked out). The best/retention
-            # policy keys on real val loss, matching the reference's
-            # save-best-on-val semantics (my_ray_module.py:190-201), not
-            # the train loss.
-            with obs.span("train.validation", epoch=epoch):
-                val_loss = run_validation(
-                    state,
-                    val_loader,
-                    eval_step,
-                    place=lambda x: jax.device_put(x, batch_sharding),
-                )
-            ppl = math.exp(min(val_loss, 30.0))
-            epoch_records.append(
-                {
-                    "epoch": epoch,
-                    "train_loss": epoch_loss,
-                    "val_loss": val_loss,
-                    "ppl": ppl,
-                    "tokens_per_s": round(tok_s, 1) if tok_s else None,
-                }
-            )
-            rate = f" ({tok_s:.0f} tok/s)" if tok_s else ""
-            log(
-                f"[gpt] epoch {epoch}: loss={epoch_loss:.4f} "
-                f"val_loss={val_loss:.4f} ppl={ppl:.2f}{rate}"
-            )
-            payload = {
-                "step": state.step,
-                "params": state.params,
-                "opt_state": state.opt_state,
-            }
-            if cfg.ema_decay > 0.0:
-                payload["ema_params"] = state.ema_params
-            mgr.save(
-                int(state.step),
-                payload,
-                metrics={
-                    "val_loss": val_loss,
-                    "train_loss": epoch_loss,
-                    "ppl": ppl,
-                },
-            )
-            if launch_attempt() > 0:
-                # Retried attempt: commit eagerly so this epoch is durable
-                # before the crashing step reruns (see utils.preempt.
-                # launch_attempt — deferred commits livelock deterministic
-                # crashes).
+                            poison = faults.grad_poison(opt_step + 1)
+                            if poison is not None:
+                                state = state.replace(
+                                    params=jax.tree_util.tree_map(
+                                        lambda p: p * poison, state.params
+                                    )
+                                )
+                        if profile is not None:
+                            profile.maybe_start(opt_step + 1)
+                        batch = {
+                            "x": jax.device_put(b["x"], batch_sharding),
+                            "y": jax.device_put(b["y"], batch_sharding),
+                        }
+                        state, metrics = train_step(state, batch, rng)
+                        losses.append(metrics["loss"])
+                        if cold:
+                            # Fence out jit compilation so throughput
+                            # numbers are comparable across epochs; the
+                            # first batch's tokens are excluded from the
+                            # rate accordingly.
+                            jax.block_until_ready(metrics["loss"])
+                            t_epoch = time.monotonic()
+                            ts_epoch = time.time()
+                            clock.compile_done(preset=cfg.preset)
+                            cold = False
+                        else:
+                            dist.step_fence(metrics["loss"])
+                            n_tokens += int(np.prod(b["y"].shape))
+                            clock.step_done(tokens=int(np.prod(b["y"].shape)))
+                        opt_step += 1
+                        if profile is not None:
+                            profile.maybe_stop(opt_step)
+                        if monitor is not None or clock.recording:
+                            # The fence above already materialized the
+                            # step's outputs; these are 4-byte host
+                            # copies, not device syncs.
+                            nf = bool(float(metrics["nonfinite"]))
+                            m_loss = float(metrics["loss"])
+                            m_gn = float(metrics["grad_norm"])
+                            if clock.recording:
+                                clock.health_done(
+                                    loss=m_loss,
+                                    grad_norm=m_gn,
+                                    update_norm=float(metrics["update_norm"]),
+                                    param_norm=float(metrics["param_norm"]),
+                                    nonfinite=nf,
+                                )
+                            if monitor is not None:
+                                anomaly = monitor.observe(
+                                    opt_step, m_loss, m_gn, nonfinite=nf
+                                )
+                                if anomaly is not None:
+                                    target = health_mod.handle_anomaly(
+                                        monitor, anomaly, mgr
+                                    )
+                                    raise health_mod._RollbackSignal(
+                                        target, anomaly
+                                    )
+                        if fault_env:
+                            from tpuflow.testing import faults
+
+                            faults.step_boundary(opt_step)
+                        if preemption_requested():
+                            drain_preempt()
+                    jax.block_until_ready(state.params)
+                    epoch_s = time.monotonic() - t_epoch
+                    tok_s = (
+                        n_tokens / max(epoch_s, 1e-9) if n_tokens else None
+                    )
+                    epoch_loss = float(jnp.stack(losses).mean())
+                    history.append(epoch_loss)
+                    rec = obs.recorder()
+                    if rec is not None:
+                        rec.record(
+                            "span", "train.epoch", ts=ts_epoch, dur_s=epoch_s,
+                            epoch=epoch, loss=epoch_loss,
+                            tokens_per_s=round(tok_s, 1) if tok_s else None,
+                        )
+                    # Held-out validation: token-level loss -> perplexity
+                    # over EVERY test window (padded tail masked out). The
+                    # best/retention policy keys on real val loss, matching
+                    # the reference's save-best-on-val semantics
+                    # (my_ray_module.py:190-201), not the train loss.
+                    with obs.span("train.validation", epoch=epoch):
+                        val_loss = run_validation(
+                            state,
+                            val_loader,
+                            eval_step,
+                            place=lambda x: jax.device_put(
+                                x, batch_sharding
+                            ),
+                        )
+                    ppl = math.exp(min(val_loss, 30.0))
+                    epoch_records.append(
+                        {
+                            "epoch": epoch,
+                            "train_loss": epoch_loss,
+                            "val_loss": val_loss,
+                            "ppl": ppl,
+                            "tokens_per_s": round(tok_s, 1)
+                            if tok_s
+                            else None,
+                        }
+                    )
+                    rate = f" ({tok_s:.0f} tok/s)" if tok_s else ""
+                    log(
+                        f"[gpt] epoch {epoch}: loss={epoch_loss:.4f} "
+                        f"val_loss={val_loss:.4f} ppl={ppl:.2f}{rate}"
+                    )
+                    payload = {
+                        "step": state.step,
+                        "params": state.params,
+                        "opt_state": state.opt_state,
+                    }
+                    if cfg.ema_decay > 0.0:
+                        payload["ema_params"] = state.ema_params
+                    mgr.save(
+                        int(state.step),
+                        payload,
+                        metrics={
+                            "val_loss": val_loss,
+                            "train_loss": epoch_loss,
+                            "ppl": ppl,
+                        },
+                    )
+                    if launch_attempt() > 0:
+                        # Retried attempt: commit eagerly so this epoch is
+                        # durable before the crashing step reruns (see
+                        # utils.preempt.launch_attempt — deferred commits
+                        # livelock deterministic crashes).
+                        mgr.wait_until_finished()
+                break
+            except health_mod.TrainingDiverged:
+                # Halt path: drain in-flight saves so the failing process
+                # leaves only committed checkpoints behind.
                 mgr.wait_until_finished()
+                raise
+            except health_mod._RollbackSignal as rb:
+                # Divergence auto-rollback: restore the last crc-verified
+                # checkpoint (handle_anomaly picked it) and replay from
+                # there — the reverse of the in-run resume path above.
+                from_step = opt_step
+                if monitor.cfg.lr_backoff != 1.0:
+                    # LR backoff rides a rebuilt optimizer; the schedule
+                    # lives inside the compiled update, so the new tx
+                    # recompiles the step — acceptable for an event that
+                    # is rare by construction (max_rollbacks bounds it).
+                    lr_scale *= monitor.cfg.lr_backoff
+                    tx = dataclasses.replace(
+                        cfg,
+                        learning_rate=cfg.learning_rate * lr_scale,
+                    ).optimizer()
+                tmpl = {
+                    "step": state.step,
+                    "params": state.params,
+                    "opt_state": state.opt_state,
+                }
+                if cfg.ema_decay > 0.0:
+                    tmpl["ema_params"] = state.params
+                restored = mgr.restore(rb.target, abstract_state=tmpl)
+                jax.block_until_ready(restored)
+                state = TrainState(
+                    step=restored["step"],
+                    apply_fn=model.apply,
+                    params=restored["params"],
+                    tx=tx,
+                    opt_state=restored["opt_state"],
+                    batch_stats={},
+                    ema_params=restored.get("ema_params", {}),
+                )
+                opt_step = int(state.step)
+                start_epoch = min(
+                    opt_step // cfg.steps_per_epoch, cfg.epochs
+                )
+                # Rewind every history the replayed epochs will re-append
+                # to — the save-per-epoch invariant keeps them in step.
+                mgr.rewind_history(rb.target)
+                history = history[:start_epoch]
+                epoch_records = epoch_records[:start_epoch]
+                obs.event(
+                    "health.rollback",
+                    step=rb.target, from_step=from_step,
+                    detector=rb.anomaly.kind, lr_scale=lr_scale,
+                    rollbacks=monitor.rollbacks,
+                )
+                log(
+                    f"[gpt] health rollback: {rb.anomaly.describe()} → "
+                    f"restored verified step {rb.target} "
+                    f"(epoch {start_epoch}, lr_scale {lr_scale:g})"
+                )
+        if profile is not None:
+            profile.close()
         mgr.wait_until_finished()
         result = GptTrainResult(
             checkpoint=mgr.checkpoint(),
@@ -566,6 +688,25 @@ def _train_pipeline(
         resume_step = (
             mgr.latest_step() if resume_checkpoint is None else None
         )
+        # One abstract template serves resume AND divergence rollback —
+        # both restore the same pipeline-sharded {step, params, opt_state}.
+        abstract = {
+            "step": jax.ShapeDtypeStruct((), jnp.int32),
+            "params": jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh
+                ),
+                p_shapes,
+                shardings,
+            ),
+            "opt_state": jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh
+                ),
+                opt_shape,
+                opt_shardings,
+            ),
+        }
         if resume_checkpoint is None and resume_step is None:
             # Params born sharded: init is jitted with the pipeline
             # shardings as out_shardings, so no host ever materializes
@@ -577,23 +718,6 @@ def _train_pipeline(
             )
             opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
         else:
-            abstract = {
-                "step": jax.ShapeDtypeStruct((), jnp.int32),
-                "params": jax.tree_util.tree_map(
-                    lambda s, sh: jax.ShapeDtypeStruct(
-                        s.shape, s.dtype, sharding=sh
-                    ),
-                    p_shapes,
-                    shardings,
-                ),
-                "opt_state": jax.tree_util.tree_map(
-                    lambda s, sh: jax.ShapeDtypeStruct(
-                        s.shape, s.dtype, sharding=sh
-                    ),
-                    opt_shape,
-                    opt_shardings,
-                ),
-            }
             if resume_checkpoint is not None:
                 restored = restore_from_handle(
                     resume_checkpoint, abstract_state=abstract
@@ -615,12 +739,26 @@ def _train_pipeline(
         # Donated params/opt_state: old and new state never coexist in HBM
         # (matches make_train_step's donate pattern; safe because mgr.save
         # snapshots device buffers synchronously before its async writer
-        # starts, and the loop rebinds both every step).
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def pp_step(params, opt_state, x, y):
-            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+        # starts, and the loop rebinds both every step). A factory so the
+        # divergence LR backoff can rebuild the step around a rescaled tx.
+        def make_pp_step(tx):
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def pp_step(params, opt_state, x, y):
+                from tpuflow.train.optim import health_stats
+
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return (
+                    new_params,
+                    opt_state,
+                    loss,
+                    health_stats(loss, grads, updates, new_params),
+                )
+
+            return pp_step
+
+        pp_step = make_pp_step(tx)
 
         loader, _ = _loaders(cfg, model_cfg.vocab_size)
         data_sharding = jax.sharding.NamedSharding(
@@ -646,6 +784,7 @@ def _train_pipeline(
                 f"→ epoch {start_epoch}"
             )
         from tpuflow import obs
+        from tpuflow.obs import health as health_mod
         from tpuflow.train.step import StepClock
 
         def drain_preempt() -> None:
@@ -662,50 +801,133 @@ def _train_pipeline(
             mgr.close()
             raise Preempted(f"drained checkpoint at step {global_step}")
 
+        monitor = health_mod.HealthMonitor.from_env()
+        profile = health_mod.ProfileWindow.from_env()
+        lr_scale = 1.0
+        fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
         clock = StepClock()
         first = True
-        for epoch in range(start_epoch, cfg.epochs):
-            loader.set_epoch(epoch)
-            losses = []
-            clock.reset()
-            for b in obs.timed_iter(loader, "data.batch_wait_s"):
-                params, opt_state, loss = pp_step(
-                    params,
-                    opt_state,
-                    jax.device_put(b["x"], data_sharding),
-                    jax.device_put(b["y"], data_sharding),
-                )
-                dist.step_fence(loss)
-                if first:
-                    clock.compile_done(mode="pipeline")
-                    first = False
-                else:
-                    clock.step_done(tokens=int(b["y"].size))
-                losses.append(loss)
-                global_step += 1
-                if os.environ.get("TPUFLOW_FAULT"):
-                    from tpuflow.testing import faults
+        while True:
+            try:
+                for epoch in range(start_epoch, cfg.epochs):
+                    loader.set_epoch(epoch)
+                    losses = []
+                    clock.reset()
+                    for b in obs.timed_iter(loader, "data.batch_wait_s"):
+                        if fault_env:
+                            from tpuflow.testing import faults
 
-                    faults.step_boundary(global_step)
-                if preemption_requested():
-                    drain_preempt()
-            jax.block_until_ready(params)
-            epoch_loss = float(jnp.stack(losses).mean())
-            history.append(epoch_loss)
-            log(f"[gpt] pipeline epoch {epoch}: loss={epoch_loss:.4f}")
-            mgr.save(
-                global_step,
-                {
-                    "step": jnp.int32(global_step),
-                    "params": params,
-                    "opt_state": opt_state,
-                },
-                metrics={"val_loss": epoch_loss},
-            )
-            if launch_attempt() > 0:
-                # Retried attempt: eager commit for monotonic progress
-                # (see utils.preempt.launch_attempt).
+                            poison = faults.grad_poison(global_step + 1)
+                            if poison is not None:
+                                params = jax.tree_util.tree_map(
+                                    lambda p: p * poison, params
+                                )
+                        if profile is not None:
+                            profile.maybe_start(global_step + 1)
+                        params, opt_state, loss, hstats = pp_step(
+                            params,
+                            opt_state,
+                            jax.device_put(b["x"], data_sharding),
+                            jax.device_put(b["y"], data_sharding),
+                        )
+                        dist.step_fence(loss)
+                        if first:
+                            clock.compile_done(mode="pipeline")
+                            first = False
+                        else:
+                            clock.step_done(tokens=int(b["y"].size))
+                        losses.append(loss)
+                        global_step += 1
+                        if profile is not None:
+                            profile.maybe_stop(global_step)
+                        if monitor is not None or clock.recording:
+                            nf = bool(float(hstats["nonfinite"]))
+                            m_loss = float(loss)
+                            m_gn = float(hstats["grad_norm"])
+                            if clock.recording:
+                                clock.health_done(
+                                    loss=m_loss,
+                                    grad_norm=m_gn,
+                                    update_norm=float(
+                                        hstats["update_norm"]
+                                    ),
+                                    param_norm=float(hstats["param_norm"]),
+                                    nonfinite=nf,
+                                )
+                            if monitor is not None:
+                                anomaly = monitor.observe(
+                                    global_step, m_loss, m_gn, nonfinite=nf
+                                )
+                                if anomaly is not None:
+                                    target = health_mod.handle_anomaly(
+                                        monitor, anomaly, mgr
+                                    )
+                                    raise health_mod._RollbackSignal(
+                                        target, anomaly
+                                    )
+                        if fault_env:
+                            from tpuflow.testing import faults
+
+                            faults.step_boundary(global_step)
+                        if preemption_requested():
+                            drain_preempt()
+                    jax.block_until_ready(params)
+                    epoch_loss = float(jnp.stack(losses).mean())
+                    history.append(epoch_loss)
+                    log(
+                        f"[gpt] pipeline epoch {epoch}: "
+                        f"loss={epoch_loss:.4f}"
+                    )
+                    mgr.save(
+                        global_step,
+                        {
+                            "step": jnp.int32(global_step),
+                            "params": params,
+                            "opt_state": opt_state,
+                        },
+                        metrics={"val_loss": epoch_loss},
+                    )
+                    if launch_attempt() > 0:
+                        # Retried attempt: eager commit for monotonic
+                        # progress (see utils.preempt.launch_attempt).
+                        mgr.wait_until_finished()
+                break
+            except health_mod.TrainingDiverged:
                 mgr.wait_until_finished()
+                raise
+            except health_mod._RollbackSignal as rb:
+                from_step = global_step
+                if monitor.cfg.lr_backoff != 1.0:
+                    lr_scale *= monitor.cfg.lr_backoff
+                    tx = dataclasses.replace(
+                        cfg,
+                        learning_rate=cfg.learning_rate * lr_scale,
+                    ).optimizer()
+                    pp_step = make_pp_step(tx)
+                restored = mgr.restore(rb.target, abstract_state=abstract)
+                params = jax.device_put(restored["params"], shardings)
+                opt_state = jax.device_put(
+                    restored["opt_state"], opt_shardings
+                )
+                global_step = int(restored["step"])
+                start_epoch = min(
+                    global_step // cfg.steps_per_epoch, cfg.epochs
+                )
+                mgr.rewind_history(rb.target)
+                history = history[:start_epoch]
+                obs.event(
+                    "health.rollback",
+                    step=rb.target, from_step=from_step,
+                    detector=rb.anomaly.kind, lr_scale=lr_scale,
+                    rollbacks=monitor.rollbacks,
+                )
+                log(
+                    f"[gpt] pipeline health rollback: "
+                    f"{rb.anomaly.describe()} → restored verified step "
+                    f"{rb.target} (epoch {start_epoch})"
+                )
+        if profile is not None:
+            profile.close()
         mgr.wait_until_finished()
         result = GptTrainResult(
             checkpoint=mgr.checkpoint(),

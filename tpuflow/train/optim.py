@@ -111,3 +111,30 @@ def make_optimizer(
             raise ValueError(f"grad_clip_norm must be > 0, got {grad_clip_norm}")
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     return tx
+
+
+def health_stats(loss, grads, updates, new_params) -> dict:
+    """On-device numerics telemetry for one train step (ISSUE 3).
+
+    Piggybacks on the same fused ``optax.global_norm`` reduction the
+    clipping path already runs: ``updates`` is the delta ``tx.update``
+    already produced (no re-derivation), and the NaN/Inf flag is one
+    scalar check on norms that exist anyway — NaN/Inf in ANY leaf
+    propagates into its global norm, so no per-leaf scan runs. All four
+    scalars ride the step's output and materialize with the loss at the
+    step fence — no extra device syncs on the hot path.
+    """
+    import jax.numpy as jnp
+
+    grad_norm = optax.global_norm(grads)
+    update_norm = optax.global_norm(updates)
+    param_norm = optax.global_norm(new_params)
+    nonfinite = jnp.logical_not(
+        jnp.isfinite(loss) & jnp.isfinite(grad_norm) & jnp.isfinite(update_norm)
+    ).astype(jnp.float32)
+    return {
+        "grad_norm": grad_norm,
+        "update_norm": update_norm,
+        "param_norm": param_norm,
+        "nonfinite": nonfinite,
+    }

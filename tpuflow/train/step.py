@@ -90,6 +90,35 @@ class StepClock:
                 obs.counter("train.tokens", tokens)
             self._last = now
 
+    @property
+    def recording(self) -> bool:
+        """Whether this clock's run records telemetry — the loops use it
+        to decide, once per step, whether to host-copy the numerics
+        scalars for the ``health.*`` gauges."""
+        return self._on
+
+    def health_done(
+        self,
+        *,
+        loss: float,
+        grad_norm: float,
+        update_norm: float,
+        param_norm: float,
+        nonfinite: bool,
+    ) -> None:
+        """Record the fenced step's on-device numerics (ISSUE 3). The
+        scalars were computed inside the jitted step and materialized by
+        the fence the loop already paid — this only copies four floats
+        into the event buffer. No-op when telemetry is disabled."""
+        if not self._on:
+            return
+        obs.gauge("health.loss", loss)
+        obs.gauge("health.grad_norm", grad_norm)
+        obs.gauge("health.update_norm", update_norm)
+        obs.gauge("health.param_norm", param_norm)
+        if nonfinite:
+            obs.counter("health.nonfinite")
+
 
 class TrainState(train_state.TrainState):
     """Flax TrainState: {step, params, opt_state} pytree + static apply_fn/tx.
@@ -262,7 +291,18 @@ def make_train_step(
             )
             loss = lsum / accum_steps
             acc = asum / accum_steps
-        new_state = state.apply_gradients(grads=grads)
+        import optax
+
+        # Explicit tx.update (what TrainState.apply_gradients wraps): the
+        # produced ``updates`` tree feeds the health telemetry below
+        # without a second optimizer pass or a params diff.
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
         if has_stats:
             new_state = new_state.replace(batch_stats=new_stats)
         if ema_decay is not None:
@@ -278,15 +318,16 @@ def make_train_step(
                     new_state.params,
                 )
             )
-        import optax
+        # Pre-clip global gradient norm plus the rest of the on-device
+        # numerics telemetry (update/param norms, fused NaN/Inf flag) —
+        # tiny fused reductions, noise next to the backward pass; the
+        # HealthMonitor and the health.* gauges read these post-fence.
+        from tpuflow.train.optim import health_stats
 
-        # Pre-clip global gradient norm: the standard training-health signal
-        # (spikes predict divergence; ~0 flags dead gradients). One fused
-        # reduction — noise next to the backward pass.
         metrics = {
             "loss": loss,
             "accuracy": acc,
-            "grad_norm": optax.global_norm(grads),
+            **health_stats(loss, grads, updates, new_params),
         }
         return new_state, metrics
 

@@ -147,6 +147,15 @@ class TrainContext:
         self.run_config = run_config
         self._reported: list[dict[str, Any]] = []
         self._manager: CheckpointManager | None = None
+        # Training-health watch over reported metrics (ISSUE 3): custom
+        # Trainer loops own their state, so in-process rollback is not
+        # ours to do — instead a diverged report SKIPS the checkpoint
+        # save (the last durable step stays clean) and raises
+        # TrainingDiverged; the gang @retry machinery then relaunches
+        # and resumes from that clean step — rollback by requeue.
+        from tpuflow.obs.health import HealthMonitor
+
+        self._health = HealthMonitor.from_env()
         if run_config.storage_path:
             cc = run_config.checkpoint_config
             self._manager = CheckpointManager(
@@ -205,6 +214,32 @@ class TrainContext:
             **{k: v for k, v in metrics.items()
                if isinstance(v, (int, float))},
         )
+        if self._health is not None:
+            loss = next(
+                (
+                    metrics[k]
+                    for k in ("loss", "train_loss", "val_loss")
+                    if isinstance(metrics.get(k), float)
+                ),
+                None,
+            )
+            if loss is not None:
+                gn = metrics.get("grad_norm")
+                anomaly = self._health.observe(
+                    save_step, loss,
+                    gn if isinstance(gn, float) else None,
+                )
+                if anomaly is not None:
+                    from tpuflow.obs.health import TrainingDiverged
+
+                    if self._manager is not None:
+                        self._manager.wait_until_finished()
+                    raise TrainingDiverged(
+                        anomaly,
+                        hint="report skipped the checkpoint save; the "
+                        "newest committed step is clean — a gang retry "
+                        "resumes from it",
+                    )
         if state is not None and self._manager is not None:
             self._manager.save(save_step, state, metrics=metrics)
             if launch_attempt() > 0:
