@@ -38,11 +38,13 @@ from tpuflow.infer import BatchPredictor, map_batches  # noqa: E402
 from tpuflow.models import NeuralNetwork, get_model  # noqa: E402
 from tpuflow.train import (  # noqa: E402
     CheckpointConfig,
+    DispatchWindow,
     Result,
     RunConfig,
     ScalingConfig,
     Trainer,
     create_train_state,
+    dispatch_depth,
     get_context,
     make_eval_step,
     make_train_step,
@@ -220,6 +222,11 @@ def train_func_per_worker(config: dict) -> None:
     rng = jax.random.PRNGKey(config.get("seed", 0) + 1)
 
     start = time.monotonic()
+    # Dispatch-ahead window (ISSUE 4): up to dispatch_depth() steps stay
+    # in flight; the lagged block_until_ready below is the only per-step
+    # synchronization on accelerators (dist.step_fence still serializes
+    # the host-CPU dev platform at dispatch — see dist.serialize_steps).
+    window = DispatchWindow(dispatch_depth())
     for epoch in range(start_epoch, epochs):
         epoch_start = time.monotonic()
         if world > 1:
@@ -227,15 +234,19 @@ def train_func_per_worker(config: dict) -> None:
             # (my_ray_module.py:149-151)
             train_loader.set_epoch(epoch)
         n_batches = 0
-        # Batch assembly + host→device placement run one batch ahead on a
-        # background thread while the devices crunch (async dispatch): the
-        # input pipeline hides behind compute.
+        # Batch assembly + host→device placement run up to the prefetch
+        # depth ahead on a background thread while the devices crunch:
+        # the input pipeline hides behind compute.
         for placed in prefetch_to_device(
             train_loader, ctx.mesh, keys=("x", "y")
         ):
             state, train_metrics = train_step(state, placed, rng)
             dist.step_fence(train_metrics["loss"])
+            for matured in window.push(train_metrics["loss"]):
+                jax.block_until_ready(matured)
             n_batches += 1
+        for matured in window.drain():
+            jax.block_until_ready(matured)
         # Block before timing/eval: keeps host and devices in step (and on the
         # CPU dev platform avoids queueing concurrent collective programs).
         jax.block_until_ready(state.params)

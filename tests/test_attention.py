@@ -338,3 +338,83 @@ def test_attention_auto_picks_xla_off_tpu(monkeypatch):
         np.asarray(attention(q, k, v, causal=True, impl="auto")),
         np.asarray(xla_attention(q, k, v, causal=True)),
     )
+
+
+def test_flash_dispatch_independent_fwd_and_fwdbwd_thresholds(monkeypatch):
+    """The measured T=512 regression (ISSUE 4 satellite): on chip, flash
+    fwd wins at T=512 (2.73x) while flash fwd+bwd LOSES there (0.2x) —
+    so 'auto' dispatch carries independent crossovers per path. Pins the
+    shipped defaults (fwd 512, fwd+bwd 2048), the per-path env
+    overrides, and that the tuning file's keys are read per path."""
+    import json
+
+    from tpuflow.ops.attention import resolve_attention_impl
+
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ", raising=False)
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ_FWD", raising=False)
+    # Point the tuning file somewhere empty so host state can't leak in.
+    monkeypatch.setenv("TPUFLOW_HOME", "/nonexistent_tpuflow_home")
+    import importlib
+
+    att = importlib.import_module("tpuflow.ops.attention")
+    monkeypatch.setattr(att, "_flash_tuning_cache", None)
+
+    # THE regression pin: the T=512 fwd+bwd shape must dispatch to XLA
+    # while the same shape's fwd-only path takes flash.
+    assert resolve_attention_impl(
+        "auto", 512, needs_bwd=True, backend="tpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 512, needs_bwd=False, backend="tpu") == "flash"
+    # Both paths win at the measured fwd+bwd crossover and above.
+    assert resolve_attention_impl(
+        "auto", 2048, needs_bwd=True, backend="tpu") == "flash"
+    assert resolve_attention_impl(
+        "auto", 2048, needs_bwd=False, backend="tpu") == "flash"
+    # Below the fwd threshold everything is XLA.
+    assert resolve_attention_impl(
+        "auto", 256, needs_bwd=False, backend="tpu") == "xla"
+    # Off-TPU is always XLA regardless of path or length.
+    assert resolve_attention_impl(
+        "auto", 8192, needs_bwd=True, backend="cpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 8192, needs_bwd=False, backend="cpu") == "xla"
+    # Explicit impls pass through untouched.
+    assert resolve_attention_impl(
+        "ring", 8, needs_bwd=True, backend="cpu") == "ring"
+
+    # Per-path env overrides: each knob moves only its own path.
+    monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ", "4096")
+    assert resolve_attention_impl(
+        "auto", 2048, needs_bwd=True, backend="tpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 2048, needs_bwd=False, backend="tpu") == "flash"
+    monkeypatch.setenv("TPUFLOW_FLASH_MIN_SEQ_FWD", "128")
+    assert resolve_attention_impl(
+        "auto", 256, needs_bwd=False, backend="tpu") == "flash"
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ")
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ_FWD")
+
+
+def test_flash_tuning_file_per_path_keys(tmp_path, monkeypatch):
+    """bench.py persists {flash_min_seq, flash_min_seq_fwd}; the
+    dispatcher reads each key for its own path only."""
+    import json
+
+    from tpuflow.ops.attention import resolve_attention_impl
+
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ", raising=False)
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ_FWD", raising=False)
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))
+    with open(tmp_path / "flash_tuning.json", "w") as f:
+        json.dump({"flash_min_seq": 1024, "flash_min_seq_fwd": 256}, f)
+    import importlib
+
+    att = importlib.import_module("tpuflow.ops.attention")
+    monkeypatch.setattr(att, "_flash_tuning_cache", None)
+    assert resolve_attention_impl(
+        "auto", 1024, needs_bwd=True, backend="tpu") == "flash"
+    assert resolve_attention_impl(
+        "auto", 512, needs_bwd=True, backend="tpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 256, needs_bwd=False, backend="tpu") == "flash"
+    monkeypatch.setattr(att, "_flash_tuning_cache", None)

@@ -351,3 +351,45 @@ def test_compact_summary_r5_verdicts_from_fresh_train():
     assert d["spec_decode"] == {"numerics_ok": True, "speedup": 1.5}
     assert d["int8_mxu"] == {"speedup": 1.3, "tf_agreement": 0.98}
     assert d["flash_crossover_T"] == 2048
+
+
+def test_flash_crossover_fwd_key_and_dual_persist(tmp_path, monkeypatch):
+    """ISSUE 4 satellite: the crossover fits independently per path (the
+    r5 sweep had fwd winning at T=512 while fwd+bwd lost there), and
+    _persist_flash_tuning writes both keys where the dispatcher reads
+    them."""
+    import importlib
+    import json
+
+    recs = {
+        "T512": {"numerics_ok": True, "fwd_speedup": 2.73,
+                 "fwdbwd_speedup": 0.2},
+        "T1024": {"numerics_ok": True, "fwd_speedup": 1.9,
+                  "fwdbwd_speedup": 0.9},
+        "T2048": {"numerics_ok": True, "fwd_speedup": 1.47,
+                  "fwdbwd_speedup": 1.73},
+        "T4096": {"numerics_ok": True, "fwd_speedup": 1.5,
+                  "fwdbwd_speedup": 2.1},
+    }
+    assert bench._flash_crossover_from(recs) == 2048
+    assert bench._flash_crossover_from(recs, key="fwd_speedup") == 512
+
+    attn = importlib.import_module("tpuflow.ops.attention")
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ", raising=False)
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ_FWD", raising=False)
+    bench._persist_flash_tuning(2048, 512)
+    with open(attn.flash_tuning_path()) as f:
+        rec = json.load(f)
+    assert rec["flash_min_seq"] == 2048
+    assert rec["flash_min_seq_fwd"] == 512
+    attn._flash_tuning_cache = None
+    assert attn._flash_min_seq(needs_bwd=True) == 2048
+    assert attn._flash_min_seq(needs_bwd=False) == 512
+    # A fwd-only fit with no trusted fwd+bwd crossover persists just its
+    # own key; the dispatcher keeps the fwd+bwd default.
+    bench._persist_flash_tuning(None, 1024)
+    attn._flash_tuning_cache = None
+    assert attn._flash_min_seq(needs_bwd=False) == 1024
+    assert attn._flash_min_seq(needs_bwd=True) == attn._DEFAULT_FLASH_MIN_SEQ
+    attn._flash_tuning_cache = None

@@ -1,0 +1,201 @@
+"""Async step pipeline (ISSUE 4): dispatch-ahead window semantics,
+prefetch wiring parity, and donation safety.
+
+The contracts under test:
+- DispatchWindow bookkeeping: depth-1 settles every step inline (the old
+  loop, bit for bit), depth-N lags settling by N-1 steps, drain/clear
+  behave at epoch/rollback boundaries.
+- The prefetch-wired train_gpt legs produce BIT-IDENTICAL losses to the
+  synchronous path: prefetch and dispatch-ahead reorder host work only,
+  never the math.
+- Donation safety: with N steps in flight, the only buffers a loop may
+  retain are step OUTPUTS (metrics); every donated input is dead the
+  moment the next step is dispatched, and reading it raises instead of
+  silently aliasing.
+"""
+
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpuflow.train.step import DispatchWindow, dispatch_depth
+
+
+def test_dispatch_window_depth_one_settles_inline():
+    w = DispatchWindow(1)
+    assert w.push("a") == ["a"]
+    assert w.push("b") == ["b"]
+    assert w.drain() == []
+    assert len(w) == 0
+
+
+def test_dispatch_window_depth_two_lags_one_step():
+    w = DispatchWindow(2)
+    assert w.push(1) == []
+    assert w.push(2) == [1]
+    assert w.push(3) == [2]
+    assert w.drain() == [3]
+    assert w.drain() == []
+
+
+def test_dispatch_window_clear_abandons_pending():
+    w = DispatchWindow(3)
+    assert w.push(1) == []
+    assert w.push(2) == []
+    w.clear()
+    assert w.drain() == []
+    # Depth below 1 clamps (a window must always settle eventually).
+    assert DispatchWindow(0).depth == 1
+    assert DispatchWindow(-3).depth == 1
+
+
+def test_dispatch_depth_env_resolution(monkeypatch):
+    monkeypatch.delenv("TPUFLOW_DISPATCH_DEPTH", raising=False)
+    assert dispatch_depth() == 2
+    assert dispatch_depth(default=5) == 5
+    monkeypatch.setenv("TPUFLOW_DISPATCH_DEPTH", "4")
+    assert dispatch_depth() == 4
+    monkeypatch.setenv("TPUFLOW_DISPATCH_DEPTH", "0")
+    assert dispatch_depth() == 1  # clamps, never a dead loop
+    monkeypatch.setenv("TPUFLOW_DISPATCH_DEPTH", "banana")
+    assert dispatch_depth() == 2  # malformed → default, never a crash
+
+
+def _run_gpt(tmp_path, tag, monkeypatch, prefetch, dispatch):
+    from tpuflow.train import GptTrainConfig, train_gpt
+
+    monkeypatch.setenv("TPUFLOW_PREFETCH_DEPTH", str(prefetch))
+    monkeypatch.setenv("TPUFLOW_DISPATCH_DEPTH", str(dispatch))
+    cfg = GptTrainConfig(
+        preset="test", epochs=2, steps_per_epoch=2, batch_size=8,
+        seq_len=16, data_axis=4, fsdp_axis=2,
+    )
+    result = train_gpt(cfg, ckpt_dir=str(tmp_path / f"ck_{tag}"))
+    return result
+
+
+def test_prefetch_and_dispatch_ahead_losses_bit_identical(
+    tmp_path, monkeypatch
+):
+    """The acceptance parity bar: the fully async loop (prefetch depth 2,
+    dispatch depth 2 — the defaults) and the fully synchronous loop
+    (prefetch disabled, settle-every-step) train to BIT-IDENTICAL
+    losses. Prefetch and dispatch-ahead may only reorder host-side
+    work."""
+    sync = _run_gpt(tmp_path, "sync", monkeypatch, prefetch=0, dispatch=1)
+    asyn = _run_gpt(tmp_path, "async", monkeypatch, prefetch=2, dispatch=2)
+    assert sync.loss_history == asyn.loss_history
+    for a, b in zip(sync.metrics_history, asyn.metrics_history):
+        assert a["train_loss"] == b["train_loss"]
+        assert a["val_loss"] == b["val_loss"]
+    assert all(math.isfinite(l) for l in asyn.loss_history)
+
+
+@pytest.mark.slow
+def test_prefetch_depth_one_also_identical(tmp_path, monkeypatch):
+    """Depth sweep completeness (slow leg): single-buffered prefetch with
+    settle-every-step dispatch matches the other two combinations."""
+    one = _run_gpt(tmp_path, "one", monkeypatch, prefetch=1, dispatch=1)
+    asyn = _run_gpt(tmp_path, "asyn2", monkeypatch, prefetch=2, dispatch=2)
+    assert one.loss_history == asyn.loss_history
+
+
+@pytest.mark.slow
+def test_pipeline_leg_prefetch_parity(tmp_path, monkeypatch):
+    """The GPipe leg through the same wiring: async == sync, bit for
+    bit. (Slow tier: two pipeline compiles; the fast tier covers the
+    FSDP parity pair and the pipeline chaos rollback covers this leg's
+    window + drain points.)"""
+    from tpuflow.train import GptTrainConfig, train_gpt
+
+    def run(tag, prefetch, dispatch):
+        monkeypatch.setenv("TPUFLOW_PREFETCH_DEPTH", str(prefetch))
+        monkeypatch.setenv("TPUFLOW_DISPATCH_DEPTH", str(dispatch))
+        cfg = GptTrainConfig(
+            preset="test", epochs=1, steps_per_epoch=2, batch_size=8,
+            seq_len=16, data_axis=4, fsdp_axis=1, stage_axis=2,
+            microbatches=2,
+        )
+        return train_gpt(cfg, ckpt_dir=str(tmp_path / f"pk_{tag}"))
+
+    sync = run("sync", prefetch=0, dispatch=1)
+    asyn = run("async", prefetch=2, dispatch=2)
+    assert sync.loss_history == asyn.loss_history
+
+
+def test_donated_step_buffers_die_at_dispatch():
+    """Donation audit pin: make_train_step donates the state, so with
+    dispatch-ahead the PREVIOUS state's buffers are dead as soon as the
+    next step is dispatched — touching them raises, it never silently
+    reads aliased memory. The step's outputs (what the DispatchWindow
+    retains) stay live and readable arbitrarily late."""
+    import optax
+
+    from tpuflow.models.mlp import NeuralNetwork
+    from tpuflow.train import create_train_state, make_train_step
+
+    model = NeuralNetwork()
+    x = np.random.default_rng(0).standard_normal((8, 28, 28)).astype(
+        np.float32
+    )
+    y = np.zeros((8,), np.int32)
+    state0 = create_train_state(
+        model, jax.random.PRNGKey(0), x[:1], optax.sgd(1e-2)
+    )
+    step = make_train_step()
+    rng = jax.random.PRNGKey(1)
+    batch = {"x": jax.numpy.asarray(x), "y": jax.numpy.asarray(y)}
+
+    state1, metrics1 = step(state0, batch, rng)
+    state2, metrics2 = step(state1, batch, rng)  # two steps in flight
+    # The donated inputs are dead...
+    leaf0 = jax.tree_util.tree_leaves(state0.params)[0]
+    leaf1 = jax.tree_util.tree_leaves(state1.params)[0]
+    assert leaf0.is_deleted() and leaf1.is_deleted()
+    with pytest.raises(RuntimeError):
+        np.asarray(leaf0)
+    # ...while the window's entries (outputs) settle fine, out of order
+    # and late — exactly what the lagged fence does.
+    assert math.isfinite(float(metrics2["loss"]))
+    assert math.isfinite(float(metrics1["loss"]))
+    # The live state is intact (the loop's current binding).
+    assert not jax.tree_util.tree_leaves(state2.params)[0].is_deleted()
+    # The batch is NOT donated: the prefetch thread's placed batches
+    # stay valid however late the steps execute.
+    assert not batch["x"].is_deleted()
+    _, _ = step(state2, batch, rng)
+
+
+def test_prefetch_disabled_spawns_no_thread(monkeypatch):
+    """The TPUFLOW_OBS=0-style overhead pin for the disabled prefetch
+    path: TPUFLOW_PREFETCH_DEPTH=0 must iterate inline — no thread, no
+    queue — and still yield correctly placed, correctly ordered
+    batches."""
+    import threading
+
+    from tpuflow import dist
+    from tpuflow.data.datasets import Split
+    from tpuflow.data.loader import ShardedLoader, prefetch_to_device
+
+    monkeypatch.setenv("TPUFLOW_PREFETCH_DEPTH", "0")
+    rng = np.random.default_rng(0)
+    split = Split(
+        images=rng.standard_normal((32, 4)).astype(np.float32),
+        labels=rng.integers(0, 2, 32).astype(np.int64),
+    )
+    loader = ShardedLoader(split, batch_size=8)
+    mesh = dist.make_mesh({"data": 8})
+    before = set(threading.enumerate())
+    placed = []
+    for b in prefetch_to_device(loader, mesh, keys=("x", "y")):
+        assert set(threading.enumerate()) == before, "thread spawned"
+        placed.append(b)
+    assert len(placed) == len(loader)
+    direct = [dict(b) for b in loader]
+    for got, want in zip(placed, direct):
+        np.testing.assert_array_equal(np.asarray(got["x"]), want["x"])
+        np.testing.assert_array_equal(np.asarray(got["y"]), want["y"])
+        assert "mask" not in got

@@ -315,3 +315,38 @@ def test_ensure_healthy_platform_skips_probe_when_pinned_cpu(
     t0 = time.monotonic()
     assert dist.ensure_healthy_platform(probe_timeout_s=90.0) == "cpu"
     assert time.monotonic() - t0 < 5.0
+
+
+def test_compile_cache_run_mode_keys_under_run_dir(tmp_path, monkeypatch):
+    """TPUFLOW_COMPILE_CACHE=run keys the persistent cache under the
+    caller's run directory (the shared-storage mode for requeued k8s
+    gangs whose pod-local $HOME is ephemeral); with no run_dir known it
+    falls back to the default home cache instead of a literal './run'
+    directory."""
+    import os
+    import subprocess
+    import sys
+
+    home = tmp_path / "home"
+    run_dir = tmp_path / "runs" / "r1"
+    run_dir.mkdir(parents=True)
+    env = {**os.environ, "TPUFLOW_HOME": str(home),
+           "TPUFLOW_COMPILE_CACHE": "run", "TPUFLOW_COMPILE_CACHE_CPU": "1"}
+    prog = (
+        "import os, sys\n"
+        "from tpuflow.dist import force_cpu_platform, "
+        "maybe_enable_compile_cache\n"
+        "force_cpu_platform(1)\n"
+        f"d = maybe_enable_compile_cache(run_dir={str(run_dir)!r})\n"
+        f"assert d == os.path.join({str(run_dir)!r}, 'compile_cache'), d\n"
+        "assert os.path.isdir(d)\n"
+        # Unknown run dir: default home cache, never './run'.
+        "d2 = maybe_enable_compile_cache()\n"
+        f"assert d2 == os.path.join({str(home)!r}, 'compile_cache'), d2\n"
+        "assert not os.path.exists('run')\n"
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]

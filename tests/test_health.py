@@ -391,7 +391,11 @@ def test_chaos_nan_grad_rollback_continuous_history(tmp_path, monkeypatch):
     assert anomalies[0]["step"] == 3
     rollbacks = [e for e in events if e["name"] == "health.rollback"]
     assert rollbacks and rollbacks[0]["step"] == 2
-    assert rollbacks[0]["from_step"] == 3
+    # from_step is the DISPATCH frontier when the anomaly settled: with
+    # dispatch-ahead (TPUFLOW_DISPATCH_DEPTH, default 2) the loop may
+    # have dispatched up to depth-1 steps past the flagged one — those
+    # in-flight steps are discarded by the same rollback.
+    assert 3 <= rollbacks[0]["from_step"] <= 3 + 1
     # The nonfinite step was counted in the numerics stream too.
     assert any(e["name"] == "health.nonfinite" for e in events)
     # Rollback rewound the manager history: the final checkpoint's
@@ -482,3 +486,42 @@ def test_chaos_lr_backoff_on_rollback(tmp_path, monkeypatch):
     assert [m["epoch"] for m in result.metrics_history] == [0, 1]
     (rb,) = [e for e in _events(d) if e["name"] == "health.rollback"]
     assert rb["lr_scale"] == 0.5
+
+
+def test_chaos_nan_grad_rollback_with_deep_dispatch_window(
+    tmp_path, monkeypatch
+):
+    """Fence-interval sync (ISSUE 4): with an explicit dispatch window
+    DEEPER than an epoch (TPUFLOW_DISPATCH_DEPTH=3 over 2-step epochs,
+    so the flagged step settles only at the epoch-end drain), the health
+    rollback still restores the crc-verified step-2 checkpoint and the
+    run finishes with a continuous finite history — the deferred fence
+    never lets a poisoned step reach the history or the store."""
+    from tpuflow.ckpt import CheckpointManager
+    from tpuflow.train import train_gpt
+
+    monkeypatch.setenv("TPUFLOW_FAULT", "nan_grad:0@step3")
+    monkeypatch.setenv("TPUFLOW_DISPATCH_DEPTH", "3")
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=0)
+    try:
+        result = train_gpt(_gpt_cfg(), ckpt_dir=str(tmp_path / "ck"))
+        obs.flush()
+    finally:
+        obs.configure(None)
+    assert [m["epoch"] for m in result.metrics_history] == [0, 1]
+    for m in result.metrics_history:
+        assert math.isfinite(m["train_loss"]) and math.isfinite(m["val_loss"])
+    events = _events(d)
+    anomalies = [e for e in events if e["name"] == "health.anomaly"]
+    assert anomalies and anomalies[0]["detector"] == "nonfinite"
+    assert anomalies[0]["step"] == 3  # attribution survives the lag
+    (rb,) = [e for e in events if e["name"] == "health.rollback"]
+    assert rb["step"] == 2
+    # The restored step is crc-verified on disk right now.
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.verify_step(2)
+    mgr.close()
+    # The loop resolved and recorded the configured window depth.
+    depths = [e for e in events if e["name"] == "train.dispatch_depth"]
+    assert depths and depths[-1]["value"] == 3.0

@@ -272,6 +272,11 @@ def test_obs_catalog_lint():
         ("histogram", "train.step_s"),
         ("span", "infer.generate"),
         ("counter", "infer.spec.committed"),
+        # Async step pipeline (ISSUE 4) with the right kinds.
+        ("gauge", "data.host_wait_s"),
+        ("gauge", "train.dispatch_depth"),
+        ("counter", "data.prefetch_hit"),
+        ("counter", "data.prefetch_miss"),
         # Training-health observatory (ISSUE 3) with the right kinds.
         ("gauge", "health.loss"),
         ("gauge", "health.grad_norm"),

@@ -316,3 +316,50 @@ def test_generation_predictor_quantize(lm):
     ).quant_decision is None
     with pytest.raises(ValueError, match="unknown quantize"):
         GenerationPredictor(model, params, max_new_tokens=4, quantize="fp4")
+
+
+def test_attention_projection_scales_are_per_out_channel(lm):
+    """ISSUE 4 satellite (int8 decode 0.76x / agreement 0.565 on chip):
+    the attention projections must carry PER-CHANNEL (axis=-1, i.e.
+    per-output-channel) scales — a per-tensor scale lets one hot output
+    channel collapse every other channel's int8 resolution, which is
+    the fidelity failure the measured agreement pointed at. Pins the
+    scale shapes for c_attn/c_proj in both layer layouts and in both
+    quantization modes, so the guard fallback in quantize_params can
+    never silently coarsen them."""
+    from tpuflow.infer.quant import _quantize_dense_kernels
+
+    model, params, cfg = lm
+
+    def check(tree, path_names, stacked):
+        sub = tree
+        for n in path_names:
+            sub = sub[n]
+        kern = sub["kernel"]
+        assert isinstance(kern, QuantLeaf), path_names
+        if stacked:
+            # (L, in, out) scan stack: per (layer, out-channel).
+            L, _in, out = kern.q.shape
+            assert kern.scale.shape == (L, 1, out), kern.scale.shape
+        else:
+            _in, out = kern.q.shape
+            assert kern.scale.shape == (1, out), kern.scale.shape
+
+    for qp in (quantize_params(params),
+               _quantize_dense_kernels(params, min_size=4096)):
+        for layer in ("h0", "h1"):
+            check(qp, (layer, "c_attn"), stacked=False)
+            check(qp, (layer, "c_proj"), stacked=False)
+
+    scfg = GPT2Config(
+        vocab_size=256, n_ctx=64, n_embd=64, n_layer=2, n_head=2,
+        dropout=0.0, dtype=jnp.float32, scan_layers=True,
+    )
+    smodel = GPT2(scfg)
+    sparams = smodel.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )["params"]
+    for qp in (quantize_params(sparams),
+               _quantize_dense_kernels(sparams, min_size=4096)):
+        check(qp, ("h", "block", "c_attn"), stacked=True)
+        check(qp, ("h", "block", "c_proj"), stacked=True)

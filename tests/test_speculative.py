@@ -284,3 +284,66 @@ def test_draft_ladder_early_history_blind_spot():
     hist3 = jnp.asarray([[1, 2, 3, 4, 5, 0, 0, 0, 0, 0]], jnp.int32)
     d3 = np.asarray(_draft_ladder(hist3, jnp.int32(5), K=2, G=2))
     np.testing.assert_array_equal(d3, [[5, 5]])
+
+
+def test_pad_laden_drafts_stay_exact():
+    """ISSUE 4 satellite forensics: the r5 on-chip numerics_ok=false was
+    suspected to be the ladder accepting against pre- vs post-pad
+    logits. Refuted: acceptance compares the draft against argmaxes of
+    ONE verify forward, so even drafts whose candidate window runs past
+    the valid history into the pad region (forced here with a draft_len
+    much longer than the committed text, and pad_id colliding with a
+    real token id) only lower acceptance, never flip tokens. The TPU
+    mismatch was width-dependent MXU rounding instead — pinned by
+    GPT2Config.decode_precision='highest' (the field's comment has the
+    full chain of evidence)."""
+    model, params = _model()
+    rng = np.random.default_rng(3)
+    # Prompts whose tails repeat near the END of the history so the
+    # drafted window [start, start+K) extends into the pad region.
+    cases = [
+        np.concatenate(
+            [rng.integers(1, 512, size=(1, 12)),
+             np.array([[7, 9, 7, 9]])], axis=1
+        ).astype(np.int32),
+        np.array([[0, 5, 0, 5, 0]], np.int32),  # pad_id=0 as a REAL token
+    ]
+    for prompt in cases:
+        for max_new in (3, 9):
+            want = np.asarray(
+                generate(model, params, prompt, max_new_tokens=max_new,
+                         temperature=0.0)
+            )
+            got = np.asarray(
+                speculative_generate(
+                    model, params, prompt, max_new_tokens=max_new,
+                    draft_len=12, ngram=3,
+                )
+            )
+            np.testing.assert_array_equal(got, want)
+
+
+def test_decode_precision_default_and_override():
+    """The decode-path matmul-precision pin (ISSUE 4 satellite): default
+    config resolves HIGHEST on the decode (non-prefill) path and None
+    (platform default) for training/prefill; decode_precision=None
+    restores the old behavior; exactness holds either way on CPU."""
+    import jax
+
+    from tpuflow.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config.small_test()
+    assert cfg.matmul_precision(True) == jax.lax.Precision.HIGHEST
+    assert cfg.matmul_precision(False) is None
+    off = GPT2Config.small_test(decode_precision=None)
+    assert off.matmul_precision(True) is None
+
+    model, params = _model(decode_precision=None)
+    prompt = np.tile(np.array([5, 6, 7, 8], np.int32), (2, 8))
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+    )
+    got = np.asarray(
+        speculative_generate(model, params, prompt, max_new_tokens=8)
+    )
+    np.testing.assert_array_equal(got, want)

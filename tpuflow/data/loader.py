@@ -125,22 +125,85 @@ class ShardedLoader:
             }
 
 
-def prefetch_to_device(loader, mesh, *, depth: int = 2, keys=None):
+def prefetch_depth(default: int = 2) -> int:
+    """Resolve the device-prefetch depth: ``TPUFLOW_PREFETCH_DEPTH``
+    beats ``default``; values <= 0 DISABLE prefetch (the loops then
+    assemble + place batches inline, no thread spawned — the overhead
+    pin in tests/test_data.py holds the disabled path to one int check
+    per call). A malformed value falls back to ``default``."""
+    import os
+
+    env = os.environ.get("TPUFLOW_PREFETCH_DEPTH")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return default
+
+
+def prefetch_to_device(loader, mesh, *, depth: int | None = None, keys=None,
+                       place=None):
     """Pipeline batch assembly + host→device placement against compute.
 
     A background thread assembles batches (the threaded C++ gather) and
-    places them on the mesh (``dist.shard_batch``) up to ``depth`` ahead,
-    while the main thread's jitted steps run — double-buffering the host
-    side of the input pipeline the way ``prepare_data_loader``'s device
-    iterator does in the reference stack (my_ray_module.py:128-129). Safe
-    under multi-host: placement is per-process local (no collectives).
+    places them on the mesh (``dist.shard_batch``, or the caller's
+    ``place``) up to ``depth`` ahead, while the main thread's jitted
+    steps run — double-buffering the host side of the input pipeline the
+    way ``prepare_data_loader``'s device iterator does in the reference
+    stack (my_ray_module.py:128-129). Safe under multi-host: placement
+    is per-process local (no collectives).
 
+    ``depth``: buffered batches; ``None`` resolves via
+    :func:`prefetch_depth` (``TPUFLOW_PREFETCH_DEPTH``, default 2).
+    Depth <= 0 disables the pipeline entirely: batches are assembled and
+    placed inline on the consumer thread — no thread, no queue — which
+    is the knob for platforms where a background device_put is unwanted.
     ``keys``: optional subset of batch entries to keep (e.g. ("x", "y")).
+    ``place``: optional ``batch -> placed_batch`` callable run on the
+    prefetch thread (default ``dist.shard_batch`` onto ``mesh``) — the
+    train legs pass their own sharded ``device_put`` so the placement
+    matches the step's batch sharding exactly.
+
+    Telemetry: per-batch ``data.batch_wait_s`` histogram plus the
+    ``data.host_wait_s`` gauge (the time the consumer actually blocked —
+    ~0 on every prefetch hit is the "input pipeline is off the critical
+    path" evidence), and ``data.prefetch_hit``/``miss`` counters.
     """
+    from tpuflow import dist, obs
+
+    if place is None:
+        place = lambda batch: dist.shard_batch(batch, mesh)  # noqa: E731
+    if depth is None:
+        depth = prefetch_depth()
+    if depth <= 0:
+        # Disabled path: inline assembly + placement, no thread spawned.
+        # Kept deliberately bare — one generator frame over the loader —
+        # so disabling prefetch never costs more than the work it defers.
+        def _inline():
+            obs_on = obs.enabled()
+            for batch in loader:
+                if obs_on:
+                    import time
+
+                    t0 = time.monotonic()
+                if keys is not None:
+                    batch = {k: batch[k] for k in keys}
+                placed = place(batch)
+                if obs_on:
+                    wait = time.monotonic() - t0
+                    obs.histogram("data.batch_wait_s", wait)
+                    obs.gauge("data.host_wait_s", wait)
+                    obs.counter("data.prefetch_miss")
+                yield placed
+
+        return _inline()
+    return _prefetch_threaded(loader, place, depth, keys)
+
+
+def _prefetch_threaded(loader, place, depth: int, keys):
     import queue
     import threading
-
-    from tpuflow import dist
 
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     done = object()
@@ -160,7 +223,7 @@ def prefetch_to_device(loader, mesh, *, depth: int = 2, keys=None):
             for batch in loader:
                 if keys is not None:
                     batch = {k: batch[k] for k in keys}
-                if not _put(dist.shard_batch(batch, mesh)):
+                if not _put(place(batch)):
                     return  # consumer went away (early break)
             _put(done)
         except BaseException as e:  # surfaced on the consuming thread
@@ -182,7 +245,11 @@ def prefetch_to_device(loader, mesh, *, depth: int = 2, keys=None):
                 hit = not q.empty()
                 t0 = time.monotonic()
                 item = q.get()
-                obs.histogram("data.batch_wait_s", time.monotonic() - t0)
+                wait = time.monotonic() - t0
+                obs.histogram("data.batch_wait_s", wait)
+                # The overlap proof: ~0 on every hit means the input
+                # pipeline ran entirely behind device compute.
+                obs.gauge("data.host_wait_s", wait)
                 if hit:
                     obs.counter("data.prefetch_hit")
                 else:

@@ -75,7 +75,7 @@ def _platform_is_cpu() -> bool:
     return os.environ.get("TPUFLOW_PLATFORM_BACKEND", "") == "cpu"
 
 
-def maybe_enable_compile_cache() -> str | None:
+def maybe_enable_compile_cache(run_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at a durable directory.
 
     On real TPU the first compile of a training step costs 20-40 s; the
@@ -84,10 +84,19 @@ def maybe_enable_compile_cache() -> str | None:
     executable instead of recompiling — the same jit program key hits
     across processes. Default ON at ``$TPUFLOW_HOME/compile_cache``
     (compilation caching is keyed on HLO + config, never stale);
-    ``TPUFLOW_COMPILE_CACHE`` recognizes 0/false/off (disable) and
-    1/true/on/unset (default directory); any other value is used as
-    the cache directory itself. Returns the directory in use, or None.
-    Safe to call any number of times and before/after backend init.
+    ``TPUFLOW_COMPILE_CACHE`` recognizes 0/false/off (disable),
+    1/true/on/unset (default directory), and ``run`` (key the cache
+    under ``<run_dir>/compile_cache`` — for deployments where only the
+    run directory rides shared storage, e.g. a requeued k8s gang whose
+    pod-local ``$HOME`` is ephemeral: every retry/requeue attempt of
+    the run shares the cache even though each lands on a fresh pod);
+    any other value is used as the cache directory itself. ``run``
+    with no ``run_dir`` known falls back to the default directory.
+    Returns the directory in use, or None.
+    Safe to call any number of times and before/after backend init —
+    every train entry point (train_gpt, Trainer.fit, gang members, the
+    flow runner, bench children) calls it, so the cache is default-on
+    without any caller wiring.
 
     CPU platforms are excluded: jaxlib's XLA:CPU AOT loader
     (cpu_aot_loader.cc) re-checks LLVM machine features when it
@@ -107,7 +116,14 @@ def maybe_enable_compile_cache() -> str | None:
         and os.environ.get("TPUFLOW_COMPILE_CACHE_CPU") != "1"
     ):
         return None
-    if knob.lower() in ("", "1", "true", "on"):
+    if knob.lower() == "run":
+        # Per-run-dir keying: callers that know their run/storage dir
+        # pass it through (train_gpt, Trainer.fit, gang_exec). Unknown
+        # run dir → default directory, never a literal './run'.
+        knob = (
+            os.path.join(run_dir, "compile_cache") if run_dir else ""
+        )
+    elif knob.lower() in ("", "1", "true", "on"):
         # Conventional enable spellings mean "default directory" — NOT a
         # relative directory literally named '1' in whatever cwd each
         # process happens to have (which would silently give every

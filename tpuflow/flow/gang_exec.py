@@ -36,10 +36,17 @@ def _bootstrap_jax() -> None:
             # build gloo collectives without a distributed client).
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
     # Gang members share the persistent compile cache: after one worker
-    # (or a previous attempt) compiled the step, the rest load it.
+    # (or a previous attempt) compiled the step, the rest load it. With
+    # TPUFLOW_COMPILE_CACHE=run the cache keys under the run directory
+    # (the parent of the obs dir every member inherits) — the mode for
+    # k8s gangs whose only shared storage is the run dir, so a requeued
+    # attempt on a fresh pod still reloads the compiled step.
     from tpuflow.dist import maybe_enable_compile_cache
 
-    maybe_enable_compile_cache()
+    obs_dir = os.environ.get("TPUFLOW_OBS_DIR")
+    maybe_enable_compile_cache(
+        run_dir=os.path.dirname(obs_dir) if obs_dir else None
+    )
 
 
 def _store_artifacts(flow_name: str, run_id: str, step_name: str) -> dict:

@@ -264,14 +264,18 @@ def speculative_generate(
 
     Token-exact vs ``generate(..., temperature=0)``: acceptance compares
     the model's argmax over a (K+1)-token warm-cache chunk against
-    single-token decode. On models whose decode path computes in a
-    width-independent dtype (``GPT2Config.decode_dtype``, f32 by default
-    — bf16 rounding of layer outputs differs systematically between
-    chunk widths, which used to flip near-tie argmaxes) this is exact on
-    every platform; verified bit-exact across the CPU scenarios
-    including a 128-token bf16 decode (tests/test_speculative.py). The
-    bench still withholds any speedup claim on mismatch rather than
-    assuming.
+    single-token decode. Two width-dependence sources are pinned off on
+    the decode path: the compute dtype (``GPT2Config.decode_dtype``,
+    f32 by default — bf16 rounding of layer outputs differs
+    systematically between chunk widths) and the MXU matmul precision
+    (``GPT2Config.decode_precision``, HIGHEST by default — TPU DEFAULT
+    precision lowers even f32 matmuls to bf16 multiply passes whose
+    rounding depends on the program's tiling, i.e. the chunk width; the
+    r5 on-chip ``numerics_ok: false`` with CPU bit-exactness intact).
+    Verified bit-exact across the CPU scenarios including a 128-token
+    bf16 decode and pad-laden drafts (tests/test_speculative.py); the
+    bench FAILS loudly (exit 3) on a fresh on-chip mismatch rather than
+    recording a null speedup.
 
     ``prompt``: dense (B, T) int32 (ragged batches: decode rows
     separately, or use ``generate``). ``ngram`` is the match-key length

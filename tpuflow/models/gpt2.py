@@ -69,6 +69,24 @@ class GPT2Config:
     # exactness-by-default); set bfloat16 to halve cache bytes for long
     # contexts at the cost of the width-dependent rounding amplifier.
     cache_dtype: jnp.dtype | None = None
+    # Decode-path (KV-cache, non-prefill) matmul precision. decode_dtype
+    # = f32 removed the LAYER-STACK width dependence, but on TPU the
+    # MXU's DEFAULT precision still lowers f32 matmuls to bf16 multiply
+    # passes whose rounding depends on the program's tiling — i.e. on
+    # the chunk WIDTH — so a (K+1)-token verify forward and single-token
+    # decode could still argmax-flip near-tie logits (the r5 on-chip
+    # speculative numerics_ok=false on BOTH prompt legs while every CPU
+    # scenario stayed bit-exact; the suspected ladder-acceptance pad bug
+    # was ruled out — acceptance compares argmaxes of ONE forward, see
+    # tests/test_speculative.py::test_pad_laden_drafts_stay_exact).
+    # 'highest' pins decode-mode matmuls (attention, Dense, LM head) to
+    # true f32 — decode is HBM-bandwidth-bound, so the extra MXU passes
+    # are ~free. None = platform default (the old behavior, for
+    # capacity-critical serving). Prefill keeps DEFAULT precision: it is
+    # the one compute-bound decode call and runs at the same width in
+    # every decode strategy, so it cannot introduce width-dependent
+    # rounding.
+    decode_precision: str | None = "highest"
 
     def compute_dtype(self, decode: bool):
         """Activation/compute dtype for this forward: ``decode_dtype``
@@ -84,6 +102,17 @@ class GPT2Config:
         if self.cache_dtype is not None:
             return self.cache_dtype
         return self.compute_dtype(decode=True)
+
+    def matmul_precision(self, decode: bool):
+        """``jax.lax.Precision`` for this forward's matmuls: the pinned
+        ``decode_precision`` on the KV-cache (non-prefill) path, else
+        None (platform default). See the field comment for why decode
+        needs width-independent rounding."""
+        if decode and self.decode_precision:
+            import jax
+
+            return jax.lax.Precision(self.decode_precision.lower())
+        return None
 
     @classmethod
     def small_test(cls, **kw) -> "GPT2Config":
@@ -151,24 +180,27 @@ class GPT2Config:
         )
 
 
-def _masked_attention(q, k, v, valid):
+def _masked_attention(q, k, v, valid, precision=None):
     """Masked softmax attention, float32 statistics (bf16-safe), static
     shapes. ``valid`` broadcasts against the (B, H, Tq, Tk) score matrix.
     Fully-masked query rows (a left-pad column whose every key is invalid)
     degrade to a uniform softmax over the -1e30 constants — finite garbage
-    that no real query ever attends to, so it stays isolated."""
+    that no real query ever attends to, so it stays isolated.
+    ``precision`` pins the einsum matmul precision (the decode path
+    passes Precision.HIGHEST for width-independent MXU rounding)."""
     import jax
 
     D = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        precision=precision,
     ) * scale
     s = jnp.where(valid, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
-        q.dtype
-    )
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32), precision=precision
+    ).astype(q.dtype)
 
 
 def _left_pad_attention(q, k, v, pad_lens):
@@ -210,15 +242,20 @@ class Block(nn.Module):
         # width-dependent rounding, and it is the one decode-mode call
         # that is compute-bound (TxT attention over the whole prompt).
         dt = cfg.compute_dtype(decode and not prefill)
+        # Width-independent decode rounding: pin MXU precision on the
+        # non-prefill decode path (see GPT2Config.decode_precision).
+        prec = cfg.matmul_precision(decode and not prefill)
 
         h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_1")(x)
-        qkv = nn.Dense(3 * cfg.n_embd, dtype=dt, name="c_attn")(h)
+        qkv = nn.Dense(
+            3 * cfg.n_embd, dtype=dt, precision=prec, name="c_attn"
+        )(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, cfg.n_head, head_dim)
         k = k.reshape(B, T, cfg.n_head, head_dim)
         v = v.reshape(B, T, cfg.n_head, head_dim)
         if decode:
-            a = self._cached_attention(q, k, v, pad_lens)
+            a = self._cached_attention(q, k, v, pad_lens, prec)
         elif pad_lens is not None:
             # Ragged (LEFT-padded) batch without a cache — the scoring path:
             # pad columns are masked out of every key set and real positions
@@ -228,7 +265,7 @@ class Block(nn.Module):
         else:
             a = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         a = a.reshape(B, T, cfg.n_embd)
-        a = nn.Dense(cfg.n_embd, dtype=dt, name="c_proj")(a)
+        a = nn.Dense(cfg.n_embd, dtype=dt, precision=prec, name="c_proj")(a)
         a = nn.Dropout(cfg.dropout, deterministic=not train)(a)
         x = x + a
 
@@ -246,13 +283,17 @@ class Block(nn.Module):
                 name="moe",
             )(h, train)
         else:
-            h = nn.Dense(4 * cfg.n_embd, dtype=dt, name="mlp_fc")(h)
+            h = nn.Dense(
+                4 * cfg.n_embd, dtype=dt, precision=prec, name="mlp_fc"
+            )(h)
             h = nn.gelu(h)
-            h = nn.Dense(cfg.n_embd, dtype=dt, name="mlp_proj")(h)
+            h = nn.Dense(
+                cfg.n_embd, dtype=dt, precision=prec, name="mlp_proj"
+            )(h)
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
-    def _cached_attention(self, q, k, v, pad_lens=None):
+    def _cached_attention(self, q, k, v, pad_lens=None, precision=None):
         """Fixed-size KV-cache attention (decode mode).
 
         Writes the new k/v at ``cache_index`` and attends q over the whole
@@ -313,17 +354,24 @@ class Block(nn.Module):
                 valid = valid & (
                     k_pos[None, None] >= pad_lens[:, None, None, None]
                 )
-            return _masked_attention(q, ck.value, cv.value, valid)
+            return _masked_attention(
+                q, ck.value, cv.value, valid, precision=precision
+            )
 
         if T > 1:
             # Fresh-cache prefill (start == 0) takes an exact T x T path —
             # the pluggable dispatch when dense, the left-padded masked
             # form when ragged — instead of softmaxing over n_ctx - T dead
             # cache columns; warm-cache (chunked) prefill takes the general
-            # cache path. Runtime branch: start is traced.
+            # cache path. Runtime branch: start is traced. Decode mode is
+            # never differentiated, so 'auto' dispatch uses the FWD-ONLY
+            # flash crossover (needs_bwd=False): prefill gets the flash
+            # win from the much lower fwd threshold even at sequence
+            # lengths where the backward would have lost to XLA.
             fast = (
                 (lambda: attention(
-                    q, k, v, causal=True, impl=cfg.attn_impl
+                    q, k, v, causal=True, impl=cfg.attn_impl,
+                    needs_bwd=False,
                 ).astype(q.dtype))
                 if pad_lens is None
                 else (lambda: _left_pad_attention(q, k, v, pad_lens))
@@ -472,4 +520,9 @@ class GPT2(nn.Module):
             x,
             wte.astype(dt),
             preferred_element_type=jnp.float32,
+            # Decode non-prefill: HIGHEST precision so the logits'
+            # rounding is width-independent on the MXU too (the f32
+            # accumulator alone does not fix the bf16 multiply passes
+            # DEFAULT precision lowers f32 operands to).
+            precision=cfg.matmul_precision(decode and not prefill),
         )

@@ -56,6 +56,12 @@ CATALOG: dict[str, tuple[str, str]] = {
     "train.validation": ("span", "held-out validation pass"),
     "train.tokens": ("counter", "steady-state tokens consumed by train steps"),
     "train.report": ("event", "one TrainContext.report: step + metrics"),
+    "train.dispatch_depth": (
+        "gauge",
+        "dispatch-ahead window depth the hot loop resolved "
+        "(TPUFLOW_DISPATCH_DEPTH): how many steps may be in flight "
+        "before the host settles the oldest step's scalars",
+    ),
     # ---------------------------------------------------------------- ckpt
     "ckpt.save": ("span", "checkpoint save, save() → commit; bytes + gbps"),
     "ckpt.restore": ("span", "checkpoint restore; bytes + gbps when known"),
@@ -73,6 +79,12 @@ CATALOG: dict[str, tuple[str, str]] = {
     "data.batch_wait_s": ("histogram", "time the consumer blocked per batch"),
     "data.prefetch_hit": ("counter", "batches ready with no consumer wait"),
     "data.prefetch_miss": ("counter", "batches the consumer had to wait for"),
+    "data.host_wait_s": (
+        "gauge",
+        "seconds the consuming loop actually blocked for this batch "
+        "(~0 on every prefetch hit = the input pipeline ran entirely "
+        "behind device compute)",
+    ),
     # --------------------------------------------------------------- infer
     "infer.predict": ("span", "BatchPredictor forward over one batch"),
     "infer.generate": ("span", "one generate() call; tokens + tokens/s"),

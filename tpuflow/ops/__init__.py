@@ -1,5 +1,9 @@
 """TPU compute kernels: XLA-fused ops and Pallas kernels for the hot paths."""
 
-from tpuflow.ops.attention import attention, xla_attention
+from tpuflow.ops.attention import (
+    attention,
+    resolve_attention_impl,
+    xla_attention,
+)
 
-__all__ = ["attention", "xla_attention"]
+__all__ = ["attention", "resolve_attention_impl", "xla_attention"]

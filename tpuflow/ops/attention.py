@@ -45,15 +45,21 @@ def xla_attention(q, k, v, *, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+# Shipped defaults for the auto-dispatch thresholds, from the on-chip
+# evidence (BENCH_r05): fwd+bwd needs T >= 2048 (at T=512 the flash
+# backward LOST to XLA at 0.2x while fwd won 2.73x — the two paths have
+# genuinely different crossovers, so they carry independent thresholds);
+# fwd-only (decode prefill, scoring without grad) wins from T >= 512.
 _DEFAULT_FLASH_MIN_SEQ = 2048
+_DEFAULT_FLASH_MIN_SEQ_FWD = 512
 _flash_tuning_cache: dict | None = None
 _warned_malformed_env = False
 
 
 def flash_tuning_path() -> str:
-    """Where ``bench.py`` persists the measured flash/XLA fwd+bwd
-    crossover on this host: ``$TPUFLOW_HOME/flash_tuning.json`` with
-    ``{"flash_min_seq": T}``."""
+    """Where ``bench.py`` persists the measured flash/XLA crossovers on
+    this host: ``$TPUFLOW_HOME/flash_tuning.json`` with
+    ``{"flash_min_seq": T_fwdbwd, "flash_min_seq_fwd": T_fwd}``."""
     import os
 
     home = os.environ.get(
@@ -62,18 +68,39 @@ def flash_tuning_path() -> str:
     return os.path.join(home, "flash_tuning.json")
 
 
-def _flash_min_seq() -> int:
-    """Dispatch threshold resolution: TPUFLOW_FLASH_MIN_SEQ env var beats
-    the host's measured tuning file beats the shipped default. A MALFORMED
-    env var falls through to the tuning-file lookup (the host's measured
+def _flash_tuning() -> dict:
+    import json
+
+    global _flash_tuning_cache
+    if _flash_tuning_cache is None:
+        try:
+            with open(flash_tuning_path()) as f:
+                _flash_tuning_cache = json.load(f)
+        except (OSError, ValueError):
+            _flash_tuning_cache = {}
+    return _flash_tuning_cache
+
+
+def _flash_min_seq(*, needs_bwd: bool = True) -> int:
+    """Dispatch threshold resolution, independently for the fwd+bwd
+    (training) and fwd-only (inference) paths: the env var
+    (TPUFLOW_FLASH_MIN_SEQ / TPUFLOW_FLASH_MIN_SEQ_FWD) beats the host's
+    measured tuning file beats the shipped default. A MALFORMED env var
+    falls through to the tuning-file lookup (the host's measured
     crossover — strictly better information than the shipped constant)
     and warns once per process, through the obs stream when one is live.
-    The file read is cached per process (this runs at trace time)."""
-    import json
+    An unset fwd-only env var falls back to the fwd+bwd env var scaled
+    by nothing — i.e. only its own sources; the two paths never borrow
+    each other's thresholds (BENCH_r05: at T=512 fwd wins 2.73x while
+    fwd+bwd loses at 0.2x). The file read is cached per process (this
+    runs at trace time)."""
     import os
 
-    global _flash_tuning_cache, _warned_malformed_env
-    env = os.environ.get("TPUFLOW_FLASH_MIN_SEQ")
+    global _warned_malformed_env
+    env_name = (
+        "TPUFLOW_FLASH_MIN_SEQ" if needs_bwd else "TPUFLOW_FLASH_MIN_SEQ_FWD"
+    )
+    env = os.environ.get(env_name)
     if env is not None:
         try:
             return int(env)
@@ -85,40 +112,60 @@ def _flash_min_seq() -> int:
                 from tpuflow import obs
 
                 warnings.warn(
-                    f"TPUFLOW_FLASH_MIN_SEQ={env!r} is not an integer; "
+                    f"{env_name}={env!r} is not an integer; "
                     "falling through to the tuning file / default",
                     stacklevel=2,
                 )
                 obs.event("warn.flash_min_seq_malformed", value=env)
             # fall through to the measured tuning file below
-    if _flash_tuning_cache is None:
-        try:
-            with open(flash_tuning_path()) as f:
-                _flash_tuning_cache = json.load(f)
-        except (OSError, ValueError):
-            _flash_tuning_cache = {}
-    v = _flash_tuning_cache.get("flash_min_seq")
-    return v if isinstance(v, int) and v > 0 else _DEFAULT_FLASH_MIN_SEQ
+    key = "flash_min_seq" if needs_bwd else "flash_min_seq_fwd"
+    v = _flash_tuning().get(key)
+    if isinstance(v, int) and v > 0:
+        return v
+    return (
+        _DEFAULT_FLASH_MIN_SEQ if needs_bwd else _DEFAULT_FLASH_MIN_SEQ_FWD
+    )
 
 
-def attention(q, k, v, *, causal: bool = True, impl: str = "xla"):
+def resolve_attention_impl(
+    impl: str, seq_len: int, *, needs_bwd: bool = True,
+    backend: str | None = None,
+) -> str:
+    """Resolve ``impl='auto'`` to a concrete implementation for one
+    (backend, seq_len, path) combination — factored out of ``attention``
+    so the dispatch choice is unit-testable without a TPU. Non-'auto'
+    impls pass through unchanged. ``needs_bwd`` selects which measured
+    crossover applies: the fwd+bwd threshold for calls that will be
+    differentiated (training), the fwd-only threshold for pure-inference
+    forwards (decode prefill) — see ``_flash_min_seq``."""
+    if impl != "auto":
+        return impl
+    backend = backend if backend is not None else jax.default_backend()
+    if backend == "tpu" and seq_len >= _flash_min_seq(needs_bwd=needs_bwd):
+        return "flash"
+    return "xla"
+
+
+def attention(q, k, v, *, causal: bool = True, impl: str = "xla",
+              needs_bwd: bool = True):
     """Dispatch to the selected implementation (see module docstring).
 
     ``impl='auto'`` picks by measured crossover: flash only on TPU at
-    T >= the resolved threshold (TPUFLOW_FLASH_MIN_SEQ env var, else the
-    host's bench-measured tuning file — ``flash_tuning_path()`` — else
-    2048, the r4 measured-win point: on-chip evidence had fwd+bwd
-    winning at T=2048 by 1.73x while the T=512 record proved timing-
-    artifact-suspect), and XLA everywhere else — CPU always takes XLA
-    (flash there is interpret-mode, for tests only).
+    T >= the resolved threshold — the fwd+bwd threshold when
+    ``needs_bwd`` (TPUFLOW_FLASH_MIN_SEQ / tuning-file ``flash_min_seq``
+    / 2048: on-chip evidence had fwd+bwd winning at T=2048 by 1.73x and
+    LOSING at T=512 by 0.2x), else the fwd-only threshold
+    (TPUFLOW_FLASH_MIN_SEQ_FWD / ``flash_min_seq_fwd`` / 512, where fwd
+    alone already won 2.73x) — and XLA everywhere else; CPU always takes
+    XLA (flash there is interpret-mode, for tests only).
     """
     if impl == "auto":
         # NB: resolved at trace time — under jit the choice is baked into
         # the compiled program for each shape; changing the env var after
         # compilation does not retune existing executables.
-        on_tpu = jax.default_backend() == "tpu"
-        impl = "flash" if (on_tpu and q.shape[1] >= _flash_min_seq()) \
-            else "xla"
+        impl = resolve_attention_impl(
+            "auto", q.shape[1], needs_bwd=needs_bwd
+        )
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal)
     if impl == "flash":

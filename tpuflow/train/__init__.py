@@ -6,8 +6,10 @@ from tpuflow.obs.health import TrainingDiverged
 from tpuflow.train.gpt import GptTrainConfig, GptTrainResult, train_gpt
 from tpuflow.train.optim import make_optimizer, make_schedule
 from tpuflow.train.step import (
+    DispatchWindow,
     TrainState,
     create_train_state,
+    dispatch_depth,
     make_eval_step,
     make_train_step,
     per_worker_batch_size,
@@ -26,6 +28,7 @@ from tpuflow.train.trainer import (
 
 __all__ = [
     "CheckpointConfig",
+    "DispatchWindow",
     "GptTrainConfig",
     "GptTrainResult",
     "Result",
@@ -36,6 +39,7 @@ __all__ = [
     "Trainer",
     "TrainingDiverged",
     "create_train_state",
+    "dispatch_depth",
     "get_context",
     "make_eval_step",
     "make_optimizer",

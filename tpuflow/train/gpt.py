@@ -189,6 +189,19 @@ def train_gpt(
     restore's page backing overlaps the setup work here.
     """
     cfg.validate()
+    # Startup latency: point XLA's persistent compilation cache at a
+    # durable directory (default $TPUFLOW_HOME/compile_cache; set
+    # TPUFLOW_COMPILE_CACHE=run to key it under this run's directory —
+    # the right mode when only the run dir is on shared storage, e.g. a
+    # requeued k8s gang whose pod-local home is ephemeral). Retried /
+    # requeued / resumed attempts then load the compiled step instead of
+    # re-paying the 20-40 s TPU compile (BENCH_r05: compile_s 62.9,
+    # wall_to_first_step 125.1 s).
+    from tpuflow import dist as _dist
+
+    _dist.maybe_enable_compile_cache(
+        run_dir=os.path.dirname(os.path.abspath(ckpt_dir))
+    )
     if cfg.stage_axis > 1:
         if cfg.fsdp_axis > 1:
             log(
@@ -357,11 +370,16 @@ def _train_fsdp(
             )
         opt_step = int(state.step)
         # Telemetry (tpuflow.obs): per-step wall times + tokens ride the
-        # fences the loop already pays; batch-wait rides the loader
+        # fences the loop already pays; batch-wait rides the prefetch
         # iterator. All no-ops when obs is disabled.
         from tpuflow import obs
+        from tpuflow.data.loader import prefetch_to_device
         from tpuflow.obs import health as health_mod
-        from tpuflow.train.step import StepClock
+        from tpuflow.train.step import (
+            DispatchWindow,
+            StepClock,
+            dispatch_depth,
+        )
 
         # Training-health observatory (ISSUE 3): the monitor judges each
         # fenced step's numerics (None when TPUFLOW_HEALTH=0 — one
@@ -372,11 +390,65 @@ def _train_fsdp(
         lr_scale = 1.0
         fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
 
+        # Dispatch-ahead (ISSUE 4): up to `depth` steps run in flight;
+        # the oldest step's scalars are settled (the float() host copies
+        # below, which ARE the fence) only when the window fills, at
+        # epoch end, and at every preemption/profile drain point — so
+        # health rollback and requeue still land on a committed step
+        # boundary, just observed up to depth-1 steps late.
+        window = DispatchWindow(dispatch_depth())
+        obs.gauge("train.dispatch_depth", float(window.depth))
+
+        def settle(entry) -> None:
+            """Fence one matured step and run its host-side accounting
+            (telemetry, health monitor). Raises _RollbackSignal when the
+            monitor flags the step. ``timed`` is False for the cold
+            (compile) step, which records train.compile instead of a
+            train.step_s observation."""
+            step_no, metrics, tokens, timed = entry
+            if monitor is not None or clock.recording:
+                # 4-byte host copies; the first one blocks until the
+                # step's program finished (the fence).
+                nf = bool(float(metrics["nonfinite"]))
+                m_loss = float(metrics["loss"])
+                m_gn = float(metrics["grad_norm"])
+                if clock.recording:
+                    if timed:
+                        clock.step_done(tokens=tokens)
+                    clock.health_done(
+                        loss=m_loss,
+                        grad_norm=m_gn,
+                        update_norm=float(metrics["update_norm"]),
+                        param_norm=float(metrics["param_norm"]),
+                        nonfinite=nf,
+                    )
+                if monitor is not None:
+                    anomaly = monitor.observe(
+                        step_no, m_loss, m_gn, nonfinite=nf
+                    )
+                    if anomaly is not None:
+                        target = health_mod.handle_anomaly(
+                            monitor, anomaly, mgr
+                        )
+                        raise health_mod._RollbackSignal(target, anomaly)
+            else:
+                # No consumer for the scalars: still fence, so the
+                # window bounds the in-flight dispatch queue.
+                jax.block_until_ready(metrics["loss"])
+                if timed:
+                    clock.step_done(tokens=tokens)
+
+        def drain_window() -> None:
+            for entry in window.drain():
+                settle(entry)
+
         def drain_preempt() -> None:
             # SIGTERM landed (or was injected): commit a final checkpoint
             # at the current step and hand back Preempted — gang_exec
             # converts it into the requeue exit code, and the supervisor
-            # reruns the step without consuming the retry budget.
+            # reruns the step without consuming the retry budget. The
+            # window drains first so the saved step is a settled one.
+            drain_window()
             payload = {
                 "step": state.step,
                 "params": state.params,
@@ -389,6 +461,14 @@ def _train_fsdp(
             mgr.close()
             raise Preempted(f"drained checkpoint at step {opt_step}")
 
+        def place_batch(b):
+            # Runs on the prefetch thread: host→device placement onto
+            # the step's exact batch sharding overlaps device compute.
+            return {
+                "x": jax.device_put(b["x"], batch_sharding),
+                "y": jax.device_put(b["y"], batch_sharding),
+            }
+
         clock = StepClock()
         cold = True
         while True:
@@ -400,8 +480,8 @@ def _train_fsdp(
                     losses = []
                     n_tokens = 0
                     clock.reset()
-                    for i, b in enumerate(
-                        obs.timed_iter(loader, "data.batch_wait_s")
+                    for batch in prefetch_to_device(
+                        loader, mesh, keys=("x", "y"), place=place_batch
                     ):
                         if fault_env:
                             from tpuflow.testing import faults
@@ -415,61 +495,51 @@ def _train_fsdp(
                                 )
                         if profile is not None:
                             profile.maybe_start(opt_step + 1)
-                        batch = {
-                            "x": jax.device_put(b["x"], batch_sharding),
-                            "y": jax.device_put(b["y"], batch_sharding),
-                        }
                         state, metrics = train_step(state, batch, rng)
                         losses.append(metrics["loss"])
+                        tokens = int(np.prod(batch["y"].shape))
                         if cold:
                             # Fence out jit compilation so throughput
                             # numbers are comparable across epochs; the
                             # first batch's tokens are excluded from the
-                            # rate accordingly.
+                            # rate accordingly. The cold step settles
+                            # inline (never enters the window).
                             jax.block_until_ready(metrics["loss"])
                             t_epoch = time.monotonic()
                             ts_epoch = time.time()
                             clock.compile_done(preset=cfg.preset)
                             cold = False
+                            opt_step += 1
+                            settle((opt_step, metrics, 0, False))
                         else:
+                            # No-op on accelerators; blocking on the
+                            # serialized host-CPU platform (at most one
+                            # collective program in flight there — see
+                            # dist.serialize_steps).
                             dist.step_fence(metrics["loss"])
-                            n_tokens += int(np.prod(b["y"].shape))
-                            clock.step_done(tokens=int(np.prod(b["y"].shape)))
-                        opt_step += 1
+                            n_tokens += tokens
+                            opt_step += 1
+                            for entry in window.push(
+                                (opt_step, metrics, tokens, True)
+                            ):
+                                settle(entry)
                         if profile is not None:
+                            # Keep execution inside the trace window:
+                            # effectively dispatch depth 1 while the
+                            # profiler is live (rare, bounded by the
+                            # TPUFLOW_PROFILE step range).
+                            drain_window()
                             profile.maybe_stop(opt_step)
-                        if monitor is not None or clock.recording:
-                            # The fence above already materialized the
-                            # step's outputs; these are 4-byte host
-                            # copies, not device syncs.
-                            nf = bool(float(metrics["nonfinite"]))
-                            m_loss = float(metrics["loss"])
-                            m_gn = float(metrics["grad_norm"])
-                            if clock.recording:
-                                clock.health_done(
-                                    loss=m_loss,
-                                    grad_norm=m_gn,
-                                    update_norm=float(metrics["update_norm"]),
-                                    param_norm=float(metrics["param_norm"]),
-                                    nonfinite=nf,
-                                )
-                            if monitor is not None:
-                                anomaly = monitor.observe(
-                                    opt_step, m_loss, m_gn, nonfinite=nf
-                                )
-                                if anomaly is not None:
-                                    target = health_mod.handle_anomaly(
-                                        monitor, anomaly, mgr
-                                    )
-                                    raise health_mod._RollbackSignal(
-                                        target, anomaly
-                                    )
                         if fault_env:
                             from tpuflow.testing import faults
 
                             faults.step_boundary(opt_step)
                         if preemption_requested():
                             drain_preempt()
+                    # Settle the tail of the window BEFORE any epoch
+                    # accounting: a flagged in-flight step must roll the
+                    # epoch back, never reach the history or the save.
+                    drain_window()
                     jax.block_until_ready(state.params)
                     epoch_s = time.monotonic() - t_epoch
                     tok_s = (
@@ -547,6 +617,9 @@ def _train_fsdp(
                 # Divergence auto-rollback: restore the last crc-verified
                 # checkpoint (handle_anomaly picked it) and replay from
                 # there — the reverse of the in-run resume path above.
+                # In-flight steps past the flagged one are discarded
+                # along with the state they produced.
+                window.clear()
                 from_step = opt_step
                 if monitor.cfg.lr_backoff != 1.0:
                     # LR backoff rides a rebuilt optimizer; the schedule
@@ -713,6 +786,12 @@ def _train_pipeline(
             # the full replicated tree. Resumes skip this entirely — the
             # restore produces every leaf (materializing random weights
             # just to overwrite them doubled resume wall time).
+            # Donation audit (ISSUE 4): these one-shot resharding jits
+            # (and the eager-restore device_puts below) deliberately do
+            # NOT donate their inputs — the restore fallback path may
+            # re-read `restored` after a corrupt-shard retry, and a
+            # donated-then-freed source would alias whatever the next
+            # dispatch-ahead step wrote into that buffer.
             params = jax.jit(init_params, out_shardings=shardings)(
                 jax.random.PRNGKey(0)
             )
@@ -739,8 +818,15 @@ def _train_pipeline(
         # Donated params/opt_state: old and new state never coexist in HBM
         # (matches make_train_step's donate pattern; safe because mgr.save
         # snapshots device buffers synchronously before its async writer
-        # starts, and the loop rebinds both every step). A factory so the
-        # divergence LR backoff can rebuild the step around a rescaled tx.
+        # starts, and the loop rebinds both every step). Dispatch-ahead
+        # audit (ISSUE 4): with N steps in flight the only live
+        # references are the loop's current params/opt_state bindings
+        # (each step's donated inputs were the PREVIOUS step's outputs,
+        # rebound before the next dispatch) and the window's loss/hstats
+        # entries — fresh, never-donated output buffers. Nothing else
+        # may retain the donated trees between dispatches. A factory so
+        # the divergence LR backoff can rebuild the step around a
+        # rescaled tx.
         def make_pp_step(tx):
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def pp_step(params, opt_state, x, y):
@@ -784,10 +870,61 @@ def _train_pipeline(
                 f"→ epoch {start_epoch}"
             )
         from tpuflow import obs
+        from tpuflow.data.loader import prefetch_to_device
         from tpuflow.obs import health as health_mod
-        from tpuflow.train.step import StepClock
+        from tpuflow.train.step import (
+            DispatchWindow,
+            StepClock,
+            dispatch_depth,
+        )
+
+        monitor = health_mod.HealthMonitor.from_env()
+        profile = health_mod.ProfileWindow.from_env()
+        lr_scale = 1.0
+        fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
+        clock = StepClock()
+        # Dispatch-ahead window, same contract as the FSDP leg: fences
+        # (the float() copies in settle) trail dispatch by up to depth-1
+        # steps; every drain point below settles to a step boundary.
+        window = DispatchWindow(dispatch_depth())
+        obs.gauge("train.dispatch_depth", float(window.depth))
+
+        def settle(entry) -> None:
+            step_no, loss, hstats, tokens, timed = entry
+            if monitor is not None or clock.recording:
+                nf = bool(float(hstats["nonfinite"]))
+                m_loss = float(loss)
+                m_gn = float(hstats["grad_norm"])
+                if clock.recording:
+                    if timed:
+                        clock.step_done(tokens=tokens)
+                    clock.health_done(
+                        loss=m_loss,
+                        grad_norm=m_gn,
+                        update_norm=float(hstats["update_norm"]),
+                        param_norm=float(hstats["param_norm"]),
+                        nonfinite=nf,
+                    )
+                if monitor is not None:
+                    anomaly = monitor.observe(
+                        step_no, m_loss, m_gn, nonfinite=nf
+                    )
+                    if anomaly is not None:
+                        target = health_mod.handle_anomaly(
+                            monitor, anomaly, mgr
+                        )
+                        raise health_mod._RollbackSignal(target, anomaly)
+            else:
+                jax.block_until_ready(loss)
+                if timed:
+                    clock.step_done(tokens=tokens)
+
+        def drain_window() -> None:
+            for entry in window.drain():
+                settle(entry)
 
         def drain_preempt() -> None:
+            drain_window()
             mgr.save(
                 global_step,
                 {
@@ -801,11 +938,13 @@ def _train_pipeline(
             mgr.close()
             raise Preempted(f"drained checkpoint at step {global_step}")
 
-        monitor = health_mod.HealthMonitor.from_env()
-        profile = health_mod.ProfileWindow.from_env()
-        lr_scale = 1.0
-        fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
-        clock = StepClock()
+        def place_batch(b):
+            # Prefetch-thread placement onto the pipeline's 'data' axis.
+            return {
+                "x": jax.device_put(b["x"], data_sharding),
+                "y": jax.device_put(b["y"], data_sharding),
+            }
+
         first = True
         while True:
             try:
@@ -813,7 +952,9 @@ def _train_pipeline(
                     loader.set_epoch(epoch)
                     losses = []
                     clock.reset()
-                    for b in obs.timed_iter(loader, "data.batch_wait_s"):
+                    for batch in prefetch_to_device(
+                        loader, mesh, keys=("x", "y"), place=place_batch
+                    ):
                         if fault_env:
                             from tpuflow.testing import faults
 
@@ -827,50 +968,33 @@ def _train_pipeline(
                         params, opt_state, loss, hstats = pp_step(
                             params,
                             opt_state,
-                            jax.device_put(b["x"], data_sharding),
-                            jax.device_put(b["y"], data_sharding),
+                            batch["x"],
+                            batch["y"],
                         )
                         dist.step_fence(loss)
+                        losses.append(loss)
+                        tokens = int(batch["y"].size)
+                        global_step += 1
                         if first:
+                            jax.block_until_ready(loss)
                             clock.compile_done(mode="pipeline")
                             first = False
+                            settle((global_step, loss, hstats, 0, False))
                         else:
-                            clock.step_done(tokens=int(b["y"].size))
-                        losses.append(loss)
-                        global_step += 1
+                            for entry in window.push(
+                                (global_step, loss, hstats, tokens, True)
+                            ):
+                                settle(entry)
                         if profile is not None:
+                            drain_window()
                             profile.maybe_stop(global_step)
-                        if monitor is not None or clock.recording:
-                            nf = bool(float(hstats["nonfinite"]))
-                            m_loss = float(loss)
-                            m_gn = float(hstats["grad_norm"])
-                            if clock.recording:
-                                clock.health_done(
-                                    loss=m_loss,
-                                    grad_norm=m_gn,
-                                    update_norm=float(
-                                        hstats["update_norm"]
-                                    ),
-                                    param_norm=float(hstats["param_norm"]),
-                                    nonfinite=nf,
-                                )
-                            if monitor is not None:
-                                anomaly = monitor.observe(
-                                    global_step, m_loss, m_gn, nonfinite=nf
-                                )
-                                if anomaly is not None:
-                                    target = health_mod.handle_anomaly(
-                                        monitor, anomaly, mgr
-                                    )
-                                    raise health_mod._RollbackSignal(
-                                        target, anomaly
-                                    )
                         if fault_env:
                             from tpuflow.testing import faults
 
                             faults.step_boundary(global_step)
                         if preemption_requested():
                             drain_preempt()
+                    drain_window()
                     jax.block_until_ready(params)
                     epoch_loss = float(jnp.stack(losses).mean())
                     history.append(epoch_loss)
@@ -896,6 +1020,7 @@ def _train_pipeline(
                 mgr.wait_until_finished()
                 raise
             except health_mod._RollbackSignal as rb:
+                window.clear()
                 from_step = global_step
                 if monitor.cfg.lr_backoff != 1.0:
                     lr_scale *= monitor.cfg.lr_backoff

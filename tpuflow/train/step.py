@@ -11,6 +11,8 @@ collective call, matching the reference's encapsulation of NCCL behind
 
 from __future__ import annotations
 
+import collections
+import os
 import time
 from typing import Any, Callable
 
@@ -36,6 +38,88 @@ from tpuflow.utils.preempt import (  # noqa: F401  (re-exported API)
     preemption_requested,
     request_preemption,
 )
+
+
+def dispatch_depth(default: int = 2) -> int:
+    """Resolve the dispatch-ahead window depth (ISSUE 4).
+
+    ``TPUFLOW_DISPATCH_DEPTH`` steps may be in flight on the accelerator
+    before the host materializes the oldest step's scalars (loss, health
+    numerics): the hot loops push each step's outputs into a
+    :class:`DispatchWindow` and only settle — ``float()`` the device
+    scalars, which is the true fence — once the window is full. Depth 1
+    reproduces the old settle-every-step loop exactly; the default of 2
+    keeps one step queued behind the executing one, so host-side work
+    (batch placement, telemetry, the health monitor) overlaps device
+    compute instead of serializing with it.
+
+    Values < 1 clamp to 1; a malformed value falls back to ``default``
+    (the loop must never die on a typo'd env var mid-provisioning).
+
+    Platform note: on the serialized host-CPU dev platform the loops
+    still ``dist.step_fence`` each step at dispatch (XLA:CPU's
+    collective rendezvous kills the process when more than one
+    collective program is in flight on a starved host — see
+    ``dist.serialize_steps``), so there the window only defers the
+    host-side accounting; on accelerators the window IS the only
+    per-step synchronization.
+    """
+    env = os.environ.get("TPUFLOW_DISPATCH_DEPTH")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, default)
+
+
+class DispatchWindow:
+    """Bounded dispatch-ahead bookkeeping for a fenced step loop.
+
+    Pure host-side bookkeeping (no jax dependency): the loop ``push``es
+    one opaque entry per dispatched step; once ``depth`` entries are
+    pending, ``push`` returns the oldest entries (the matured ones) for
+    the caller to settle — the caller's settle function does the actual
+    fence (``float()`` on a device scalar blocks until that step's
+    program finished, which transitively bounds the in-flight window).
+    ``drain()`` matures everything pending (epoch end, preemption drain,
+    pre-checkpoint barrier); ``clear()`` abandons pending entries
+    without settling them (divergence rollback: the in-flight steps are
+    being discarded along with the state they produced).
+
+    Why the caller settles instead of a callback: settle raises —
+    health anomalies unwind the epoch loop via ``_RollbackSignal`` — and
+    the raise must happen in the loop's own try block, not inside a
+    helper frame holding half-consumed state.
+    """
+
+    def __init__(self, depth: int = 1):
+        self.depth = max(1, int(depth))
+        self._pending: collections.deque = collections.deque()
+
+    def push(self, entry) -> list:
+        """Queue one dispatched step; return entries due for settling
+        (oldest first). With depth N, the entry pushed for step i
+        matures when step i+N-1 is pushed — depth 1 returns every entry
+        immediately (the settle-every-step loop)."""
+        self._pending.append(entry)
+        out = []
+        while len(self._pending) >= self.depth:
+            out.append(self._pending.popleft())
+        return out
+
+    def drain(self) -> list:
+        """Mature every pending entry (oldest first)."""
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def clear(self) -> None:
+        """Abandon pending entries WITHOUT settling (rollback path)."""
+        self._pending.clear()
+
+    def __len__(self) -> int:
+        return len(self._pending)
 
 
 class StepClock:
@@ -205,6 +289,16 @@ def make_train_step(
     identical to the full-batch step for mean losses (pinned by
     tests/test_train_step.py). The scan is a compiler-friendly loop: one
     trace, static shapes, grads carried in place.
+
+    Donation audit (ISSUE 4, dispatch-ahead): argument 0 (the state) is
+    donated — XLA reuses its buffers for the new state, so the OLD state
+    must never be touched after the call. The hot loops honor this by
+    (a) rebinding ``state`` before the next dispatch and (b) keeping
+    only the step's *outputs* (the metrics dict) alive in the
+    :class:`DispatchWindow` while up to ``dispatch_depth()`` steps are
+    in flight; batches and rng are NOT donated, so the prefetch thread's
+    placed batches stay valid however late the step executes (pinned by
+    tests/test_train_step.py donation-safety tests).
     """
 
     if accum_steps < 1:

@@ -369,6 +369,11 @@ class Trainer:
 
     def fit(self) -> Result:
         global _ACTIVE_CONTEXT
+        # Persistent XLA compile cache (default-on; TPUFLOW_COMPILE_CACHE
+        # =run keys it under this run's storage path): retried/requeued
+        # attempts reload the compiled step instead of re-paying the
+        # first-compile wall time. See dist.maybe_enable_compile_cache.
+        dist.maybe_enable_compile_cache(run_dir=self.run_config.storage_path)
         mesh = self._build_mesh()
         ctx = TrainContext(mesh, self.run_config)
         _ACTIVE_CONTEXT = ctx
