@@ -272,6 +272,15 @@ def train_func_per_worker(config: dict) -> None:
             {"val_loss": val_loss, "accuracy": accuracy},
             state=_state_tree(state),
             step=epoch + 1,
+            # Loader cursor (ISSUE 5): this loop checkpoints at epoch
+            # boundaries, so a resumed attempt starts the next epoch at
+            # its head — persisted so restore tooling sees one contract
+            # across loops.
+            data_state={
+                "epoch": epoch + 1,
+                "batch_index": 0,
+                "seed": int(train_loader.seed),
+            },
         )
     _log(f"total training time: {time.monotonic() - start:.1f}s")
 

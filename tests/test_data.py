@@ -286,6 +286,31 @@ def test_lm_text_explicit_missing_path_raises(tmp_path, monkeypatch):
         load_dataset("lm_text", data_dir=str(tmp_path), seq_len=8)
 
 
+def test_skip_batches_replays_exact_epoch_tail():
+    """Deterministic mid-epoch resume (ISSUE 5): skip_batches(k) on a
+    fresh loader with the same (seed, epoch) yields exactly the batches
+    k.. of the uninterrupted epoch — one-shot (the next epoch starts at
+    its head), and the persisted cursor round-trips via state_dict."""
+    split = _toy_split(40)
+    full = ShardedLoader(split, 5, shuffle=True, seed=7)
+    full.set_epoch(2)
+    whole = [b["y"] for b in full]
+
+    resumed = ShardedLoader(split, 5, shuffle=True, seed=7)
+    resumed.set_epoch(2)
+    resumed.skip_batches(3)
+    tail = [b["y"] for b in resumed]
+    assert len(tail) == len(whole) - 3
+    for want, got in zip(whole[3:], tail):
+        np.testing.assert_array_equal(want, got)
+    # One-shot: a repeat iteration of the same epoch starts at the head.
+    again = [b["y"] for b in resumed]
+    assert len(again) == len(whole)
+    np.testing.assert_array_equal(again[0], whole[0])
+    # The cursor a checkpoint persists.
+    assert resumed.state_dict(3) == {"epoch": 2, "batch_index": 3, "seed": 7}
+
+
 def test_max_batches_caps_epoch_but_roams_the_corpus():
     """max_batches bounds batches per epoch while the reshuffle still draws
     from the whole split — different epochs cover different rows."""

@@ -540,12 +540,13 @@ def test_gang_kill_mid_save_leaves_no_torn_step(tmp_path):
                     )
                     # Deterministic mid-save death: the FIRST shard file
                     # of step 2 lands on storage, then the process dies —
-                    # before the manifest/metadata commit can happen.
+                    # before the commit (saves stage into step_2.tmp and
+                    # publish via one atomic rename, ISSUE 5).
                     orig_write = raw_fmt._write_one
 
                     def sabotage(directory, fname, arr, pool=None):
                         orig_write(directory, fname, arr, pool)
-                        if (os.sep + "step_2" + os.sep) in directory and not (
+                        if (os.sep + "step_2.tmp" + os.sep) in directory and not (
                             os.path.exists(marker)
                         ):
                             open(marker, "w").write("x")

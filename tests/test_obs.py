@@ -286,6 +286,10 @@ def test_obs_catalog_lint():
         ("event", "health.anomaly"),
         ("event", "health.rollback"),
         ("event", "health.profile"),
+        # Durable checkpointing (ISSUE 5) — the lint itself also enforces
+        # these via REQUIRED_EMITTERS; asserting through both keeps the
+        # standalone tool and the pytest twin honest about each other.
+        *mod.REQUIRED_EMITTERS,
     ):
         assert required in kinds, f"missing emitter {required}"
     # Kind mismatches and dynamic (unlintable) names are errors, not just

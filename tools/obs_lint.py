@@ -51,6 +51,23 @@ _DYNAMIC_RE = re.compile(
 # those are the recorder's own internals, exempted by path below.
 _EXEMPT_FILES = {os.path.join("tpuflow", "obs", "recorder.py")}
 
+# (kind, name) pairs the tree is REQUIRED to emit somewhere: registration
+# drift is one failure mode, silently deleting the telemetry a runbook
+# depends on is another. The durable-checkpointing evidence trail (ISSUE
+# 5) lives here; the pytest twin (tests/test_obs.py) checks these plus
+# its own per-subsystem list.
+REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
+    ("event", "ckpt.io_retry"),
+    ("event", "ckpt.io_error"),
+    ("event", "ckpt.save_failed"),
+    ("event", "ckpt.gc"),
+    ("span", "ckpt.upload"),
+    ("event", "ckpt.restore_tier"),
+    ("event", "ckpt.emergency_save"),
+    ("event", "ckpt.verify"),
+    ("event", "ckpt.corrupt"),
+)
+
 
 def dynamic_name_calls(src: str) -> list[str]:
     """Emitter calls in ``src`` whose name argument is not a string
@@ -116,6 +133,13 @@ def lint(root: str = REPO) -> tuple[list[str], list[str]]:
                     f"({head!r}...) is invisible to this lint — emit "
                     "literal catalog names instead"
                 )
+    kinds = {(k, n) for _, k, n in emitted_names(root)}
+    for required in REQUIRED_EMITTERS:
+        if required not in kinds:
+            errors.append(
+                f"required emitter missing from tpuflow/: "
+                f"{required[1]!r} ({required[0]})"
+            )
     warnings = [
         f"catalog name {name!r} has no literal emitter in tpuflow/"
         for name in sorted(set(CATALOG) - used)

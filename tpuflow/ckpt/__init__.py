@@ -11,10 +11,11 @@ from tpuflow.ckpt.manager import (
     prewarm_restore_handle,
     restore_from_handle,
 )
-from tpuflow.ckpt.raw import CorruptShardError
+from tpuflow.ckpt.raw import CheckpointIOError, CorruptShardError
 
 __all__ = [
     "Checkpoint",
+    "CheckpointIOError",
     "CheckpointManager",
     "CorruptShardError",
     "prewarm_restore_handle",

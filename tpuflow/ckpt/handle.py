@@ -20,10 +20,17 @@ from typing import Any, Iterator
 
 @dataclasses.dataclass
 class Checkpoint:
-    """Reference to a checkpoint directory written by CheckpointManager."""
+    """Reference to a checkpoint directory written by CheckpointManager.
+
+    ``alt_paths`` (ISSUE 5): alternate directories holding the SAME
+    committed step on other storage tiers — e.g. the node-local fast-tier
+    copy beside the persistent one. ``as_directory`` serves the first
+    tier that still exists, so a handle stays restorable when one tier is
+    gone (persistent dir lagging an upload, or a local copy evicted)."""
 
     path: str
     metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+    alt_paths: list[str] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_directory(cls, path: str, metadata: dict | None = None) -> "Checkpoint":
@@ -42,14 +49,27 @@ class Checkpoint:
     def as_directory(self) -> Iterator[str]:
         """Yield a local directory with the checkpoint contents
         (↔ checkpoint.as_directory(), my_ray_module.py:254). Storage here is a
-        filesystem path already, so no materialization copy is needed."""
-        if not os.path.isdir(self.path):
-            raise FileNotFoundError(f"checkpoint directory gone: {self.path}")
-        yield self.path
+        filesystem path already, so no materialization copy is needed; the
+        first existing tier among ``path`` + ``alt_paths`` serves."""
+        for candidate in [self.path, *self.alt_paths]:
+            if os.path.isdir(candidate):
+                yield candidate
+                return
+        raise FileNotFoundError(
+            f"checkpoint directory gone: {self.path}"
+            + (f" (and {len(self.alt_paths)} alternate tiers)" if self.alt_paths else "")
+        )
 
     def to_json(self) -> dict:
-        return {"path": self.path, "metadata": self.metadata}
+        out = {"path": self.path, "metadata": self.metadata}
+        if self.alt_paths:
+            out["alt_paths"] = list(self.alt_paths)
+        return out
 
     @classmethod
     def from_json(cls, obj: dict) -> "Checkpoint":
-        return cls(path=obj["path"], metadata=obj.get("metadata", {}))
+        return cls(
+            path=obj["path"],
+            metadata=obj.get("metadata", {}),
+            alt_paths=list(obj.get("alt_paths", [])),
+        )

@@ -29,6 +29,7 @@ same overlap Orbax's own manager provides).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
@@ -44,6 +45,39 @@ from tpuflow.ckpt.handle import Checkpoint
 _STATE_DIR = "state"
 _META_FILE = "metadata.json"
 _STEP_PREFIX = "step_"
+# Saves stage into <final>.tmp and become visible via ONE atomic rename at
+# commit; anything still wearing the suffix at manager construction is a
+# killed writer's leftovers and is garbage-collected (ckpt.gc).
+_STAGE_SUFFIX = ".tmp"
+
+
+def _local_tier_root(persistent_dir: str) -> str | None:
+    """Node-local fast-tier directory for this manager, or None when the
+    tier is off. ``TPUFLOW_CKPT_LOCAL_DIR`` names the node-local root
+    (tmpfs / local NVMe); each run keys a subdirectory off a hash of its
+    persistent directory, so concurrent runs never collide while a
+    requeued attempt of the SAME run on the same node finds its local
+    copies again — that is the whole point of the tier (restore in
+    seconds after a preemption instead of re-reading the run dir)."""
+    root = os.environ.get("TPUFLOW_CKPT_LOCAL_DIR")
+    if not root:
+        return None
+    key = hashlib.sha1(os.path.abspath(persistent_dir).encode()).hexdigest()[:16]
+    return os.path.join(os.path.abspath(root), key)
+
+
+def _local_keep(default: int = 2) -> int:
+    """Local-tier retention: newest ``TPUFLOW_CKPT_LOCAL_KEEP`` committed
+    steps survive, oldest evicted first — requeue loops must not fill node
+    disk. Clamped to >= 1 (a tier that keeps nothing is the tier being
+    off); malformed falls back to ``default``."""
+    env = os.environ.get("TPUFLOW_CKPT_LOCAL_KEEP")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return default
 
 
 def _addressable_nbytes(tree) -> int:
@@ -156,6 +190,16 @@ class CheckpointManager:
         self._ckptr = ocp.StandardCheckpointer()
         self._metrics_history: list[dict[str, Any]] = []
         self._pending_commit = None  # multi-host raw: commit deferred to drain
+        # (step, cleanup) of the save currently in flight: consumed by
+        # wait_until_finished when that save dies with a CheckpointIOError
+        # — the failed step's staging is reclaimed, ckpt.save_failed is
+        # recorded, and training continues (ISSUE 5 tentpole).
+        self._pending_fail: tuple[int, Any] | None = None
+        # Node-local fast tier (ISSUE 5): saves stage here first and upload
+        # to the persistent run dir on the saver thread; restores prefer a
+        # crc-valid local copy. None = tier off, persistent-only behavior.
+        self.local_dir = _local_tier_root(self.directory)
+        self.local_keep = _local_keep()
         # Multi-host: construction is collective (like every other manager
         # operation) — the barriers ensure no host is already writing while
         # process 0 sweeps, and no host starts writing before the sweep ends.
@@ -199,9 +243,11 @@ class CheckpointManager:
         exact per-shard sizes this process's saves will request (so no
         truncation waste gets reclaimed by the host), sized to the
         retention footprint: ``max_to_keep`` live steps plus one in flight.
-        No-op for the Orbax format and for already-warm pools.
+        No-op for the Orbax format, for already-warm pools, and with the
+        local fast tier on (staging then writes fresh local pages — the
+        pool lives on the persistent filesystem, see save()).
         """
-        if self._pool is None:
+        if self._pool is None or self.local_dir is not None:
             return
         sizes = []
         for leaf in jax.tree_util.tree_leaves(state):
@@ -252,7 +298,10 @@ class CheckpointManager:
         except (ValueError, FileNotFoundError):
             return
         _prewarm_state_dir(
-            os.path.join(self._step_dir(chosen), _STATE_DIR),
+            os.path.join(
+                self._committed_dir(chosen) or self._step_dir(chosen),
+                _STATE_DIR,
+            ),
             background=background,
         )
 
@@ -262,57 +311,127 @@ class CheckpointManager:
         raw_fmt._ARENA.prewarm_wait()
 
     def _sweep_orphans(self) -> None:
-        """Reclaim step dirs whose save never committed (crash mid-write).
+        """Garbage-collect every leftover of a killed writer (ckpt.gc).
 
-        Uncommitted dirs (no ``metadata.json``) are invisible to
-        ``all_steps()`` and would otherwise leak storage forever; at manager
-        construction no save is in flight, so every uncommitted dir here is a
-        crash orphan — recycle (raw) or delete it."""
+        Three classes, all invisible to ``all_steps()`` but leaking storage
+        forever without the sweep: staged ``step_K.tmp`` dirs (killed
+        between payload and commit — by construction these can NEVER be
+        mistaken for restorable steps, the commit is one atomic rename),
+        committed-looking dirs without a ``metadata.json`` (pre-staging
+        crashes, upload leftovers), and the local fast tier's stale staging
+        plus anything beyond its retention from previous attempts. At
+        manager construction no save is in flight, so everything found here
+        is an orphan — recycle (raw) or delete it."""
         if jax.process_index() != 0:
             return
+        removed: list[str] = []
         try:
             entries = os.listdir(self.directory)
         except FileNotFoundError:
-            return
+            entries = []
         for name in entries:
             if not name.startswith(_STEP_PREFIX):
                 continue
             path = os.path.join(self.directory, name)
-            if os.path.isdir(path) and not os.path.exists(
+            if not os.path.isdir(path):
+                continue
+            if name.endswith(_STAGE_SUFFIX) or not os.path.exists(
                 os.path.join(path, _META_FILE)
             ):
                 if self._pool is not None:
                     self._pool.adopt_dir(path)
                 else:
                     shutil.rmtree(path, ignore_errors=True)
+                removed.append(name)
+        if self.local_dir and os.path.isdir(self.local_dir):
+            # Local tier: stale staging from killed attempts, uncommitted
+            # dirs, and over-retention leftovers — requeue loops must not
+            # fill node disk (ISSUE 5 satellite).
+            for name in sorted(os.listdir(self.local_dir)):
+                if not name.startswith(_STEP_PREFIX):
+                    continue
+                path = os.path.join(self.local_dir, name)
+                if not os.path.isdir(path):
+                    continue
+                if name.endswith(_STAGE_SUFFIX) or not os.path.exists(
+                    os.path.join(path, _META_FILE)
+                ):
+                    shutil.rmtree(path, ignore_errors=True)
+                    removed.append(f"local:{name}")
+            for step in self._committed_in(self.local_dir)[: -self.local_keep]:
+                shutil.rmtree(
+                    os.path.join(self.local_dir, f"{_STEP_PREFIX}{step}"),
+                    ignore_errors=True,
+                )
+                removed.append(f"local:{_STEP_PREFIX}{step}")
+        if removed:
+            obs.event(
+                "ckpt.gc", reclaimed=len(removed), dirs=sorted(removed)[:16]
+            )
 
     # ------------------------------------------------------------------ paths
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
 
-    def _read_meta(self, step: int) -> dict | None:
-        try:
-            with open(os.path.join(self._step_dir(step), _META_FILE)) as f:
-                return json.load(f)
-        except (OSError, json.JSONDecodeError):
+    def _local_step_dir(self, step: int) -> str | None:
+        if self.local_dir is None:
             return None
+        return os.path.join(self.local_dir, f"{_STEP_PREFIX}{step}")
 
-    def _all_steps(self) -> list[int]:
-        """Completed steps on disk (no wait — safe on the saver thread)."""
+    def _restore_tiers(self, step: int) -> list[tuple[str, str]]:
+        """(tier_name, step_dir) candidates for restoring ``step``, fastest
+        first: a committed local copy, then the persistent copy. The
+        restore ladder walks these in order, falling through on corruption
+        (``ckpt.corrupt`` per hop) before dropping to an earlier step."""
+        out = []
+        local = self._local_step_dir(step)
+        if local is not None and os.path.exists(os.path.join(local, _META_FILE)):
+            out.append(("local", local))
+        if os.path.exists(os.path.join(self._step_dir(step), _META_FILE)):
+            out.append(("persistent", self._step_dir(step)))
+        return out
+
+    def _committed_dir(self, step: int) -> str | None:
+        """Preferred committed dir for ``step`` (local tier first), or
+        None when the step is committed nowhere."""
+        tiers = self._restore_tiers(step)
+        return tiers[0][1] if tiers else None
+
+    @staticmethod
+    def _committed_in(root: str | None) -> list[int]:
+        """Committed step numbers under one tier root (sorted)."""
         steps = []
+        if root is None:
+            return steps
         try:
-            entries = os.listdir(self.directory)
+            entries = os.listdir(root)
         except FileNotFoundError:
-            return []
+            return steps
         for name in entries:
-            if name.startswith(_STEP_PREFIX):
+            if name.startswith(_STEP_PREFIX) and not name.endswith(_STAGE_SUFFIX):
                 try:
                     step = int(name[len(_STEP_PREFIX) :])
                 except ValueError:
                     continue
-                # Only completed saves count (state committed + metadata).
-                if os.path.exists(os.path.join(self.directory, name, _META_FILE)):
+                if os.path.exists(os.path.join(root, name, _META_FILE)):
                     steps.append(step)
+        return sorted(steps)
+
+    def _read_meta(self, step: int) -> dict | None:
+        for _tier, sd in self._restore_tiers(step):
+            try:
+                with open(os.path.join(sd, _META_FILE)) as f:
+                    return json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+        return None
+
+    def _all_steps(self) -> list[int]:
+        """Completed steps on disk — the union over tiers (an emergency
+        save may exist only locally until its upload; a requeued attempt
+        must still resume from it). No wait — safe on the saver thread."""
+        steps = set(self._committed_in(self.directory))
+        steps.update(self._committed_in(self.local_dir))
         return sorted(steps)
 
     def _best_step(self) -> int | None:
@@ -358,29 +477,69 @@ class CheckpointManager:
         ]
 
     # ------------------------------------------------------------------ save
-    def save(self, step: int, state, metrics: dict | None = None) -> Checkpoint:
+    def _drop_step_dir(self, step_dir: str) -> None:
+        """Make one persistent-tier step dir invisible (metadata first),
+        then recycle its payload pages (pool) or delete it."""
+        if not os.path.isdir(step_dir):
+            return
+        try:
+            os.unlink(os.path.join(step_dir, _META_FILE))
+        except OSError:
+            pass
+        if self._pool is not None:
+            self._pool.adopt_dir(step_dir)
+        else:
+            shutil.rmtree(step_dir, ignore_errors=True)
+
+    def save(
+        self,
+        step: int,
+        state,
+        metrics: dict | None = None,
+        *,
+        data_state: dict | None = None,
+        _upload: bool = True,
+    ) -> Checkpoint:
         """Asynchronously save ``state`` (a pytree) for ``step`` with metrics.
 
         ↔ the reference's per-epoch torch.save + report(metrics, checkpoint)
         (my_ray_module.py:178-205). Blocks only if the previous async save is
         still in flight.
+
+        Durability model (ISSUE 5): the whole save stages into
+        ``step_K.tmp`` and becomes visible via ONE atomic rename at commit
+        — no observer can ever see a committed-looking dir with a partial
+        payload, and a killed writer's staging is reclaimed by the next
+        manager's GC. With the local fast tier on (TPUFLOW_CKPT_LOCAL_DIR)
+        the save stages and commits *locally*, then uploads to the
+        persistent run dir off the training path (``ckpt.upload`` span).
+        Every shard/manifest/marker write runs through the retrying I/O
+        wrapper (raw.retry_io); a save whose retries exhaust fails THAT
+        step's save cleanly at the next drain (``ckpt.save_failed``) —
+        training continues from the previous committed step's durability.
+
+        ``data_state``: opaque loader-cursor dict (epoch, batch index,
+        shuffle seed) persisted in the step's metadata so resume replays
+        the epoch's remaining batches exactly (deterministic mid-epoch
+        resume).
         """
         self.wait_until_finished()
-        step_dir = self._step_dir(step)
-        state_dir = os.path.join(step_dir, _STATE_DIR)
+        final_dir = self._step_dir(step)
+        local_final = self._local_step_dir(step)
+        # With the local fast tier on, the save stages and COMMITS locally;
+        # the persistent copy appears via the async upload below.
+        commit_root = local_final if local_final is not None else final_dir
+        stage_dir = commit_root + _STAGE_SUFFIX
+        state_dir = os.path.join(stage_dir, _STATE_DIR)
 
         def _clean_stale() -> None:
-            # A retried step must first become invisible (stale metadata
-            # gone) before its old state is recycled and rewritten.
-            try:
-                os.unlink(os.path.join(step_dir, _META_FILE))
-            except FileNotFoundError:
-                pass
-            if os.path.exists(state_dir):
-                if self._pool is not None:
-                    self._pool.adopt_dir(state_dir)  # recycle a retried step
-                else:
-                    shutil.rmtree(state_dir)
+            # A retried step must first become invisible in EVERY tier
+            # (stale metadata gone) before its replacement is staged.
+            self._drop_step_dir(final_dir)
+            self._drop_step_dir(final_dir + _STAGE_SUFFIX)
+            if local_final is not None:
+                shutil.rmtree(local_final, ignore_errors=True)
+                shutil.rmtree(stage_dir, ignore_errors=True)
 
         if jax.process_count() > 1:
             # Shared-directory mutation is process 0's job, fenced so no
@@ -394,9 +553,10 @@ class CheckpointManager:
             multihost_utils.sync_global_devices("tpuflow_ckpt_save_prepped")
         else:
             _clean_stale()
-        os.makedirs(step_dir, exist_ok=True)
+        os.makedirs(stage_dir, exist_ok=True)
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
-        self._metrics_history.append({"step": step, **metrics})
+        hist_entry = {"step": step, **metrics}
+        self._metrics_history.append(hist_entry)
         meta = {
             "step": step,
             "metrics": metrics,
@@ -404,9 +564,25 @@ class CheckpointManager:
             "process_count": jax.process_count(),
             "device_count": jax.device_count(),
         }
+        if data_state is not None:
+            meta["data_state"] = dict(data_state)
         if self.save_dtype is not None:
             state = _downcast(state, self.save_dtype)
             meta["save_dtype"] = self.save_dtype
+
+        def _fail_cleanup() -> None:
+            # This save died on a classified storage error: the step never
+            # existed — drop its history entry and reclaim its staging.
+            try:
+                self._metrics_history.remove(hist_entry)
+            except ValueError:
+                pass
+            if jax.process_index() == 0:
+                self._drop_step_dir(final_dir + _STAGE_SUFFIX)
+                if local_final is not None:
+                    shutil.rmtree(stage_dir, ignore_errors=True)
+
+        self._pending_fail = (step, _fail_cleanup)
 
         # Telemetry: one ckpt.save span from save() entry to commit
         # (payload durable + step visible), carrying bytes and derived
@@ -419,24 +595,38 @@ class CheckpointManager:
         _obs_bytes = _addressable_nbytes(state) if _obs_rec is not None else 0
 
         def _commit(merge: bool = False) -> None:
-            # The step becomes visible (metadata.json present) only once its
-            # payload is fully on disk — ↔ Orbax's commit-marker semantics; a
-            # crash mid-write leaves an invisible directory — and only then
-            # is retention applied, so a crash never leaves fewer than
-            # ``max_to_keep`` complete checkpoints. Retired files land in the
-            # recycle pool in time for the *next* save to overwrite them.
+            # The step becomes visible only via the atomic stage→final
+            # rename below, strictly after its payload is fully on disk —
+            # ↔ Orbax's commit-marker semantics, hardened: a crash at ANY
+            # point before the rename leaves only an invisible ``.tmp``
+            # dir the next GC reclaims. Only then is retention applied, so
+            # a crash never leaves fewer than ``max_to_keep`` complete
+            # checkpoints.
+            from tpuflow.ckpt import raw as raw_fmt
+
             if jax.process_index() == 0:
                 if merge:
-                    from tpuflow.ckpt import raw as raw_fmt
-
                     raw_fmt.merge_manifests(state_dir)
-                # Atomic marker: a crash mid-dump must not leave a visible
-                # step with unreadable metadata.
-                tmp = os.path.join(step_dir, _META_FILE + ".tmp")
-                with open(tmp, "w") as f:
-                    json.dump(meta, f)
-                os.replace(tmp, os.path.join(step_dir, _META_FILE))
-            self._retain()
+                if os.environ.get("TPUFLOW_FAULT"):
+                    from tpuflow.testing import faults
+
+                    if faults.partial_commit():
+                        return  # simulated kill between payload and marker
+                # Marker written INSIDE the staging dir (atomically), then
+                # one rename publishes payload + metadata together.
+                marker = os.path.join(stage_dir, _META_FILE)
+
+                def write_marker() -> None:
+                    with open(marker + _STAGE_SUFFIX, "w") as f:
+                        json.dump(meta, f)
+                    os.replace(marker + _STAGE_SUFFIX, marker)
+
+                raw_fmt.retry_io(write_marker, op="write_meta", path=marker)
+                raw_fmt.retry_io(
+                    lambda: os.replace(stage_dir, commit_root),
+                    op="commit",
+                    path=commit_root,
+                )
             if _obs_rec is not None:
                 dur = time.monotonic() - _obs_t0
                 _obs_rec.record(
@@ -444,18 +634,28 @@ class CheckpointManager:
                     bytes=_obs_bytes,
                     gbps=_obs_bytes / dur / 1e9 if dur > 0 else 0.0,
                 )
+            if local_final is not None and jax.process_index() == 0:
+                if _upload:
+                    self._upload_step(step, local_final, final_dir)
+                self._local_retain()
+            self._retain()
 
+        # RecyclePool files live on the persistent filesystem; with the
+        # local tier staging on (typically) a different one, every take's
+        # cross-device rename would fail and strand the popped pool file —
+        # local-tier staging writes fresh pages instead.
+        save_pool = self._pool if local_final is None else None
         if self.format == "raw":
             if jax.process_count() > 1:
                 # Multi-host: every host writes its own shards; the commit
                 # needs an all-hosts barrier (a collective), which must run
                 # on the MAIN thread — it happens in wait_until_finished(),
                 # which the next save()/restore()/query drains through.
-                self._raw_saver.save(state_dir, state, pool=self._pool)
+                self._raw_saver.save(state_dir, state, pool=save_pool)
                 self._pending_commit = lambda: _commit(merge=True)
             else:
                 self._raw_saver.save(
-                    state_dir, state, pool=self._pool, on_commit=_commit
+                    state_dir, state, pool=save_pool, on_commit=_commit
                 )
         else:
             # StandardCheckpointer.save is async: the commit marker must not
@@ -469,14 +669,103 @@ class CheckpointManager:
             self._pending_commit = lambda: _commit(merge=False)
         if not self._async:
             self.wait_until_finished()
-        return Checkpoint(path=step_dir, metadata=meta)
+        if _upload or local_final is None:
+            handle_path, alts = final_dir, [local_final] if local_final else []
+        else:
+            handle_path, alts = local_final, [final_dir]
+        return Checkpoint(path=handle_path, metadata=meta, alt_paths=alts)
+
+    def _upload_step(self, step: int, src: str, dst: str) -> None:
+        """Copy a committed local-tier step to the persistent run dir — on
+        the saver thread (single-host) or at the deferred-commit drain
+        (multi-host), never on the training critical path. The copy lands
+        in ``dst.tmp`` and becomes visible via one atomic rename, so the
+        persistent tier keeps the staged-commit guarantee. An upload that
+        fails after retries leaves the step durable LOCALLY: recorded on
+        the ``ckpt.upload`` span (ok=False), never fatal."""
+        import errno as _errno
+
+        from tpuflow.ckpt import raw as raw_fmt
+
+        t0, ts0 = time.monotonic(), time.time()
+        tmp = dst + _STAGE_SUFFIX
+
+        def _copy() -> None:
+            if os.environ.get("TPUFLOW_FAULT"):
+                from tpuflow.testing import faults
+
+                faults.maybe_upload_stall()
+            shutil.rmtree(tmp, ignore_errors=True)
+            try:
+                shutil.copytree(src, tmp)
+            except shutil.Error as e:  # multi-file copytree wrapper
+                raise OSError(_errno.EIO, f"upload copy failed: {e}") from e
+            os.replace(tmp, dst)
+
+        err: str | None = None
+        try:
+            raw_fmt.retry_io(_copy, op="upload", path=dst)
+        except raw_fmt.CheckpointIOError as e:
+            err = str(e)[:300]
+        rec = obs.recorder()
+        if rec is not None:
+            nbytes = 0
+            try:
+                sd = os.path.join(src, _STATE_DIR)
+                if raw_fmt.is_raw(sd):
+                    nbytes = sum(raw_fmt.manifest_shard_sizes(sd))
+            except (OSError, ValueError, KeyError):
+                pass
+            dur = time.monotonic() - t0
+            attrs: dict[str, Any] = {"step": step, "bytes": nbytes, "ok": err is None}
+            if nbytes and dur > 0 and err is None:
+                attrs["gbps"] = nbytes / dur / 1e9
+            if err is not None:
+                attrs["error"] = err
+            rec.record("span", "ckpt.upload", ts=ts0, dur_s=dur, **attrs)
+
+    def emergency_save(
+        self,
+        step: int,
+        state,
+        metrics: dict | None = None,
+        *,
+        data_state: dict | None = None,
+    ) -> Checkpoint:
+        """Last-chance checkpoint for a closing termination-grace window.
+
+        Stages and commits SYNCHRONOUSLY on the fastest tier (local when
+        configured) and skips the persistent upload — a requeued attempt
+        on the same node resumes from this exact step instead of the last
+        periodic save; the persistent copy appears when that attempt's
+        next periodic save uploads normally. Records ``ckpt.emergency_save``
+        with the estimated grace remaining. Called by the train-loop drain
+        points when ``preempt.emergency_save_advised()``."""
+        from tpuflow.utils.preempt import grace_remaining_s
+
+        ckpt = self.save(
+            step, state, metrics or {}, data_state=data_state, _upload=False
+        )
+        self.wait_until_finished()
+        grace = grace_remaining_s()
+        obs.event(
+            "ckpt.emergency_save",
+            step=step,
+            tier="local" if self.local_dir else "persistent",
+            ok=self._committed_dir(step) is not None,
+            grace_s=round(grace, 3) if grace is not None else -1.0,
+        )
+        return ckpt
 
     def _retain(self) -> None:
         """Keep the newest ``max_to_keep`` steps plus the best step.
 
         Runs on the saver thread right after a save commits (saves are
         serialized by the wait in ``save()``, so every step seen here is
-        complete)."""
+        complete). The keep-set is computed over the tier UNION (a
+        local-only emergency step counts as newest), while deletion walks
+        only persistent-committed dirs — the local tier has its own
+        count-based retention (``_local_retain``)."""
         if self.max_to_keep is None or jax.process_index() != 0:
             return
         steps = self._all_steps()
@@ -484,7 +773,7 @@ class CheckpointManager:
         best = self._best_step()
         if best is not None:
             keep.add(best)
-        for s in steps:
+        for s in self._committed_in(self.directory):
             if s in keep:
                 continue
             if self._pool is not None:
@@ -492,9 +781,51 @@ class CheckpointManager:
             else:
                 shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
+    def _local_retain(self) -> None:
+        """Local fast tier: newest ``TPUFLOW_CKPT_LOCAL_KEEP`` committed
+        steps survive, oldest evicted first (plain deletes — the recycle
+        pool lives on the persistent filesystem, a cross-device rename
+        would copy). Bounds node-disk usage across requeue loops."""
+        if self.local_dir is None or jax.process_index() != 0:
+            return
+        for s in self._committed_in(self.local_dir)[: -self.local_keep]:
+            shutil.rmtree(
+                os.path.join(self.local_dir, f"{_STEP_PREFIX}{s}"),
+                ignore_errors=True,
+            )
+
+    def _save_failed(self, pending_fail, err: BaseException | None) -> None:
+        """One save died on a *classified* storage error (retry budget
+        exhausted or permanent errno): reclaim its staging, drop its
+        history entry, record ``ckpt.save_failed`` — and return control to
+        the loop. Losing one periodic checkpoint is recoverable (the
+        previous committed step still restores); killing the member over
+        it would cost the whole gang a requeue."""
+        step = None
+        if pending_fail is not None:
+            step, cleanup = pending_fail
+            try:
+                cleanup()
+            except OSError:
+                pass
+        obs.event(
+            "ckpt.save_failed",
+            step=step if step is not None else -1,
+            error=str(err)[:300] if err is not None else "peer host",
+        )
+        print(
+            f"[tpuflow] checkpoint save for step {step} failed after "
+            f"retries; training continues on the previous committed step: "
+            f"{err}"
+        )
+
     def wait_until_finished(self) -> None:
+        from tpuflow.ckpt import raw as raw_fmt
+
         pending = self._pending_commit
         self._pending_commit = None
+        pending_fail = self._pending_fail
+        self._pending_fail = None
         err: BaseException | None = None
         try:
             self._ckptr.wait_until_finished()
@@ -502,30 +833,46 @@ class CheckpointManager:
         except BaseException as e:
             # Never publish a step whose writes failed.
             err = e
+        # A CheckpointIOError is the retry wrapper's verdict: the storage
+        # layer failed for good on THIS save. That fails the step's save
+        # cleanly (ckpt.save_failed) instead of killing the member.
+        soft = isinstance(err, raw_fmt.CheckpointIOError)
         if pending is not None:
             if jax.process_count() > 1:
                 # Deferred multi-host commit. Before the commit barrier,
-                # exchange a per-host success bit so ONE host's failed write
-                # aborts the commit promptly and uniformly on ALL hosts —
-                # instead of peers hanging in the barrier until the
-                # collective timeout. (A fully dead peer still costs the
-                # collective timeout; nothing shorter exists.) SPMD contract:
-                # every process drains saves at the same program points
-                # (report/restore/queries).
+                # exchange a per-host verdict (1 = writes ok, 2 = this
+                # host's save died on a classified storage error — abort
+                # the commit uniformly but keep training everywhere, 0 =
+                # hard failure — raise everywhere) so ONE host's failed
+                # write aborts promptly instead of peers hanging in the
+                # barrier until the collective timeout. (A fully dead peer
+                # still costs the collective timeout; nothing shorter
+                # exists.) SPMD contract: every process drains saves at
+                # the same program points (report/restore/queries).
                 import numpy as _np
 
                 from jax.experimental import multihost_utils
 
-                ok = multihost_utils.process_allgather(
-                    _np.asarray(1 if err is None else 0, _np.int32)
+                codes = _np.asarray(
+                    multihost_utils.process_allgather(
+                        _np.asarray(
+                            1 if err is None else (2 if soft else 0),
+                            _np.int32,
+                        )
+                    )
                 )
-                if int(_np.min(ok)) == 0:
-                    if err is not None:
+                if (codes == 0).any():
+                    if err is not None and not soft:
                         raise err
                     raise RuntimeError(
                         "checkpoint shard write failed on a peer host; "
                         "commit aborted on all hosts"
                     )
+                if (codes == 2).any():
+                    # Same branch on every host (same codes): no commit,
+                    # no extra barrier needed — the allgather synchronized.
+                    self._save_failed(pending_fail, err)
+                    return
                 # All hosts' local writes succeeded; barrier so the merged
                 # manifest covers every host's shards.
                 multihost_utils.sync_global_devices("tpuflow_ckpt_commit")
@@ -534,9 +881,13 @@ class CheckpointManager:
                 # after a drain) until process 0 has written the merged
                 # manifest and the metadata marker.
                 multihost_utils.sync_global_devices("tpuflow_ckpt_committed")
+                return
             elif err is None:
                 pending()
         if err is not None:
+            if soft:
+                self._save_failed(pending_fail, err)
+                return
             raise err
 
     def close(self) -> None:
@@ -569,7 +920,10 @@ class CheckpointManager:
             chosen = self._best_step() if best else (steps[-1] if steps else None)
         else:
             chosen = step
-        if chosen is None or not os.path.isdir(self._step_dir(chosen)):
+        if chosen is None or (
+            self._committed_dir(chosen) is None
+            and not os.path.isdir(self._step_dir(chosen))
+        ):
             raise FileNotFoundError(
                 f"no checkpoint {'(best)' if best else ''} found in {self.directory}"
             )
@@ -595,53 +949,74 @@ class CheckpointManager:
         shard files (no read copy); see raw.restore_raw for the safety
         contract (read-only consumers of finished/owned runs).
 
-        Integrity: raw-format shards are crc32-verified as they are read
-        (``TPUFLOW_CKPT_VERIFY=0`` opts out). A corrupted step records a
-        ``ckpt.corrupt`` event and falls back to the newest earlier
-        committed step; with no earlier step the CorruptShardError
-        propagates — corrupted weights are never silently returned.
+        Integrity + tiers (ISSUE 5): raw-format shards are crc32-verified
+        as they are read (``TPUFLOW_CKPT_VERIFY=0`` opts out). The restore
+        walks a fallback ladder — crc-valid LOCAL copy (seconds after a
+        same-node requeue) → persistent copy → previous committed step —
+        recording ``ckpt.restore_tier`` for the tier that served and one
+        ``ckpt.corrupt`` per rejected hop; with nothing left the last
+        CorruptShardError propagates — corrupted weights are never
+        silently returned.
         """
         from tpuflow.ckpt import raw as raw_fmt
 
         chosen = self._resolve_step(step, best)
+        last_err: BaseException | None = None
         while True:
-            state_dir = os.path.join(self._step_dir(chosen), _STATE_DIR)
-            t0, ts0 = time.monotonic(), time.time()
-            try:
-                if raw_fmt.is_raw(state_dir):
-                    out = raw_fmt.restore_raw(
-                        state_dir,
-                        _abstractify(abstract_state)
-                        if abstract_state is not None
-                        else None,
-                        zero_copy=zero_copy,
+            for tier, sd in self._restore_tiers(chosen) or [
+                ("persistent", self._step_dir(chosen))
+            ]:
+                state_dir = os.path.join(sd, _STATE_DIR)
+                t0, ts0 = time.monotonic(), time.time()
+                try:
+                    if raw_fmt.is_raw(state_dir):
+                        out = raw_fmt.restore_raw(
+                            state_dir,
+                            _abstractify(abstract_state)
+                            if abstract_state is not None
+                            else None,
+                            zero_copy=zero_copy,
+                        )
+                    elif abstract_state is not None:
+                        out = self._ckptr.restore(
+                            state_dir, _abstractify(abstract_state)
+                        )
+                    else:
+                        out = self._ckptr.restore(state_dir)
+                except raw_fmt.CorruptShardError as e:
+                    last_err = e
+                    obs.event(
+                        "ckpt.corrupt", step=chosen, tier=tier,
+                        error=str(e)[:300],
                     )
-                elif abstract_state is not None:
-                    out = self._ckptr.restore(
-                        state_dir, _abstractify(abstract_state)
+                    print(
+                        f"[tpuflow] checkpoint step {chosen} corrupt on the "
+                        f"{tier} tier: {e}"
                     )
-                else:
-                    out = self._ckptr.restore(state_dir)
-            except raw_fmt.CorruptShardError as e:
-                obs.event("ckpt.corrupt", step=chosen, error=str(e)[:300])
-                prev = [s for s in self._all_steps() if s < chosen]
-                if not prev:
-                    raise
-                print(
-                    f"[tpuflow] checkpoint step {chosen} corrupt, falling "
-                    f"back to step {prev[-1]}: {e}"
+                    continue
+                obs.event("ckpt.restore_tier", step=chosen, tier=tier)
+                _record_restore(state_dir, t0, ts0, step=chosen)
+                return out
+            prev = [s for s in self._all_steps() if s < chosen]
+            if not prev:
+                if last_err is not None:
+                    raise last_err
+                raise FileNotFoundError(
+                    f"no restorable copy of step {chosen} in {self.directory}"
                 )
-                chosen = prev[-1]
-                continue
-            _record_restore(state_dir, t0, ts0, step=chosen)
-            return out
+            print(
+                f"[tpuflow] no valid copy of step {chosen}, falling back "
+                f"to previous committed step {prev[-1]}"
+            )
+            chosen = prev[-1]
 
     def verify_step(self, step: int | None = None, *, best: bool = False) -> bool:
         """Audit one step's shard files against the manifest crc32s.
 
         Reads every shard byte once and recomputes the checksums (an
         explicit integrity audit — e.g. before promoting a checkpoint or
-        after copying it across storage tiers). Records a ``ckpt.verify``
+        after copying it across storage tiers), on the tier a restore
+        would read first (local when present). Records a ``ckpt.verify``
         event with the outcome plus one ``ckpt.corrupt`` event per bad
         shard. Orbax-format steps and shards saved before integrity
         stamping verify vacuously. Returns True when every checked shard
@@ -649,14 +1024,14 @@ class CheckpointManager:
         from tpuflow.ckpt import raw as raw_fmt
 
         chosen = self._resolve_step(step, best)
-        checked, bad = raw_fmt.verify_dir(
-            os.path.join(self._step_dir(chosen), _STATE_DIR)
-        )
+        tiers = self._restore_tiers(chosen)
+        tier, sd = tiers[0] if tiers else ("persistent", self._step_dir(chosen))
+        checked, bad = raw_fmt.verify_dir(os.path.join(sd, _STATE_DIR))
         obs.event(
-            "ckpt.verify", step=chosen, shards=checked, ok=not bad
+            "ckpt.verify", step=chosen, shards=checked, ok=not bad, tier=tier
         )
         for fname in bad:
-            obs.event("ckpt.corrupt", step=chosen, file=fname)
+            obs.event("ckpt.corrupt", step=chosen, file=fname, tier=tier)
         return not bad
 
     def restore_metadata(self, step: int | None = None, *, best: bool = False) -> dict:
@@ -667,11 +1042,22 @@ class CheckpointManager:
         return meta
 
     def checkpoint(self, step: int | None = None, *, best: bool = False) -> Checkpoint:
-        """A flow-level handle to a saved step (path + metadata, no tensors)."""
+        """A flow-level handle to a saved step (path + metadata, no
+        tensors). The handle's primary path is the persistent copy (it may
+        cross runs/nodes); a committed local copy rides along as an
+        alternate path so same-node consumers restore from the fast tier
+        when the persistent dir is gone or lagging."""
         chosen = self._resolve_step(step, best)
-        return Checkpoint(
-            path=self._step_dir(chosen), metadata=self._read_meta(chosen) or {}
-        )
+        meta = self._read_meta(chosen) or {}
+        pers = self._step_dir(chosen)
+        local = self._local_step_dir(chosen)
+        alts = []
+        if local is not None and os.path.exists(os.path.join(local, _META_FILE)):
+            if os.path.exists(os.path.join(pers, _META_FILE)):
+                alts = [local]
+            else:
+                pers, alts = local, []
+        return Checkpoint(path=pers, metadata=meta, alt_paths=alts)
 
 
 def _record_restore(

@@ -27,12 +27,15 @@ policies.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
+import random
 import threading
+import time
 import weakref
 import zlib
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -48,6 +51,139 @@ class CorruptShardError(RuntimeError):
     truncation). Raised by restore-side verification so corrupted weights
     are never silently returned; the CheckpointManager catches it to fall
     back to the previous committed step."""
+
+
+class CheckpointIOError(OSError):
+    """A checkpoint storage operation failed for good: either a permanent
+    error (EACCES, EROFS, ...) or a transient one that survived the whole
+    retry budget (``retry_io``). The CheckpointManager treats a *save*
+    dying this way as that step's save failing cleanly — partial staging
+    reclaimed, ``ckpt.save_failed`` recorded, training continues — never
+    as a member death; restores let it propagate (with tier/step fallback
+    first)."""
+
+
+# Errnos worth retrying: the storage layer hiccuped but the operation may
+# well succeed on a fresh attempt (shared-filesystem brownouts, NFS/FUSE
+# timeouts, device congestion). ENOSPC/EDQUOT are deliberately transient
+# HERE: retention and the orphan GC free space between attempts, so "disk
+# full" during a save is frequently a passing state, not a verdict.
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "EIO", "EAGAIN", "EBUSY", "EINTR", "ETIMEDOUT", "ESTALE",
+        "ENOSPC", "EDQUOT", "ENETDOWN", "ENETUNREACH", "ENETRESET",
+        "ECONNRESET", "ECONNABORTED", "EREMOTEIO", "ENOLINK",
+    )
+    if hasattr(errno, name)
+)
+
+# Structural absence is a *semantic* outcome callers branch on (is this a
+# committed step? does the subtree exist?), not a storage failure — those
+# errors re-raise unchanged instead of being wrapped in CheckpointIOError.
+_STRUCTURAL_ERRNOS = frozenset({errno.ENOENT, errno.ENOTDIR, errno.EISDIR})
+
+
+def io_retries(default: int = 4) -> int:
+    """Transient-failure retry budget per storage operation
+    (``TPUFLOW_CKPT_IO_RETRIES``). 0 disables retrying; a malformed value
+    falls back to ``default`` (checkpointing must never die on a typo'd
+    env var mid-provisioning)."""
+    env = os.environ.get("TPUFLOW_CKPT_IO_RETRIES")
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return default
+
+
+def io_backoff_s(default: float = 0.05) -> float:
+    """Base backoff before the first retry (``TPUFLOW_CKPT_IO_BACKOFF_S``);
+    doubles per attempt with 50-100% jitter so a gang's writers don't
+    hammer a recovering filesystem in lockstep."""
+    env = os.environ.get("TPUFLOW_CKPT_IO_BACKOFF_S")
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            pass
+    return default
+
+
+def io_transient(e: OSError) -> bool:
+    """Transient-vs-permanent classification of one storage error. Errors
+    without an errno (wrapper layers, some FUSE stacks) count as transient
+    — retrying a permanent error wastes a bounded few attempts, while NOT
+    retrying a transient one fails a save that would have succeeded."""
+    return e.errno is None or e.errno in _TRANSIENT_ERRNOS
+
+
+def retry_io(
+    fn: Callable[[], Any],
+    *,
+    op: str,
+    path: str = "",
+    _sleep: Callable[[float], None] = time.sleep,
+):
+    """Run one storage operation with transient-error retries.
+
+    Every shard read/write, manifest dump, fsync-ing rename and upload
+    copy in the checkpoint fast path goes through here: transient
+    ``OSError``s (see ``io_transient``) are retried up to ``io_retries()``
+    times with jittered exponential backoff from ``io_backoff_s()``,
+    recording one ``ckpt.io_retry`` event per attempt; a permanent error
+    or an exhausted budget records ``ckpt.io_error`` and raises
+    :class:`CheckpointIOError` (structural absence — ENOENT and friends —
+    re-raises unchanged; ``CorruptShardError`` passes straight through:
+    integrity failures are never retried, re-reading corrupt bytes cannot
+    help). ``fn`` must be safe to re-run from scratch — every call site
+    rewrites its file from the start.
+    """
+    from tpuflow import obs
+
+    retries = io_retries()
+    backoff = io_backoff_s()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            if os.environ.get("TPUFLOW_FAULT"):
+                from tpuflow.testing import faults
+
+                faults.ckpt_io_fault(op, path)
+            return fn()
+        except CorruptShardError:
+            raise
+        except OSError as e:
+            if isinstance(e, CheckpointIOError):
+                raise  # a nested retry_io already classified + recorded it
+            name = os.path.basename(path.rstrip(os.sep)) if path else ""
+            if e.errno in _STRUCTURAL_ERRNOS:
+                raise
+            if not io_transient(e):
+                obs.event(
+                    "ckpt.io_error", op=op, path=name, errno=e.errno,
+                    attempts=attempt, transient=False, error=str(e)[:200],
+                )
+                raise CheckpointIOError(
+                    f"{op} {path or '<unknown>'}: permanent storage error: {e}"
+                ) from e
+            if attempt > retries:
+                obs.event(
+                    "ckpt.io_error", op=op, path=name, errno=e.errno,
+                    attempts=attempt, transient=True, error=str(e)[:200],
+                )
+                raise CheckpointIOError(
+                    f"{op} {path or '<unknown>'}: transient storage error "
+                    f"persisted through {attempt} attempts: {e}"
+                ) from e
+            delay = backoff * (2 ** (attempt - 1)) * (0.5 + 0.5 * random.random())
+            obs.event(
+                "ckpt.io_retry", op=op, path=name, attempt=attempt,
+                delay_s=round(delay, 4), error=str(e)[:200],
+            )
+            _sleep(delay)
 
 
 def _verify_enabled() -> bool:
@@ -345,21 +481,36 @@ class RecyclePool:
                 for s in sizes[from_i:]:
                     self._release_promise(s)
 
+        class _Cancelled(Exception):
+            pass
+
         for i, size in enumerate(sizes):
             if self._warm_cancel.is_set():
                 return abort(i, None)
             with self._lock:
                 self._counter += 1
                 path = os.path.join(self.directory, f"r{self._counter:08d}.bin")
-            try:
+
+            def write_warm_file() -> None:
+                # Restart-from-scratch on retry ("wb" truncates): a partial
+                # warm file must never enter the pool.
                 with open(path, "wb", buffering=0) as f:
                     written = 0
                     while written < size:
                         if self._warm_cancel.is_set():
-                            return abort(i, path)
+                            raise _Cancelled
                         f.write(buf[: min(chunk, size - written)])
                         written += min(chunk, size - written)
-            except OSError:
+
+            try:
+                # Through the retrying wrapper (ckpt.io_retry recorded):
+                # a transient ENOSPC — retention/GC free space between
+                # attempts — must not silently leave the warm file absent
+                # and re-expose the first save to cold page-backing.
+                retry_io(write_warm_file, op="prewarm", path=path)
+            except _Cancelled:
+                return abort(i, path)
+            except (CheckpointIOError, OSError):
                 return abort(i, path)
             with self._lock:
                 self._files.setdefault(size, []).append(path)
@@ -656,17 +807,19 @@ def _gather_host(tree):
 
 def _write_one(directory: str, fname: str, arr, pool: RecyclePool | None) -> None:
     dst = os.path.join(directory, fname)
-    recycled = pool.take(arr.nbytes) if pool is not None else None
-    written = False
-    if recycled is not None:
-        try:
-            os.rename(recycled, dst)
-            _native.write_bytes(dst, arr, inplace=True)
-            written = True
-        except OSError:
-            pass  # fall through to a fresh write
-    if not written:
+
+    def attempt() -> None:
+        recycled = pool.take(arr.nbytes) if pool is not None else None
+        if recycled is not None:
+            try:
+                os.rename(recycled, dst)
+                _native.write_bytes(dst, arr, inplace=True)
+                return
+            except OSError:
+                pass  # fall through to a fresh write
         _native.write_bytes(dst, arr)
+
+    retry_io(attempt, op="write_shard", path=dst)
     if os.environ.get("TPUFLOW_FAULT"):
         from tpuflow.testing import faults
 
@@ -754,12 +907,21 @@ def _write_entries(
                 fut.result()  # propagate the first write error
     if jax.process_count() > 1:
         frag = os.path.join(directory, f"manifest.p{jax.process_index():05d}.json")
-        with open(frag + ".tmp", "w") as f:
-            json.dump(manifest, f)
-        os.replace(frag + ".tmp", frag)
+
+        def write_frag() -> None:
+            with open(frag + ".tmp", "w") as f:
+                json.dump(manifest, f)
+            os.replace(frag + ".tmp", frag)
+
+        retry_io(write_frag, op="write_manifest", path=frag)
         return
-    with open(os.path.join(directory, MANIFEST), "w") as f:
-        json.dump(manifest, f)
+    unified = os.path.join(directory, MANIFEST)
+
+    def write_unified() -> None:
+        with open(unified, "w") as f:
+            json.dump(manifest, f)
+
+    retry_io(write_unified, op="write_manifest", path=unified)
 
 
 def merge_manifests(directory: str, *, visibility_timeout_s: float = 10.0) -> None:
@@ -805,11 +967,17 @@ def merge_manifests(directory: str, *, visibility_timeout_s: float = 10.0) -> No
             continue
         for entry, add in zip(merged["leaves"], frag["leaves"]):
             entry["shards"].extend(add["shards"])
-    with open(os.path.join(directory, MANIFEST + ".tmp"), "w") as f:
-        json.dump(merged, f)
-    os.replace(
-        os.path.join(directory, MANIFEST + ".tmp"),
-        os.path.join(directory, MANIFEST),
+
+    def write_merged() -> None:
+        with open(os.path.join(directory, MANIFEST + ".tmp"), "w") as f:
+            json.dump(merged, f)
+        os.replace(
+            os.path.join(directory, MANIFEST + ".tmp"),
+            os.path.join(directory, MANIFEST),
+        )
+
+    retry_io(
+        write_merged, op="write_manifest", path=os.path.join(directory, MANIFEST)
     )
 
 
@@ -936,8 +1104,13 @@ def is_raw(directory: str) -> bool:
 
 
 def _read_manifest(directory: str) -> dict:
-    with open(os.path.join(directory, MANIFEST)) as f:
-        m = json.load(f)
+    path = os.path.join(directory, MANIFEST)
+
+    def read() -> dict:
+        with open(path) as f:
+            return json.load(f)
+
+    m = retry_io(read, op="read_manifest", path=path)
     if m.get("format") != FORMAT_NAME:
         raise ValueError(f"{directory}: not a {FORMAT_NAME} checkpoint")
     return m
@@ -1023,7 +1196,11 @@ def _read_shard(
     # pre-backed buffer of this exact size is available (transient reads —
     # escapes=False, copied into a full-leaf buffer — must not consume them).
     out = _ARENA.take(nbytes) if escapes else None
-    buf = _native.read_bytes(path, nbytes, threads=threads, out=out)
+    buf = retry_io(
+        lambda: _native.read_bytes(path, nbytes, threads=threads, out=out),
+        op="read_shard",
+        path=path,
+    )
     if verify:
         _check_shard_bytes(path, shard, buf, nbytes)
     return buf.view(dtype).reshape(shard["shape"])

@@ -60,11 +60,35 @@ class ShardedLoader:
                 f"{self.num_shards} shards"
             )
         self._epoch = 0
+        self._skip_next = 0
 
     def set_epoch(self, epoch: int) -> None:
         """Reseed the shuffle for a new epoch (parity: sampler.set_epoch,
         reference my_ray_module.py:149-151)."""
         self._epoch = epoch
+
+    def skip_batches(self, n: int) -> None:
+        """Skip the first ``n`` batches of the NEXT iteration (one-shot).
+
+        Deterministic mid-epoch resume (ISSUE 5): the per-epoch
+        permutation is a pure function of (seed, epoch), so after a
+        restore whose checkpoint metadata recorded the loader cursor
+        (epoch, batches consumed, seed), skipping exactly the consumed
+        batches replays the epoch's REMAINDER bit-for-bit — no batch is
+        trained twice and none is dropped. The skip applies once: the
+        following epochs iterate from their head as usual.
+        """
+        self._skip_next = max(int(n), 0)
+
+    def state_dict(self, batches_consumed: int) -> dict:
+        """The loader cursor a checkpoint should persist for deterministic
+        mid-epoch resume: pair with ``set_epoch`` + ``skip_batches`` on
+        the restoring side (CheckpointManager.save(data_state=...))."""
+        return {
+            "epoch": int(self._epoch),
+            "batch_index": int(batches_consumed),
+            "seed": int(self.seed),
+        }
 
     def _indices(self) -> np.ndarray:
         n = len(self.split)
@@ -95,6 +119,12 @@ class ShardedLoader:
         if self.max_batches is not None:
             order = order[: self.max_batches * self.batch_size]
         bs = self.batch_size
+        skip, self._skip_next = self._skip_next, 0
+        if skip:
+            # Mid-epoch resume: drop exactly the already-consumed prefix;
+            # the permutation above is identical for the same (seed,
+            # epoch), so what remains is the epoch's exact tail.
+            order = order[skip * bs :]
         n_full = len(order) // bs
         for b in range(n_full):
             idx = order[b * bs : (b + 1) * bs]

@@ -162,11 +162,17 @@ def main(argv: list[str]) -> None:
         ):
             fn(flow)
     except Preempted as e:
-        # The loop already drained and committed its final checkpoint;
-        # exit with the requeue code — os._exit, because surviving this
-        # far with a possibly-dead peer means the shutdown barrier below
-        # could hang until the collective timeout.
-        print(f"[tpuflow] gang member preempted, requeueing: {e}")
+        # The loop already drained and committed its final checkpoint
+        # (full save, or the fast local-tier emergency save when the
+        # grace window was closing); exit with the requeue code —
+        # os._exit, because surviving this far with a possibly-dead peer
+        # means the shutdown barrier below could hang until the
+        # collective timeout.
+        from tpuflow.utils.preempt import grace_remaining_s
+
+        grace = grace_remaining_s()
+        spare = f" with {grace:.1f}s grace to spare" if grace is not None else ""
+        print(f"[tpuflow] gang member preempted, requeueing{spare}: {e}")
         obs.flush()
         sys.stdout.flush()
         os._exit(REQUEUE_EXIT_CODE)

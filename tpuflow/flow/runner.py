@@ -533,6 +533,17 @@ class FlowRunner:
                     TPUFLOW_ATTEMPT=str(attempt),
                     TPUFLOW_HEARTBEAT_FILE=hb_path,
                 )
+                if "TPUFLOW_PREEMPT_GRACE_S" not in env:
+                    # The supervisor SIGKILLs TPUFLOW_KILL_GRACE_S after
+                    # its SIGTERM — tell members their real termination
+                    # grace so the drain's emergency-save decision
+                    # (preempt.emergency_save_advised) counts down from
+                    # the budget that actually applies here. Deployed,
+                    # the pod spec sets TPUFLOW_PREEMPT_GRACE_S from
+                    # terminationGracePeriodSeconds instead.
+                    env["TPUFLOW_PREEMPT_GRACE_S"] = os.environ.get(
+                        "TPUFLOW_KILL_GRACE_S", "5"
+                    )
                 if getattr(self, "_obs_dir", None):
                     # Each member records its own events.p<i>.jsonl in the
                     # run's obs dir; the end-of-run merge unions them.

@@ -73,7 +73,48 @@ CATALOG: dict[str, tuple[str, str]] = {
     "ckpt.corrupt": (
         "event",
         "a shard failed crc32/truncation verification; restore fell back "
-        "to the previous committed step or raised — never silent",
+        "to the next tier / previous committed step or raised — never "
+        "silent",
+    ),
+    # Durable checkpointing under storage failure (ISSUE 5): retrying I/O,
+    # staged atomic commits + GC, the local fast tier, emergency saves.
+    "ckpt.io_retry": (
+        "event",
+        "one transient storage error absorbed by the retrying I/O wrapper "
+        "(op, path, attempt, jittered backoff slept)",
+    ),
+    "ckpt.io_error": (
+        "event",
+        "a storage operation failed for good: permanent errno or retry "
+        "budget exhausted (raises CheckpointIOError)",
+    ),
+    "ckpt.save_failed": (
+        "event",
+        "one step's save died on a classified storage error after "
+        "retries: staging reclaimed, history entry dropped, training "
+        "continues on the previous committed step — never a member death",
+    ),
+    "ckpt.gc": (
+        "event",
+        "manager startup reclaimed killed-writer leftovers: staged .tmp "
+        "dirs, uncommitted step dirs, stale local-tier staging/overflow",
+    ),
+    "ckpt.upload": (
+        "span",
+        "local fast tier → persistent run dir copy of one committed step "
+        "(async saver thread); ok=False means the step is durable locally "
+        "only",
+    ),
+    "ckpt.restore_tier": (
+        "event",
+        "which tier served a restore (local | persistent) for which step "
+        "— the fallback-ladder evidence trail",
+    ),
+    "ckpt.emergency_save": (
+        "event",
+        "last-chance synchronous commit on the fastest tier inside a "
+        "closing preemption-grace window (upload skipped); the requeued "
+        "attempt resumes from this step",
     ),
     # ---------------------------------------------------------------- data
     "data.batch_wait_s": ("histogram", "time the consumer blocked per batch"),

@@ -30,17 +30,35 @@ process. Grammar (one spec per entry)::
                                  written after the spec activates (silent
                                  storage corruption — caught by the
                                  per-shard crc32 on restore)
+    ckpt_io_flaky:p<n>           every distinct checkpoint I/O operation
+                                 (op+path) raises a transient EIO on its
+                                 first <n> attempts, succeeding after —
+                                 proves the retry_io backoff layer; with
+                                 <n> above the retry budget, proves the
+                                 save-failed-cleanly path
+    ckpt_partial_commit          single-shot: the next commit leaves its
+                                 staged step-<k>.tmp dir on disk and never
+                                 writes the marker (a writer killed
+                                 between payload and commit) — the next
+                                 manager's GC must reclaim it
+    upload_stall[:<seconds>]     sleep in the local→persistent upload of
+                                 the fast checkpoint tier (default 5 s) —
+                                 a slow shared filesystem the async saver
+                                 must absorb off the training path
 
 Hooks are threaded through gang exec (``maybe_rendezvous_delay``), the
 train loops (``step_boundary`` — called by ``TrainContext.report`` and
-the GPT epoch loops), the heartbeat stamp (``maybe_stall_heartbeat``) and
-the raw saver (``corrupt_after_write``). Every hook is a no-op costing
-one env lookup when ``TPUFLOW_FAULT`` is unset.
+the GPT epoch loops), the heartbeat stamp (``maybe_stall_heartbeat``),
+the raw saver (``corrupt_after_write``), the retrying I/O wrapper
+(``ckpt_io_fault``) and the manager's commit/upload path
+(``partial_commit`` / ``maybe_upload_stall``). Every hook is a no-op
+costing one env lookup when ``TPUFLOW_FAULT`` is unset.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import errno as _errno
 import os
 import sys
 import time
@@ -63,13 +81,18 @@ KINDS = (
     "rendezvous_delay",
     "ckpt_truncate",
     "ckpt_flip_byte",
+    "ckpt_io_flaky",
+    "ckpt_partial_commit",
+    "upload_stall",
 )
 
 # Parse cache keyed on the raw env string (tests flip the env between
 # cases in one process); fired-once bookkeeping for the single-shot
-# checkpoint corruptions.
+# checkpoint corruptions; per-(op,path) injected-failure counts for the
+# flaky-IO fault.
 _CACHE: tuple[str, list[Fault]] | None = None
 _FIRED: set[str] = set()
+_IO_FLAKY_COUNTS: dict[str, int] = {}
 
 
 def reset() -> None:
@@ -77,6 +100,7 @@ def reset() -> None:
     global _CACHE
     _CACHE = None
     _FIRED.clear()
+    _IO_FLAKY_COUNTS.clear()
 
 
 def parse(raw: str) -> list[Fault]:
@@ -106,6 +130,14 @@ def parse(raw: str) -> list[Fault]:
             secs_s, _, rank_s = payload.partition("@")
             value = float(secs_s)
             rank = int(rank_s) if rank_s else None
+        elif kind == "ckpt_io_flaky":
+            if not payload.startswith("p"):
+                raise ValueError(
+                    f"ckpt_io_flaky spec needs 'p<n>', got {entry!r}"
+                )
+            value = float(int(payload[1:]))
+        elif kind == "upload_stall":
+            value = float(payload) if payload else 5.0
         elif payload:
             raise ValueError(f"fault {kind} takes no payload, got {entry!r}")
         out.append(Fault(kind, rank=rank, step=step, value=value))
@@ -215,6 +247,56 @@ def maybe_stall_heartbeat() -> None:
             print("[faults] heartbeat_stall: hanging", file=sys.stderr)
             sys.stderr.flush()
             time.sleep(3600.0)
+
+
+def ckpt_io_fault(op: str, path: str) -> None:
+    """retry_io hook: with ``ckpt_io_flaky:p<n>`` active, every distinct
+    (op, path) pair raises a *transient* EIO on its first <n> attempts and
+    succeeds afterwards — deterministic, so tests can pin both "retries
+    absorb the blip" (<n> ≤ retry budget) and "the save fails cleanly"
+    (<n> > budget)."""
+    if not os.environ.get("TPUFLOW_FAULT"):
+        return
+    f = active("ckpt_io_flaky")
+    if f is None:
+        return
+    n = int(f.value or 0)
+    key = f"{op}:{path}"
+    fired = _IO_FLAKY_COUNTS.get(key, 0)
+    if fired < n:
+        _IO_FLAKY_COUNTS[key] = fired + 1
+        raise OSError(
+            _errno.EIO,
+            f"[faults] injected flaky IO ({fired + 1}/{n}) for {op}",
+            path,
+        )
+
+
+def partial_commit() -> bool:
+    """Commit hook: with ``ckpt_partial_commit`` active, return True ONCE
+    — the manager then leaves the staged ``.tmp`` dir in place without a
+    commit marker, emulating a writer killed between payload and commit."""
+    if not os.environ.get("TPUFLOW_FAULT"):
+        return False
+    if active("ckpt_partial_commit") is None or "ckpt_partial_commit" in _FIRED:
+        return False
+    _FIRED.add("ckpt_partial_commit")
+    print("[faults] ckpt_partial_commit: leaving staged dir", file=sys.stderr)
+    return True
+
+
+def maybe_upload_stall() -> None:
+    """Upload hook: with ``upload_stall[:s]`` active, sleep inside the
+    local→persistent copy — a slow shared filesystem the async saver must
+    absorb without stalling training."""
+    if not os.environ.get("TPUFLOW_FAULT"):
+        return
+    f = active("upload_stall")
+    if f is not None:
+        print(
+            f"[faults] upload_stall: sleeping {f.value}s", file=sys.stderr
+        )
+        time.sleep(f.value or 0.0)
 
 
 def corrupt_after_write(path: str) -> None:

@@ -27,9 +27,36 @@ from typing import Any
 
 from tpuflow.utils.preempt import (
     Preempted,
+    emergency_save_advised,
     launch_attempt,
     preemption_requested,
 )
+
+
+def _resume_cursor(
+    data_state: dict | None,
+    step: int,
+    steps_per_epoch: int,
+    epochs: int,
+    seed: int,
+) -> tuple[int, int]:
+    """(start_epoch, batches_to_skip) for a resume/rollback landing on
+    ``step``.
+
+    With a persisted loader cursor (ISSUE 5: checkpoint metadata
+    ``data_state``) whose shuffle seed matches, the resume lands
+    mid-epoch and replays exactly the epoch's unconsumed tail — no batch
+    trained twice, none dropped. Without one (pre-cursor checkpoints, or
+    a reseeded loader whose permutation no longer matches) fall back to
+    the epoch head the step floors to, as before."""
+    if data_state and int(data_state.get("seed", -1)) == int(seed):
+        epoch = min(int(data_state.get("epoch", 0)), epochs)
+        skip = max(int(data_state.get("batch_index", 0)), 0)
+        if skip >= steps_per_epoch:
+            # Drained exactly at the epoch boundary: next epoch, no skip.
+            return min(epoch + 1, epochs), 0
+        return epoch, skip
+    return min(step // steps_per_epoch, epochs), 0
 
 
 @dataclasses.dataclass
@@ -360,13 +387,21 @@ def _train_fsdp(
                     }
                 )
         start_epoch = 0
+        resume_skip = 0
         if resume_step is not None:
-            start_epoch = min(
-                int(state.step) // cfg.steps_per_epoch, cfg.epochs
+            start_epoch, resume_skip = _resume_cursor(
+                (mgr._read_meta(resume_step) or {}).get("data_state"),
+                int(state.step), cfg.steps_per_epoch, cfg.epochs,
+                loader.seed,
             )
             log(
                 f"[gpt] in-run resume from step {int(state.step)} "
                 f"→ epoch {start_epoch}"
+                + (
+                    f" (replaying from batch {resume_skip})"
+                    if resume_skip
+                    else ""
+                )
             )
         opt_step = int(state.step)
         # Telemetry (tpuflow.obs): per-step wall times + tokens ride the
@@ -456,8 +491,22 @@ def _train_fsdp(
             }
             if cfg.ema_decay > 0.0:
                 payload["ema_params"] = state.ema_params
-            mgr.save(opt_step, payload, metrics={})
-            mgr.wait_until_finished()
+            data_state = loader.state_dict(cursor["batch"])
+            if mgr.latest_step() != opt_step:
+                if emergency_save_advised():
+                    # Closing grace window (ISSUE 5): synchronous commit
+                    # on the fastest tier, upload skipped — the requeued
+                    # attempt resumes from THIS step, not the last
+                    # periodic save.
+                    mgr.emergency_save(
+                        opt_step, payload, data_state=data_state
+                    )
+                else:
+                    mgr.save(
+                        opt_step, payload, metrics={},
+                        data_state=data_state,
+                    )
+                    mgr.wait_until_finished()
             mgr.close()
             raise Preempted(f"drained checkpoint at step {opt_step}")
 
@@ -471,12 +520,21 @@ def _train_fsdp(
 
         clock = StepClock()
         cold = True
+        # Loader cursor for deterministic mid-epoch resume: epoch + batches
+        # consumed, persisted as checkpoint data_state and replayed by
+        # skip_batches on the restoring side.
+        pending_skip = resume_skip
+        cursor = {"batch": 0}
         while True:
             try:
                 for epoch in range(start_epoch, cfg.epochs):
                     t_epoch = time.monotonic()
                     ts_epoch = time.time()
                     loader.set_epoch(epoch)
+                    cursor["batch"] = pending_skip
+                    if pending_skip:
+                        loader.skip_batches(pending_skip)
+                        pending_skip = 0
                     losses = []
                     n_tokens = 0
                     clock.reset()
@@ -523,6 +581,7 @@ def _train_fsdp(
                                 (opt_step, metrics, tokens, True)
                             ):
                                 settle(entry)
+                        cursor["batch"] += 1
                         if profile is not None:
                             # Keep execution inside the trace window:
                             # effectively dispatch depth 1 while the
@@ -600,6 +659,13 @@ def _train_fsdp(
                             "train_loss": epoch_loss,
                             "ppl": ppl,
                         },
+                        # Epoch boundary: the next attempt resumes at the
+                        # next epoch's head.
+                        data_state={
+                            "epoch": epoch + 1,
+                            "batch_index": 0,
+                            "seed": loader.seed,
+                        },
                     )
                     if launch_attempt() > 0:
                         # Retried attempt: commit eagerly so this epoch is
@@ -650,8 +716,9 @@ def _train_fsdp(
                     ema_params=restored.get("ema_params", {}),
                 )
                 opt_step = int(state.step)
-                start_epoch = min(
-                    opt_step // cfg.steps_per_epoch, cfg.epochs
+                start_epoch, pending_skip = _resume_cursor(
+                    (mgr._read_meta(rb.target) or {}).get("data_state"),
+                    opt_step, cfg.steps_per_epoch, cfg.epochs, loader.seed,
                 )
                 # Rewind every history the replayed epochs will re-append
                 # to — the save-per-epoch invariant keeps them in step.
@@ -861,13 +928,20 @@ def _train_pipeline(
             ]
         global_step = start_step
         start_epoch = 0
+        resume_skip = 0
         if resume_step is not None:
-            start_epoch = min(
-                start_step // cfg.steps_per_epoch, cfg.epochs
+            start_epoch, resume_skip = _resume_cursor(
+                (mgr._read_meta(resume_step) or {}).get("data_state"),
+                start_step, cfg.steps_per_epoch, cfg.epochs, loader.seed,
             )
             log(
                 f"[gpt] pipeline in-run resume from step {start_step} "
                 f"→ epoch {start_epoch}"
+                + (
+                    f" (replaying from batch {resume_skip})"
+                    if resume_skip
+                    else ""
+                )
             )
         from tpuflow import obs
         from tpuflow.data.loader import prefetch_to_device
@@ -925,16 +999,25 @@ def _train_pipeline(
 
         def drain_preempt() -> None:
             drain_window()
-            mgr.save(
-                global_step,
-                {
-                    "step": jnp.int32(global_step),
-                    "params": params,
-                    "opt_state": opt_state,
-                },
-                metrics={},
-            )
-            mgr.wait_until_finished()
+            payload = {
+                "step": jnp.int32(global_step),
+                "params": params,
+                "opt_state": opt_state,
+            }
+            data_state = loader.state_dict(cursor["batch"])
+            if mgr.latest_step() != global_step:
+                if emergency_save_advised():
+                    # Closing grace window: fastest-tier commit, upload
+                    # skipped (see the FSDP leg's drain).
+                    mgr.emergency_save(
+                        global_step, payload, data_state=data_state
+                    )
+                else:
+                    mgr.save(
+                        global_step, payload, metrics={},
+                        data_state=data_state,
+                    )
+                    mgr.wait_until_finished()
             mgr.close()
             raise Preempted(f"drained checkpoint at step {global_step}")
 
@@ -946,10 +1029,16 @@ def _train_pipeline(
             }
 
         first = True
+        pending_skip = resume_skip
+        cursor = {"batch": 0}
         while True:
             try:
                 for epoch in range(start_epoch, cfg.epochs):
                     loader.set_epoch(epoch)
+                    cursor["batch"] = pending_skip
+                    if pending_skip:
+                        loader.skip_batches(pending_skip)
+                        pending_skip = 0
                     losses = []
                     clock.reset()
                     for batch in prefetch_to_device(
@@ -985,6 +1074,7 @@ def _train_pipeline(
                                 (global_step, loss, hstats, tokens, True)
                             ):
                                 settle(entry)
+                        cursor["batch"] += 1
                         if profile is not None:
                             drain_window()
                             profile.maybe_stop(global_step)
@@ -1010,6 +1100,11 @@ def _train_pipeline(
                             "opt_state": opt_state,
                         },
                         metrics={"val_loss": epoch_loss},
+                        data_state={
+                            "epoch": epoch + 1,
+                            "batch_index": 0,
+                            "seed": loader.seed,
+                        },
                     )
                     if launch_attempt() > 0:
                         # Retried attempt: eager commit for monotonic
@@ -1035,8 +1130,10 @@ def _train_pipeline(
                     restored["opt_state"], opt_shardings
                 )
                 global_step = int(restored["step"])
-                start_epoch = min(
-                    global_step // cfg.steps_per_epoch, cfg.epochs
+                start_epoch, pending_skip = _resume_cursor(
+                    (mgr._read_meta(rb.target) or {}).get("data_state"),
+                    global_step, cfg.steps_per_epoch, cfg.epochs,
+                    loader.seed,
                 )
                 mgr.rewind_history(rb.target)
                 history = history[:start_epoch]

@@ -34,6 +34,8 @@ from tpuflow.utils.preempt import (  # noqa: F401  (re-exported API)
     REQUEUE_EXIT_CODE,
     Preempted,
     clear_preemption,
+    emergency_save_advised,
+    grace_remaining_s,
     install_sigterm_handler,
     preemption_requested,
     request_preemption,

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 
 # BSD EX_TEMPFAIL: "try again later". Distinct from every exit code a crash
 # produces (Python exceptions → 1, signals → 128+N / negative), so the gang
@@ -50,11 +51,20 @@ def launch_attempt() -> int:
 
 
 _FLAG = threading.Event()
+# Monotonic timestamp of the FIRST preemption request this cycle: the
+# anchor the remaining-grace estimate counts down from. Guarded by the
+# GIL-atomicity of a single assignment (the signal handler may run on any
+# thread's behalf).
+_REQUESTED_AT: float | None = None
 
 
 def request_preemption(signum=None, frame=None) -> None:
     """Mark this process preempted (signal-handler compatible signature).
-    Checked by the train loops at step boundaries; idempotent."""
+    Checked by the train loops at step boundaries; idempotent — repeated
+    SIGTERMs keep the original grace anchor."""
+    global _REQUESTED_AT
+    if _REQUESTED_AT is None:
+        _REQUESTED_AT = time.monotonic()
     _FLAG.set()
 
 
@@ -63,7 +73,58 @@ def preemption_requested() -> bool:
 
 
 def clear_preemption() -> None:
+    global _REQUESTED_AT
+    _REQUESTED_AT = None
     _FLAG.clear()
+
+
+def grace_budget_s(default: float = 30.0) -> float:
+    """Total termination grace this process believes it has after SIGTERM,
+    in seconds (``TPUFLOW_PREEMPT_GRACE_S``). The gang launcher stamps it
+    from the supervisor's kill grace; deployed, it should mirror the pod's
+    ``terminationGracePeriodSeconds``. Malformed values fall back to
+    ``default``."""
+    import os
+
+    env = os.environ.get("TPUFLOW_PREEMPT_GRACE_S")
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            pass
+    return default
+
+
+def grace_remaining_s() -> float | None:
+    """Estimated termination grace still left, or None when no preemption
+    is in flight. Never negative — a drain that started late sees 0 and
+    must take the fastest path it has."""
+    anchor = _REQUESTED_AT
+    if anchor is None or not _FLAG.is_set():
+        return None
+    return max(0.0, grace_budget_s() - (time.monotonic() - anchor))
+
+
+def emergency_save_advised(threshold_default: float = 10.0) -> bool:
+    """True when a preemption is in flight and the estimated remaining
+    grace is under ``TPUFLOW_PREEMPT_EMERGENCY_S`` (default 10 s): drain
+    points should then write the fast local-tier emergency checkpoint
+    (``CheckpointManager.emergency_save`` — commit without the persistent
+    upload) instead of the full save, so the last steps of progress land
+    on *some* durable tier before the SIGKILL."""
+    import os
+
+    remaining = grace_remaining_s()
+    if remaining is None:
+        return False
+    env = os.environ.get("TPUFLOW_PREEMPT_EMERGENCY_S")
+    threshold = threshold_default
+    if env:
+        try:
+            threshold = float(env)
+        except ValueError:
+            pass
+    return remaining < threshold
 
 
 def install_sigterm_handler() -> bool:
