@@ -22,9 +22,14 @@ from tpuflow.dist import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(8)
 
+import json  # noqa: E402
+import time  # noqa: E402
+
 import jax  # noqa: E402
 
 import pytest  # noqa: E402
+
+_SESSION_T0: float | None = None
 
 
 @pytest.fixture(scope="session")
@@ -38,3 +43,34 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process/integration test"
     )
+
+
+def pytest_sessionstart(session):
+    global _SESSION_T0
+    _SESSION_T0 = time.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Record the session's wall time for the tier-1 duration guard
+    (tools/obs_lint.py): full 'not slow' sessions exceeding the guard
+    threshold fail the next obs_lint run, so slow-creep is caught before
+    CI's hard timeout starts killing the suite. Partial runs are recorded
+    too, but the guard only judges full-suite records (testscollected)."""
+    if _SESSION_T0 is None:
+        return
+    rec = {
+        "duration_s": round(time.monotonic() - _SESSION_T0, 1),
+        "markexpr": str(
+            getattr(session.config.option, "markexpr", "") or ""
+        ),
+        "testscollected": int(getattr(session, "testscollected", 0) or 0),
+        "recorded_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        with open(os.path.join(repo, ".tier1_duration.json"), "w") as f:
+            json.dump(rec, f)
+    except OSError:
+        pass

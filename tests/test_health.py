@@ -406,6 +406,17 @@ def test_chaos_nan_grad_rollback_continuous_history(tmp_path, monkeypatch):
     steps = [m["step"] for m in mgr._metrics_history]
     assert steps == sorted(set(steps)), f"duplicated steps {steps}"
     mgr.close()
+    # Goodput ledger (ISSUE 6): the replayed trajectory after the
+    # rollback is charged to the replay bucket, not the productive one —
+    # and the decomposition still sums to the measured wall.
+    from tpuflow.obs.goodput import compute_goodput
+
+    gp = compute_goodput(events)
+    assert gp["buckets"]["replay"] > 0, gp["buckets"]
+    assert gp["buckets"]["step"] > 0
+    assert sum(gp["buckets"].values()) == pytest.approx(
+        gp["wall_s"], rel=0.05
+    )
 
 
 def test_chaos_nan_grad_halts_when_rollback_disabled(tmp_path, monkeypatch):

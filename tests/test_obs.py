@@ -246,6 +246,14 @@ def test_disabled_overhead_unmeasurable_per_step(monkeypatch):
     # generator frame on the loader hot path).
     loader = [1, 2, 3]
     assert obs.timed_iter(loader, "data.batch_wait_s") is loader
+    # ISSUE 6 surfaces stay opt-in on the disabled path: no export server
+    # without the env knob, no flight artifact without a recorder.
+    monkeypatch.delenv("TPUFLOW_OBS_HTTP_PORT", raising=False)
+    from tpuflow.obs import export as obs_export
+    from tpuflow.obs import flight as flight_mod
+
+    assert obs_export.maybe_start_from_env(proc=0) is None
+    assert flight_mod.dump_flight("noop") is None
 
 
 # ------------------------------------------------------------ catalog lint
@@ -286,6 +294,12 @@ def test_obs_catalog_lint():
         ("event", "health.anomaly"),
         ("event", "health.rollback"),
         ("event", "health.profile"),
+        # Run observatory (ISSUE 6) with the right kinds.
+        ("gauge", "goodput.productive_s"),
+        ("gauge", "goodput.lost_s"),
+        ("gauge", "goodput.fraction"),
+        ("event", "obs.flight"),
+        ("event", "obs.export"),
         # Durable checkpointing (ISSUE 5) — the lint itself also enforces
         # these via REQUIRED_EMITTERS; asserting through both keeps the
         # standalone tool and the pytest twin honest about each other.
@@ -503,6 +517,299 @@ def test_flow_obs_disabled_by_env(tmp_path, monkeypatch):
     assert not os.path.exists(os.path.join(run_dir, "events.jsonl"))
     assert not os.path.exists(os.path.join(run_dir, "timeline.html"))
     assert store.read_run_meta(*pathspec.split("/"))["telemetry"] == {}
+
+
+# ------------------------------------------------- goodput ledger (ISSUE 6)
+def test_goodput_buckets_sum_to_wall_and_classify():
+    """The interval sweep charges every instant to exactly one bucket:
+    data waits are carved OUT of the step fence containing them, async
+    checkpoint saves charge only their exposed (non-overlapped) tail,
+    and the gap between attempt lanes is the requeue bucket — so the
+    buckets sum to the measured wall by construction."""
+    T = 1000.0
+    events = [
+        {"kind": "span", "name": "train.compile", "ts": T + 0.0,
+         "dur_s": 2.0, "proc": 0, "launch": 0},
+        {"kind": "histogram", "name": "train.step_s", "ts": T + 3.0,
+         "value": 1.0, "proc": 0, "launch": 0},
+        {"kind": "gauge", "name": "data.host_wait_s", "ts": T + 3.6,
+         "value": 0.4, "proc": 0, "launch": 0},
+        {"kind": "histogram", "name": "train.step_s", "ts": T + 4.0,
+         "value": 1.0, "proc": 0, "launch": 0},
+        # Async save overlapping the second step; only [4.0, 4.5] exposed.
+        {"kind": "span", "name": "ckpt.save", "ts": T + 3.5, "dur_s": 1.0,
+         "proc": 0, "launch": 0},
+        # Requeued attempt: restore then one more step, after a 2 s gap.
+        {"kind": "span", "name": "ckpt.restore", "ts": T + 6.5,
+         "dur_s": 0.5, "proc": 0, "launch": 1},
+        {"kind": "histogram", "name": "train.step_s", "ts": T + 8.0,
+         "value": 1.0, "proc": 0, "launch": 1},
+    ]
+    gp = obs.compute_goodput(events)
+    b = gp["buckets"]
+    assert gp["wall_s"] == pytest.approx(8.0)
+    assert b["compile"] == pytest.approx(2.0)
+    assert b["step"] == pytest.approx(2.6)       # 3.0 fenced − 0.4 wait
+    assert b["data_wait"] == pytest.approx(0.4)
+    assert b["ckpt"] == pytest.approx(0.5)       # exposed tail only
+    assert b["restore"] == pytest.approx(0.5)
+    assert b["requeue_gap"] == pytest.approx(2.0)
+    assert b["other"] == pytest.approx(0.0)
+    assert sum(b.values()) == pytest.approx(gp["wall_s"])
+    assert gp["fraction"] == pytest.approx(2.6 / 8.0)
+    assert gp["steps_timed"] == 3
+    assert [a["attempt"] for a in gp["attempts"]] == [0, 1]
+    assert gp["attempts"][1]["start_s"] == pytest.approx(6.5)
+    # And summarize embeds the same ledger + headline fraction.
+    s = obs.summarize(events)
+    assert s["goodput"]["buckets"]["requeue_gap"] == pytest.approx(2.0)
+    assert s["headline"]["goodput_fraction"] == pytest.approx(0.325)
+    assert s["headline"]["requeue_gap_s"] == pytest.approx(2.0)
+
+
+def test_goodput_replayed_steps_are_not_productive():
+    """After a health.rollback (from_step − step discarded steps), the
+    next that-many fenced steps re-cover old ground: charged to the
+    replay bucket, not the productive one."""
+    events = [
+        {"kind": "histogram", "name": "train.step_s", "ts": 1.0,
+         "value": 1.0, "proc": 0},
+        {"kind": "event", "name": "health.rollback", "ts": 1.5,
+         "step": 2, "from_step": 4, "proc": 0},
+        {"kind": "histogram", "name": "train.step_s", "ts": 3.0,
+         "value": 1.0, "proc": 0},
+        {"kind": "histogram", "name": "train.step_s", "ts": 4.0,
+         "value": 1.0, "proc": 0},
+        {"kind": "histogram", "name": "train.step_s", "ts": 5.0,
+         "value": 1.0, "proc": 0},
+    ]
+    gp = obs.compute_goodput(events)
+    assert gp["buckets"]["replay"] == pytest.approx(2.0)
+    assert gp["buckets"]["step"] == pytest.approx(2.0)
+    assert sum(gp["buckets"].values()) == pytest.approx(gp["wall_s"])
+
+
+def test_goodput_empty_and_partial_streams():
+    assert obs.compute_goodput([]) == {
+        "wall_s": 0.0, "fraction": 0.0,
+        "buckets": {b: 0.0 for b in obs.GOODPUT_BUCKETS},
+        "attempts": [], "steps_timed": 0,
+    }
+    # Events without usable timestamps are skipped, not fatal.
+    gp = obs.compute_goodput([{"kind": "event", "name": "x"}])
+    assert gp["wall_s"] == 0.0
+
+
+# --------------------------------------- live ledger + export (ISSUE 6)
+def test_live_ledger_and_metrics_endpoint(tmp_path):
+    """StepClock fences feed the in-process ledger; the export server
+    serves it as Prometheus text (/metrics) and JSON (/status) without
+    touching any file."""
+    import urllib.error
+    import urllib.request
+
+    from tpuflow.obs import export as obs_export
+    from tpuflow.obs import goodput
+    from tpuflow.train.step import StepClock
+
+    obs.configure(str(tmp_path / "obs"), proc=0)
+    clock = StepClock()  # resets the live ledger for "this leg"
+    goodput.live().set_model_flops_per_token(6.0 * 1000)
+    time.sleep(0.005)  # give the fences real (ms-scale) durations
+    clock.compile_done()
+    for i in range(3):
+        time.sleep(0.002)
+        clock.step_done(tokens=64, step=i + 1)
+    clock.health_done(
+        loss=1.25, grad_norm=0.5, update_norm=0.1, param_norm=2.0,
+        nonfinite=False,
+    )
+    snap = goodput.live().snapshot()
+    assert snap["steps"] == 3 and snap["step"] == 3
+    assert snap["tokens"] == 192
+    assert snap["compile_s"] > 0 and snap["productive_s"] > 0
+    assert 0.0 <= snap["goodput_fraction"] <= 1.0
+    srv = obs_export.MetricsServer(port=0)
+    try:
+        with urllib.request.urlopen(f"{srv.url}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "tpuflow_steps_total 3" in text
+        assert "tpuflow_tokens_total 192" in text
+        assert "tpuflow_goodput_fraction" in text
+        assert "tpuflow_loss 1.25" in text
+        assert "# TYPE tpuflow_steps_total counter" in text
+        with urllib.request.urlopen(f"{srv.url}/status", timeout=5) as r:
+            st = json.loads(r.read().decode())
+        assert st["steps"] == 3 and st["pid"] == os.getpid()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{srv.url}/nope", timeout=5)
+    finally:
+        srv.close()
+    # The periodic goodput gauges landed in the event stream (one at the
+    # compile fence at minimum).
+    obs.flush()
+    names = {e["name"] for e in obs.read_events(_events_file(str(tmp_path / "obs")))}
+    assert "goodput.productive_s" in names
+    assert "goodput.lost_s" in names and "goodput.fraction" in names
+
+
+def test_export_opt_in_member_zero_and_singleton(monkeypatch):
+    from tpuflow.obs import export as obs_export
+
+    monkeypatch.delenv("TPUFLOW_OBS_HTTP_PORT", raising=False)
+    assert obs_export.maybe_start_from_env(proc=0) is None  # opt-in only
+    monkeypatch.setenv("TPUFLOW_OBS_HTTP_PORT", "0")
+    assert obs_export.maybe_start_from_env(proc=1) is None  # member 0 only
+    srv = obs_export.maybe_start_from_env(proc=0)
+    try:
+        assert srv is not None and srv.port > 0
+        assert obs_export.maybe_start_from_env(proc=0) is srv  # idempotent
+    finally:
+        obs_export.stop()
+    monkeypatch.setenv("TPUFLOW_OBS_HTTP_PORT", "nope")
+    assert obs_export.maybe_start_from_env(proc=0) is None  # malformed
+
+
+# ------------------------------------------------ flight recorder (ISSUE 6)
+def test_flight_dump_ring_fingerprint_and_marker(tmp_path):
+    from tpuflow.obs import flight
+
+    d = str(tmp_path / "obs")
+    obs.configure(d, proc=3)
+    for i in range(300):
+        obs.counter("train.tokens", i)
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        path = flight.dump_flight("unhandled_exception", e)
+    assert path == flight.flight_path(d, 3)
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "unhandled_exception"
+    assert dump["proc"] == 3 and dump["pid"] == os.getpid()
+    assert "RuntimeError: boom" in dump["stack"]
+    # Bounded ring: 300 events recorded, the newest 256 kept.
+    assert len(dump["events"]) == 256
+    assert dump["events"][-1]["name"] == "train.tokens"
+    assert dump["events"][-1]["value"] == 299
+    assert any(k.startswith("TPUFLOW_") for k in dump["env"])
+    # The marker event landed in the stream, pointing at the artifact.
+    obs.flush()
+    events = obs.read_events(_events_file(d))
+    (marker,) = [e for e in events if e["name"] == "obs.flight"]
+    assert marker["path"] == path
+    # Re-dump overwrites atomically (newest wins).
+    assert flight.dump_flight("sigterm") == path
+    with open(path) as f:
+        assert json.load(f)["reason"] == "sigterm"
+
+
+def test_recorder_stamps_attempt_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_ATTEMPT", "2")
+    rec = obs.Recorder(str(tmp_path / "obs"), proc=1, flush_interval=60)
+    rec.record("counter", "train.tokens", value=1)
+    rec.close()
+    (ev,) = [
+        e for e in obs.read_events(rec.path) if e["name"] == "train.tokens"
+    ]
+    assert ev["launch"] == 2
+
+
+# ------------------------------------------------------ CLI (ISSUE 6)
+def test_obs_cli_summarize(tmp_path, capsys):
+    run_dir = str(tmp_path / "run")
+    rec = obs.Recorder(obs.obs_dir(run_dir), proc=0, flush_interval=60)
+    rec.record("span", "train.compile", ts=100.0, dur_s=1.0)
+    rec.record("histogram", "train.step_s", ts=102.0, value=0.5)
+    rec.record("histogram", "train.step_s", ts=103.0, value=0.5)
+    rec.record("counter", "train.tokens", ts=103.0, value=256)
+    rec.close()
+    from tpuflow.obs.__main__ import main as obs_main
+
+    assert obs_main(["summarize", run_dir, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["headline"]["steps_timed"] == 2
+    assert out["goodput"]["steps_timed"] == 2
+    assert out["goodput"]["buckets"]["step"] == pytest.approx(1.0)
+    assert out["goodput"]["buckets"]["compile"] == pytest.approx(1.0)
+    # Human-readable mode prints the decomposition.
+    assert obs_main(["summarize", run_dir]) == 0
+    text = capsys.readouterr().out
+    assert "goodput:" in text and "compile" in text
+    # Bad usage / empty runs exit non-zero with a message, not a trace.
+    assert obs_main([]) == 2
+    assert obs_main(["summarize", run_dir, "--bogus"]) == 2
+    assert obs_main(["summarize", str(tmp_path / "empty")]) == 1
+
+
+# ---------------------------------------- heartbeat step stamp (ISSUE 6)
+def test_heartbeat_stamps_step_and_supervisor_reads_it(
+    tmp_path, monkeypatch
+):
+    from tpuflow.flow.runner import FlowRunner
+    from tpuflow.utils import heartbeat
+
+    hb = tmp_path / "heartbeat_0"
+    monkeypatch.setenv("TPUFLOW_HEARTBEAT_FILE", str(hb))
+    heartbeat.beat(step=7)
+    assert hb.read_text() == "7"
+    before = os.path.getmtime(hb)
+    time.sleep(0.01)
+    heartbeat.beat()  # plain liveness stamp keeps the last step...
+    assert hb.read_text() == "7"
+    assert os.path.getmtime(hb) >= before  # ...but refreshes the mtime
+    assert FlowRunner._heartbeat_step(str(tmp_path), 0) == 7
+    assert FlowRunner._heartbeat_step(str(tmp_path), 1) is None  # absent
+    hb.write_text("")  # step-less legacy stamp → no step, no crash
+    assert FlowRunner._heartbeat_step(str(tmp_path), 0) is None
+
+
+# ------------------------------------- tier-1 duration guard (ISSUE 6)
+def test_tier1_duration_guard(tmp_path):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_lint_guard", os.path.join(repo, "tools", "obs_lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    path = tmp_path / mod.TIER1_DURATION_FILE
+
+    def write(rec):
+        path.write_text(json.dumps(rec))
+
+    assert mod.tier1_duration_guard(str(tmp_path)) is None  # no record
+    write({"duration_s": 700.0, "markexpr": "not slow",
+           "testscollected": 300})
+    assert mod.tier1_duration_guard(str(tmp_path)) is None  # under guard
+    write({"duration_s": 860.0, "markexpr": "not slow",
+           "testscollected": 300})
+    err = mod.tier1_duration_guard(str(tmp_path))
+    assert err and "860" in err and "820" in err
+    # The slow suite and partial runs are exempt — their durations say
+    # nothing about the tier-1 budget.
+    write({"duration_s": 9000.0, "markexpr": "slow",
+           "testscollected": 20})
+    assert mod.tier1_duration_guard(str(tmp_path)) is None
+    write({"duration_s": 9000.0, "markexpr": "not slow",
+           "testscollected": 5})
+    assert mod.tier1_duration_guard(str(tmp_path)) is None
+    path.write_text("not json{")  # torn record must not fail the lint
+    assert mod.tier1_duration_guard(str(tmp_path)) is None
+    # And the guard is wired into lint(): an over-budget record turns
+    # into a lint error on the real tree.
+    write({"duration_s": 860.0, "markexpr": "not slow",
+           "testscollected": 300})
+    # lint(root) reads the duration file from its root argument — point a
+    # fake root at tmp_path? lint also walks tpuflow/, so run the guard
+    # integration through the errors list of a real lint with the record
+    # injected beside the real repo is too invasive; the unit coverage
+    # above plus the call-site wiring (lint appends tier1_duration_guard)
+    # is pinned by reading the source.
+    import inspect
+
+    assert "tier1_duration_guard(root)" in inspect.getsource(mod.lint)
 
 
 def test_trainer_report_and_fit_events(tmp_path):

@@ -16,6 +16,7 @@ its pytest twin (tests/test_obs.py::test_obs_catalog_lint).
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import sys
@@ -66,7 +67,55 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("event", "ckpt.emergency_save"),
     ("event", "ckpt.verify"),
     ("event", "ckpt.corrupt"),
+    # Run observatory (ISSUE 6): the goodput-so-far gauges and the
+    # flight/export markers are runbook surfaces — deleting their
+    # emitters silently would orphan the goodput & live-monitoring
+    # runbook.
+    ("gauge", "goodput.productive_s"),
+    ("gauge", "goodput.lost_s"),
+    ("gauge", "goodput.fraction"),
+    ("event", "obs.flight"),
+    ("event", "obs.export"),
 )
+
+# Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records
+# every full 'not slow' session's wall time here; exceeding the guard
+# threshold fails this lint BEFORE the suite exceeds the hard CI budget
+# and starts getting killed by the timeout — the 50 s margin is the
+# early warning.
+TIER1_BUDGET_S = 870.0
+TIER1_GUARD_S = 820.0
+TIER1_DURATION_FILE = ".tier1_duration.json"
+# Records from partial runs (a handful of tests) say nothing about the
+# full suite; only judge sessions that collected most of it.
+_TIER1_MIN_TESTS = 100
+
+
+def tier1_duration_guard(root: str = REPO) -> str | None:
+    """Error string when the last recorded full tier-1 session exceeded
+    the duration guard, else None. Only full 'not slow' sessions are
+    judged; no record (fresh clone, CI cache wipe) passes vacuously."""
+    try:
+        with open(os.path.join(root, TIER1_DURATION_FILE)) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if rec.get("markexpr") != "not slow":
+        return None
+    try:
+        if int(rec.get("testscollected", 0)) < _TIER1_MIN_TESTS:
+            return None
+        dur = float(rec.get("duration_s", 0.0))
+    except (TypeError, ValueError):
+        return None
+    if dur > TIER1_GUARD_S:
+        return (
+            f"tier-1 suite recorded {dur:.0f}s, over the {TIER1_GUARD_S:.0f}s "
+            f"guard of the {TIER1_BUDGET_S:.0f}s budget — slow-mark the "
+            "newest long tests or speed the suite up before CI starts "
+            "timing out"
+        )
+    return None
 
 
 def dynamic_name_calls(src: str) -> list[str]:
@@ -140,6 +189,9 @@ def lint(root: str = REPO) -> tuple[list[str], list[str]]:
                 f"required emitter missing from tpuflow/: "
                 f"{required[1]!r} ({required[0]})"
             )
+    duration_err = tier1_duration_guard(root)
+    if duration_err:
+        errors.append(duration_err)
     warnings = [
         f"catalog name {name!r} has no literal emitter in tpuflow/"
         for name in sorted(set(CATALOG) - used)

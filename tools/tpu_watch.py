@@ -26,6 +26,13 @@ Run it in the background for a whole working session:
 Env knobs: TPU_WATCH_INTERVAL_S (probe cadence, default 45),
 TPU_WATCH_MAX_S (give up after, default 11h),
 TPU_WATCH_PROBE_TIMEOUT_S (per-probe hang bound, default 75).
+
+Follow mode (``--follow [url]``): instead of probing for evidence
+windows, poll a LIVE run's metrics endpoint (tpuflow.obs.export,
+opted in via TPUFLOW_OBS_HTTP_PORT on the run) and print one status
+line per poll — step, step rate, tokens/s, rolling MFU, goodput-so-far,
+last loss. The url defaults to 127.0.0.1:$TPUFLOW_OBS_HTTP_PORT;
+TPU_WATCH_FOLLOW_INTERVAL_S (default 5) sets the cadence.
 """
 
 from __future__ import annotations
@@ -175,6 +182,47 @@ def commit_evidence(note: str) -> None:
     ])
 
 
+def follow(url: str, interval: float, max_s: float) -> int:
+    """Poll ``<url>/status`` (the live export endpoint's JSON view) and
+    print one babysitter line per poll. Unreachable polls are reported
+    and retried — the endpoint appears when the gang's member 0 starts
+    training and vanishes across requeues, both routine mid-watch."""
+    import urllib.request
+
+    def fmt(st: dict, key: str, spec: str = "{:.3g}") -> str:
+        v = st.get(key)
+        return spec.format(v) if isinstance(v, (int, float)) else "-"
+
+    deadline = time.time() + max_s
+    while time.time() < deadline:
+        stamp = time.strftime("%H:%M:%S")
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + "/status", timeout=5
+            ) as r:
+                st = json.loads(r.read().decode())
+        except (OSError, ValueError) as e:
+            print(
+                f"[tpu_watch {stamp}] follow: {url} unreachable ({e}); "
+                f"retry in {interval:.0f}s",
+                flush=True,
+            )
+        else:
+            print(
+                f"[tpu_watch {stamp}] step={st.get('step', '-')} "
+                f"rate={fmt(st, 'step_rate')}/s "
+                f"tok/s={fmt(st, 'tokens_per_s', '{:.0f}')} "
+                f"mfu={fmt(st, 'mfu', '{:.4f}')} "
+                f"goodput={fmt(st, 'goodput_fraction', '{:.3f}')} "
+                f"loss={fmt(st, 'loss', '{:.4f}')} "
+                f"up={fmt(st, 'uptime_s', '{:.0f}')}s",
+                flush=True,
+            )
+        time.sleep(interval)
+    print("[tpu_watch] follow deadline reached", flush=True)
+    return 0
+
+
 def main() -> int:
     interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", "45"))
     probe_timeout = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "75"))
@@ -265,4 +313,20 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--follow" in sys.argv:
+        i = sys.argv.index("--follow")
+        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
+            follow_url = sys.argv[i + 1]
+        else:
+            follow_url = (
+                "http://127.0.0.1:"
+                f"{os.environ.get('TPUFLOW_OBS_HTTP_PORT', '8080')}"
+            )
+        sys.exit(
+            follow(
+                follow_url,
+                float(os.environ.get("TPU_WATCH_FOLLOW_INTERVAL_S", "5")),
+                float(os.environ.get("TPU_WATCH_MAX_S", str(11 * 3600))),
+            )
+        )
     sys.exit(main())

@@ -146,6 +146,47 @@ class _GanttBar:
         )
 
 
+# Goodput-bucket → color for the stacked goodput bar: productive green,
+# lost time in the warning/subsystem hues, residual near-background.
+_GOODPUT_COLORS = (
+    ("step", "#2e9960"),
+    ("replay", "#c2b33a"),
+    ("compile", "#2a78d6"),
+    ("restore", "#9268d4"),
+    ("data_wait", "#eb6834"),
+    ("ckpt", "#8a8782"),
+    ("requeue_gap", "#d05252"),
+    ("other", "#e5e4e0"),
+)
+
+
+class _GoodputBar:
+    """One 100%-stacked horizontal bar over the goodput buckets — the
+    run's wall-clock decomposition at a glance (hover a segment for the
+    bucket name + seconds)."""
+
+    def __init__(self, goodput: dict):
+        self.goodput = goodput
+
+    def _render(self) -> str:
+        wall = max(float(self.goodput.get("wall_s", 0.0)), 1e-9)
+        buckets = self.goodput.get("buckets", {})
+        cells = []
+        for bucket, color in _GOODPUT_COLORS:
+            v = float(buckets.get(bucket, 0.0))
+            if v <= 0:
+                continue
+            cells.append(
+                f"<div title='{bucket}: {v:.3f}s' "
+                f"style='width:{100.0 * v / wall:.2f}%;"
+                f"background:{color};height:16px'></div>"
+            )
+        return (
+            "<div style='display:flex;width:480px;height:16px;"
+            "background:#f1f0ec'>" + "".join(cells) + "</div>"
+        )
+
+
 # Span-name → bar color (categorical slots of the validated palette; one
 # hue per subsystem so the Gantt reads by layer).
 _TIMELINE_COLORS = {
@@ -252,6 +293,57 @@ def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> N
                         if k != "step"
                     ],
                     headers=["last gauge", "value"],
+                )
+            )
+
+    # Goodput ledger (ISSUE 6): the wall-clock decomposition + one lane
+    # per launch attempt, so a requeued run's card shows what each
+    # attempt cost and where the gaps were.
+    goodput = summary.get("goodput") or {}
+    if goodput.get("wall_s") and goodput.get("steps_timed"):
+        wall = goodput["wall_s"]
+        buf.append(Markdown("## Goodput"))
+        buf.append(
+            Markdown(
+                f"**{100.0 * goodput.get('fraction', 0.0):.1f}%** of "
+                f"{wall:.1f} s wall went to productive train steps."
+            )
+        )
+        buf.append(_GoodputBar(goodput))
+        buf.append(
+            Table(
+                [
+                    [
+                        bucket,
+                        f"{goodput['buckets'].get(bucket, 0.0):.3f}s",
+                        f"{100.0 * goodput['buckets'].get(bucket, 0.0) / wall:.1f}%",
+                    ]
+                    for bucket, _c in _GOODPUT_COLORS
+                    if goodput["buckets"].get(bucket)
+                ],
+                headers=["bucket", "seconds", "share"],
+            )
+        )
+        attempts = goodput.get("attempts") or []
+        if len(attempts) > 1:
+            buf.append(Markdown("## Attempt lanes"))
+            buf.append(
+                Table(
+                    [
+                        [
+                            f"attempt {a['attempt']}",
+                            " ".join(f"p{p}" for p in a.get("procs", [])),
+                            f"+{a['start_s']:.3f}s",
+                            f"{a['dur_s']:.3f}s",
+                            _GanttBar(
+                                100.0 * a["start_s"] / wall,
+                                100.0 * a["dur_s"] / wall,
+                                "#2a78d6",
+                            ),
+                        ]
+                        for a in attempts
+                    ],
+                    headers=["attempt", "procs", "start", "dur", ""],
                 )
             )
 

@@ -207,6 +207,17 @@ class Run:
 
         return obs.health_summary(self.events())
 
+    def goodput(self) -> dict:
+        """The run's goodput ledger (``obs.compute_goodput`` of the
+        merged stream): wall time decomposed into productive step seconds
+        vs compile / restore / data-wait / checkpoint / rollback-replay /
+        requeue-gap buckets, stitched across gang members and launch
+        attempts — the "what fraction of wall-clock actually trained,
+        and where did the rest go" answer the observatory exists for."""
+        from tpuflow import obs
+
+        return obs.compute_goodput(self.events())
+
 
 class Flow:
     """Handle to a flow's run history: ``Flow("TpuGptTrain")`` — the

@@ -91,13 +91,34 @@ def main(argv: list[str]) -> None:
     # the train loops check it at step boundaries, drain + commit a final
     # checkpoint, and raise Preempted — converted below into the requeue
     # exit code the supervisor treats as retry-without-budget.
+    import signal
+
     from tpuflow.utils.preempt import (
         REQUEUE_EXIT_CODE,
         Preempted,
-        install_sigterm_handler,
+        request_preemption,
     )
 
-    install_sigterm_handler()
+    def _on_sigterm(signum, frame):
+        # Flag first — the drain contract must hold even if forensics
+        # fail. Then dump the flight ring: this SIGTERM may be the
+        # supervisor's kill escalation (SIGKILL follows after the grace
+        # window, when no further code runs), so now is the only chance
+        # to leave a structured artifact; a clean preemption drain just
+        # gains one extra file. dump_flight is signal-safe (ring
+        # snapshot with a lock timeout) and never raises.
+        request_preemption(signum, frame)
+        try:
+            from tpuflow.obs import flight as _flight
+
+            _flight.dump_flight("sigterm")
+        except Exception:
+            pass
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread (library embedding)
+        pass
     from tpuflow.testing import faults
 
     faults.maybe_rendezvous_delay()
@@ -151,6 +172,11 @@ def main(argv: list[str]) -> None:
     # (set by FlowRunner._exec_gang), so each member writes its own
     # events.p<proc>.jsonl beside the head's — merged at end of run.
     from tpuflow import obs
+    from tpuflow.obs import export as obs_export
+
+    # Live metrics endpoint (ISSUE 6, opt-in TPUFLOW_OBS_HTTP_PORT):
+    # gang member 0 serves /metrics + /status for the whole gang.
+    obs_export.maybe_start_from_env(proc=jax.process_index())
 
     fn = flow_cls.steps()[step_name]
     try:
@@ -176,6 +202,17 @@ def main(argv: list[str]) -> None:
         obs.flush()
         sys.stdout.flush()
         os._exit(REQUEUE_EXIT_CODE)
+    except BaseException as e:
+        # Fatal path: this member is about to exit non-zero and the
+        # supervisor will record flow.member_failed — leave the
+        # structured forensic artifact (ring + env fingerprint + THIS
+        # stack) that the event references, then let the failure
+        # propagate unchanged.
+        from tpuflow.obs import flight as flight_mod
+
+        flight_mod.dump_flight("unhandled_exception", e)
+        obs.flush()
+        raise
     obs.flush()
 
     # Every member persists its own artifacts; the head's land at the gang

@@ -677,11 +677,21 @@ class FlowRunner:
                             failure = (
                                 "member_failed", i, f"member {i} exited {rc}"
                             )
-                            obs.event(
-                                "flow.member_failed", step=step_name,
-                                member=i, rc=rc,
-                                log_tail=self._log_tail(tdir, i),
-                            )
+                            attrs = {
+                                "step": step_name,
+                                "member": i,
+                                "rc": rc,
+                                "log_tail": self._log_tail(tdir, i),
+                            }
+                            # Crash forensics (ISSUE 6): the dying member
+                            # dumped its flight ring before exiting
+                            # (unhandled exception, SIGTERM, injected
+                            # death) — reference the structured artifact
+                            # beside the log tail.
+                            flight = self._member_flight(i)
+                            if flight:
+                                attrs["flight"] = flight
+                            obs.event("flow.member_failed", **attrs)
                 if failure is not None:
                     break
                 if stall_timeout and stall_timeout > 0:
@@ -704,14 +714,26 @@ class FlowRunner:
                             stalled.append((age, i))
                     if stalled:
                         age, culprit = max(stalled)
+                        # Heartbeats stamp the member's current step
+                        # (ISSUE 6 satellite): report WHERE it stalled,
+                        # not just how stale the stamp is.
+                        last_step = self._heartbeat_step(tdir, culprit)
+                        at = (
+                            f" at step {last_step}"
+                            if last_step is not None
+                            else ""
+                        )
                         failure = (
                             "heartbeat_stall", culprit,
                             f"member {culprit} heartbeat stalled "
-                            f"{age:.1f}s (> {stall_timeout:.0f}s)",
+                            f"{age:.1f}s (> {stall_timeout:.0f}s){at}",
                         )
                         obs.event(
                             "flow.heartbeat_stall", step=step_name,
                             member=culprit, age_s=round(age, 2),
+                            last_step=(
+                                last_step if last_step is not None else -1
+                            ),
                             log_tail=self._log_tail(tdir, culprit),
                         )
                         break
@@ -736,6 +758,30 @@ class FlowRunner:
                 return f.read()[-limit:]
         except OSError:
             return ""
+
+    def _member_flight(self, member: int) -> str | None:
+        """Path of the failed member's flight-recorder dump, if the
+        member managed to write one before dying (its crash handlers run
+        pre-exit, the supervisor polls post-exit — no race)."""
+        obs_dir = getattr(self, "_obs_dir", None)
+        if not obs_dir:
+            return None
+        from tpuflow.obs import flight as flight_mod
+
+        path = flight_mod.flight_path(obs_dir, member)
+        return path if os.path.exists(path) else None
+
+    @staticmethod
+    def _heartbeat_step(tdir: str, member: int) -> int | None:
+        """Last step number the member stamped into its heartbeat file
+        (``utils.heartbeat.beat(step=...)``), or None for a step-less /
+        absent stamp."""
+        try:
+            with open(os.path.join(tdir, f"heartbeat_{member}")) as f:
+                raw = f.read().strip()
+            return int(raw) if raw else None
+        except (OSError, ValueError):
+            return None
 
     @staticmethod
     def _kill_survivors(procs: list, rcs: list) -> None:

@@ -23,6 +23,17 @@ flush on a background thread (see ``recorder``).
 """
 
 from tpuflow.obs.catalog import CATALOG, is_registered, kind_of
+from tpuflow.obs.export import (
+    MetricsServer,
+    maybe_start_from_env as maybe_start_export,
+)
+from tpuflow.obs.flight import dump_flight, flight_path
+from tpuflow.obs.goodput import (
+    BUCKETS as GOODPUT_BUCKETS,
+    ProcessLedger,
+    compute_goodput,
+)
+from tpuflow.obs.goodput import live as goodput_live
 from tpuflow.obs.health import (
     Anomaly,
     HealthConfig,
@@ -55,22 +66,30 @@ from tpuflow.obs.timeline import (
 __all__ = [
     "Anomaly",
     "CATALOG",
+    "GOODPUT_BUCKETS",
     "HealthConfig",
     "HealthMonitor",
+    "MetricsServer",
+    "ProcessLedger",
     "ProfileWindow",
     "Recorder",
     "TrainingDiverged",
+    "compute_goodput",
     "configure",
     "counter",
+    "dump_flight",
     "enabled",
     "event",
+    "flight_path",
     "flush",
     "gauge",
+    "goodput_live",
     "health_summary",
     "histogram",
     "is_registered",
     "kind_of",
     "load_run_events",
+    "maybe_start_export",
     "merge_run_events",
     "obs_dir",
     "read_events",

@@ -1,0 +1,64 @@
+"""Operator CLI: ``python -m tpuflow.obs summarize <run_dir> [--json]``.
+
+Reads a run directory's merged telemetry (the committed ``events.jsonl``,
+or the per-process fragments of a still-running/crashed run) and prints
+the headline metrics plus the goodput ledger — no client API, no jax
+import, safe to point at a live run from a login shell. ``--json`` dumps
+the full ``obs.summarize`` structure for CI and scripts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from tpuflow.obs.goodput import BUCKETS
+from tpuflow.obs.timeline import load_run_events, summarize
+
+_USAGE = "usage: python -m tpuflow.obs summarize <run_dir> [--json]"
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("-")]
+    flags = {a for a in argv if a.startswith("-")}
+    if flags - {"--json"} or len(args) != 2 or args[0] != "summarize":
+        print(_USAGE, file=sys.stderr)
+        return 2
+    run_dir = args[1]
+    events = load_run_events(run_dir)
+    if not events:
+        print(f"no telemetry found under {run_dir}", file=sys.stderr)
+        return 1
+    s = summarize(events)
+    if "--json" in flags:
+        json.dump(s, sys.stdout, indent=2, sort_keys=True, default=str)
+        print()
+        return 0
+    print(f"events: {len(events)}")
+    headline = s.get("headline", {})
+    if headline:
+        print("headline:")
+        for k, v in sorted(headline.items()):
+            print(f"  {k}: {v:.6g}" if isinstance(v, float) else f"  {k}: {v}")
+    gp = s.get("goodput") or {}
+    wall = gp.get("wall_s", 0.0)
+    if wall:
+        print(
+            f"goodput: {100.0 * gp.get('fraction', 0.0):.1f}% of "
+            f"{wall:.1f}s wall"
+        )
+        for b in BUCKETS:
+            v = gp.get("buckets", {}).get(b, 0.0)
+            if v:
+                print(f"  {b}: {v:.3f}s ({100.0 * v / wall:.1f}%)")
+        for a in gp.get("attempts", []):
+            procs = ",".join(f"p{p}" for p in a.get("procs", []))
+            print(
+                f"  attempt {a['attempt']}: +{a['start_s']:.1f}s "
+                f"for {a['dur_s']:.1f}s [{procs}]"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

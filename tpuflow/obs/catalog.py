@@ -40,7 +40,9 @@ CATALOG: dict[str, tuple[str, str]] = {
     "flow.heartbeat_stall": (
         "event",
         "gang supervisor: a member's heartbeat went silent past the stall "
-        "timeout (member, age_s); the gang is killed",
+        "timeout (member, age_s, last_step — heartbeats stamp the current "
+        "step, so the report says WHERE the member stalled, not just how "
+        "long ago); the gang is killed",
     ),
     "flow.preempt": (
         "event",
@@ -173,11 +175,48 @@ CATALOG: dict[str, tuple[str, str]] = {
         "windowed jax.profiler capture committed (TPUFLOW_PROFILE="
         "start:stop): step window + trace directory",
     ),
+    # ------------------------------------------------------------- goodput
+    # Run observatory (ISSUE 6): goodput-so-far gauges emitted at the
+    # fences StepClock already pays. The authoritative per-run ledger —
+    # wall time decomposed into step/replay/compile/restore/data-wait/
+    # ckpt/requeue-gap buckets — is computed by ``obs.summarize`` (and
+    # ``Run.goodput()``) from the merged stream; these gauges are the
+    # incremental in-run view the live export endpoint serves.
+    "goodput.productive_s": (
+        "gauge",
+        "cumulative settled train-step seconds this process banked so "
+        "far (the ledger's productive bucket)",
+    ),
+    "goodput.lost_s": (
+        "gauge",
+        "process wall seconds so far NOT spent in settled train steps "
+        "(compile, restore, waits, gaps — decomposed precisely by the "
+        "summarize-time goodput ledger)",
+    ),
+    "goodput.fraction": (
+        "gauge",
+        "productive fraction of this process's wall time so far "
+        "(goodput-so-far; the run-level number comes from the merged "
+        "ledger)",
+    ),
     # ----------------------------------------------------------------- obs
     "obs.dropped": (
         "event",
         "telemetry events lost by this recorder (buffer overflow or a "
         "failed flush), surfaced once at close — never silently",
+    ),
+    "obs.flight": (
+        "event",
+        "a crash-forensics flight dump was written (reason + path): the "
+        "bounded ring of recent events, env/config fingerprint, and "
+        "faulting stack — referenced from the supervisor's "
+        "flow.member_failed event",
+    ),
+    "obs.export": (
+        "event",
+        "the live metrics endpoint started serving /metrics (Prometheus "
+        "text) + /status (JSON) on gang member 0 "
+        "(TPUFLOW_OBS_HTTP_PORT); carries the bound port",
     ),
     # ------------------------------------------------------------ warnings
     "warn.flash_min_seq_malformed": (

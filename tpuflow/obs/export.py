@@ -1,0 +1,179 @@
+"""Live metrics export: /metrics (Prometheus) + /status (JSON) mid-run.
+
+Opt-in via ``TPUFLOW_OBS_HTTP_PORT``: gang member 0 (or the training
+process itself, outside a gang) starts one daemon-threaded HTTP server
+serving the live goodput ledger (``tpuflow.obs.goodput.live()``) — step
+rate, tokens/s, rolling MFU from the model's FLOP estimate,
+goodput-so-far, and the last health gauges. Polling a file was never an
+option mid-run: the recorder buffers off the hot path and the merged
+``events.jsonl`` only exists after the run; this endpoint is how
+``tools/tpu_watch.py --follow`` (and a real Prometheus scraper) watch a
+run while it trains.
+
+Zero cost when off: without the env knob ``maybe_start_from_env`` is a
+single dict lookup; with it, the server runs entirely on its own daemon
+thread and reads only in-memory counters — nothing lands on the step
+path.
+
+Knobs: ``TPUFLOW_OBS_HTTP_PORT`` (0 = ephemeral, the chosen port is
+printed and recorded as an ``obs.export`` event), ``TPUFLOW_OBS_HTTP_HOST``
+(default 127.0.0.1 — bind 0.0.0.0 explicitly to let a remote scraper in).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpuflow.obs import goodput as _goodput
+from tpuflow.obs import recorder as _rec
+
+_SERVER: "MetricsServer | None" = None
+
+# (prometheus metric, snapshot key, prometheus type) — one stable,
+# documented mapping so dashboards don't chase snapshot-dict drift.
+_PROM_SPEC = (
+    ("tpuflow_uptime_seconds", "uptime_s", "gauge"),
+    ("tpuflow_steps_total", "steps", "counter"),
+    ("tpuflow_reports_total", "reports", "counter"),
+    ("tpuflow_step", "step", "gauge"),
+    ("tpuflow_tokens_total", "tokens", "counter"),
+    ("tpuflow_step_rate", "step_rate", "gauge"),
+    ("tpuflow_tokens_per_s", "tokens_per_s", "gauge"),
+    ("tpuflow_mfu", "mfu", "gauge"),
+    ("tpuflow_goodput_fraction", "goodput_fraction", "gauge"),
+    ("tpuflow_productive_seconds_total", "productive_s", "counter"),
+    ("tpuflow_compile_seconds_total", "compile_s", "counter"),
+    ("tpuflow_loss", "loss", "gauge"),
+    ("tpuflow_grad_norm", "grad_norm", "gauge"),
+    ("tpuflow_nonfinite_steps_total", "nonfinite_steps", "counter"),
+)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a ledger snapshot as Prometheus text exposition (0.0.4).
+    Keys absent from the snapshot (MFU off-TPU, rates before the second
+    fence) are omitted rather than invented."""
+    lines = []
+    for metric, key, ptype in _PROM_SPEC:
+        v = snapshot.get(key)
+        if not isinstance(v, (int, float)):
+            continue
+        lines.append(f"# TYPE {metric} {ptype}")
+        lines.append(f"{metric} {float(v):.10g}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (http.server API)
+        try:
+            route = self.path.split("?", 1)[0]
+            if route == "/metrics":
+                body = prometheus_text(_goodput.live().snapshot()).encode()
+                ctype = "text/plain; version=0.0.4"
+            elif route in ("/status", "/"):
+                snap = _goodput.live().snapshot()
+                snap["pid"] = os.getpid()
+                body = (json.dumps(snap) + "\n").encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-response
+
+    def log_message(self, fmt, *args):
+        pass  # scrapes must not spam the member's step log
+
+
+class MetricsServer:
+    """One daemon-threaded HTTP server over the live ledger."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            daemon=True,
+            name="tpuflow-obs-export",
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=2)
+
+
+def maybe_start_from_env(proc: int | None = None) -> MetricsServer | None:
+    """Start the export server when ``TPUFLOW_OBS_HTTP_PORT`` is set and
+    this is gang member 0 (``proc`` defaults from TPUFLOW_OBS_PROC /
+    TPUFLOW_PROCESS_ID; a non-gang training process is its own member 0).
+    Idempotent per process — the train legs and the gang bootstrap both
+    call it; the first caller wins, later calls return the same server.
+    A bind failure disables export with a printed warning, never the run.
+    """
+    global _SERVER
+    raw = os.environ.get("TPUFLOW_OBS_HTTP_PORT")
+    if not raw:
+        return None
+    if _SERVER is not None:
+        return _SERVER
+    try:
+        port = int(raw)
+    except ValueError:
+        print(
+            f"[tpuflow] malformed TPUFLOW_OBS_HTTP_PORT={raw!r} "
+            "(want an integer); live export disabled"
+        )
+        return None
+    if proc is None:
+        try:
+            proc = int(
+                os.environ.get("TPUFLOW_OBS_PROC")
+                or os.environ.get("TPUFLOW_PROCESS_ID")
+                or 0
+            )
+        except ValueError:
+            proc = 0
+    if proc != 0:
+        return None  # one endpoint per gang: member 0 owns it
+    host = os.environ.get("TPUFLOW_OBS_HTTP_HOST", "127.0.0.1")
+    try:
+        _SERVER = MetricsServer(port, host=host)
+    except OSError as e:
+        print(
+            f"[tpuflow] obs export failed to bind {host}:{port} "
+            f"({e}); live export disabled"
+        )
+        return None
+    _rec.event("obs.export", port=_SERVER.port)
+    print(
+        f"[tpuflow] obs export serving /metrics + /status on {_SERVER.url}"
+    )
+    return _SERVER
+
+
+def stop() -> None:
+    """Tear the process's export server down (tests; the daemon thread
+    otherwise dies with the process)."""
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.close()
+        _SERVER = None

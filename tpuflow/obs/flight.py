@@ -1,0 +1,96 @@
+"""Crash-forensics flight recorder.
+
+A gang member that dies fatally — an unhandled exception, the
+supervisor's SIGTERM→SIGKILL escalation, an injected
+``faults.step_boundary`` death — used to leave only a log tail behind.
+Every recorder-enabled process now keeps a bounded ring of its last N
+events (``Recorder._ring``, ``TPUFLOW_OBS_FLIGHT_RING``, default 256);
+``dump_flight`` snapshots that ring plus the process's env/config
+fingerprint and the faulting stack into ``<obs_dir>/flight/p<proc>.json``
+— a structured artifact the gang supervisor references from its
+``flow.member_failed`` event, so triage starts from WHAT the member was
+doing, not from grepping its log.
+
+Signal-safety: the SIGTERM hook calls ``dump_flight`` from a signal
+handler, which may have interrupted a frame holding the recorder's
+buffer lock. The ring snapshot therefore tries the lock with a timeout
+and degrades to a best-effort lockless copy; the locked recorder APIs
+(the ``obs.flight`` marker event + flush) run only when the lock was
+provably free. The dump itself never raises — forensics must not turn a
+dying process's exit path into a second failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+from tpuflow.obs import recorder as _rec
+
+FLIGHT_SUBDIR = "flight"
+# The config surface worth fingerprinting: every knob that changes what
+# the process was doing when it died. Values are truncated — fingerprint,
+# not dump.
+_ENV_PREFIXES = ("TPUFLOW_", "JAX_", "XLA_")
+
+
+def flight_path(obs_dir: str, proc: int) -> str:
+    """Where process ``proc``'s flight dump lands under ``obs_dir`` —
+    shared with the supervisor, which looks the artifact up by member
+    index when it records the failure."""
+    return os.path.join(obs_dir, FLIGHT_SUBDIR, f"p{int(proc):05d}.json")
+
+
+def _fingerprint() -> dict[str, str]:
+    return {
+        k: v[:200]
+        for k, v in sorted(os.environ.items())
+        if k.startswith(_ENV_PREFIXES)
+    }
+
+
+def dump_flight(reason: str, exc: BaseException | None = None) -> str | None:
+    """Write this process's flight dump; returns its path, or None when
+    telemetry is disabled (no recorder → no ring, and no obs dir to land
+    the artifact in) or the write itself failed. Atomic (tmp + rename):
+    a reader never sees a torn dump; repeated dumps keep the newest."""
+    rec = _rec.recorder()
+    if rec is None:
+        return None
+    try:
+        if exc is not None:
+            stack = "".join(
+                traceback.format_exception(type(exc), exc, exc.__traceback__)
+            )
+        else:
+            stack = "".join(traceback.format_stack())
+        ring, lock_was_free = rec._ring_snapshot()
+        payload = {
+            "reason": reason,
+            "ts": time.time(),
+            "proc": rec.proc,
+            "pid": os.getpid(),
+            "attempt": rec.attempt,
+            "argv": list(sys.argv),
+            "env": _fingerprint(),
+            "stack": stack[-8000:],
+            "dropped_events": rec.dropped,
+            "events": ring,
+        }
+        path = flight_path(rec.directory, rec.proc)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=_rec._jsonable)
+        os.replace(tmp, path)
+        if lock_was_free:
+            # Safe to touch the locked recorder API: the interrupted
+            # frame (if any) did not hold the buffer lock.
+            _rec.event("obs.flight", reason=reason, path=path)
+            _rec.flush()
+        return path
+    except Exception:
+        return None

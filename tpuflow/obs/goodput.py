@@ -1,0 +1,416 @@
+"""Goodput ledger: where did the run's wall-clock go?
+
+tpuflow now survives requeues, rollbacks, and emergency saves (ISSUEs
+2-5) — which makes "what fraction of wall time actually trained" the
+operator's first question, and one no single event can answer. This
+module stitches the merged telemetry stream (``tpuflow.obs.timeline``)
+across gang members, attempts, and requeues into ONE per-run accounting:
+
+- ``compute_goodput(events)`` — the authoritative, event-derived ledger.
+  Wall time (first event → last event) is decomposed into labeled
+  buckets by an interval sweep: every instant is charged to exactly one
+  bucket, so the buckets sum to the measured wall by construction
+  (residual time lands in ``other``, never vanishes).
+
+- ``ProcessLedger`` / ``live()`` — the incremental, in-process view fed
+  by the fences ``StepClock`` already pays (no new synchronization):
+  cumulative productive seconds, rolling step/token rates, rolling MFU
+  from the model's FLOP estimate, goodput-so-far. This is what the live
+  export endpoint (``tpuflow.obs.export``) serves mid-run.
+
+Bucket semantics (highest sweep priority first):
+
+- ``requeue_gap`` — wall time between one launch attempt's last event
+  and the next attempt's first (process teardown, backoff, relaunch,
+  re-rendezvous). Attempts are identified by the ``launch`` field the
+  recorder stamps from ``TPUFLOW_ATTEMPT`` into every gang-member event
+  (a dedicated key: the head's ``flow.step`` span carries its own
+  ``attempt`` attribute spanning ALL launches, which must not collapse
+  the lanes).
+- ``compile``     — ``train.compile`` spans (cold jit trace + compile).
+- ``restore``     — ``ckpt.restore`` spans.
+- ``data_wait``   — consumer-visible input stalls (``data.host_wait_s``
+  gauges / ``data.batch_wait_s`` observations). Carved OUT of the step
+  interval that contains them: a step that blocked on input was not
+  fully productive.
+- ``replay``      — steps re-executed after a divergence rollback
+  (``health.rollback`` carries ``from_step``−``step`` = the count of
+  discarded steps; the next that-many step observations from that
+  process re-cover old ground).
+- ``step``        — settled ``train.step_s`` fences: the productive
+  bucket, the numerator of the goodput fraction.
+- ``ckpt``        — the EXPOSED (non-overlapped) part of ``ckpt.save`` /
+  ``ckpt.upload`` spans. Async saves that fully hide behind training
+  charge nothing here — that is the point of the async saver.
+- ``other``       — everything else (setup, validation, host overhead).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Iterable
+
+from tpuflow.obs import recorder as _rec
+
+# Sweep priority, highest first: when labeled intervals overlap, each
+# instant of wall time is charged to the highest-priority label covering
+# it — so a data wait inside a step fence reduces the step bucket, while
+# an async checkpoint save hiding under compute charges nothing.
+_PRIORITY = (
+    "requeue_gap",
+    "compile",
+    "restore",
+    "data_wait",
+    "replay",
+    "step",
+    "ckpt",
+)
+BUCKETS: tuple[str, ...] = _PRIORITY + ("other",)
+
+
+def compute_goodput(events: Iterable[dict]) -> dict[str, Any]:
+    """Fold a (merged) event stream into the per-run goodput ledger.
+
+    Returns::
+
+        {"wall_s": float,          # first event → last event
+         "fraction": float,        # buckets["step"] / wall_s
+         "buckets": {bucket: seconds, ...},   # sums exactly to wall_s
+         "attempts": [{"attempt", "start_s", "dur_s", "procs"}, ...],
+         "steps_timed": int}
+
+    Tolerant of partial streams (a still-running or crashed run): any
+    event without a usable timestamp is skipped, unknown names are
+    ignored, and an empty stream yields an all-zero ledger.
+    """
+    evs = sorted(
+        (e for e in events if isinstance(e.get("ts"), (int, float))),
+        key=lambda e: (e.get("ts", 0.0), e.get("proc", 0)),
+    )
+    intervals: list[tuple[float, float, str]] = []
+    pending_replay: dict[int, int] = {}
+    lanes: dict[int, list] = {}  # attempt -> [start, end, procs]
+    steps_timed = 0
+    t_lo: float | None = None
+    t_hi: float | None = None
+
+    for ev in evs:
+        ts = float(ev["ts"])
+        kind = ev.get("kind")
+        name = ev.get("name")
+        try:
+            proc = int(ev.get("proc", 0) or 0)
+        except (TypeError, ValueError):
+            proc = 0
+        try:
+            dur = max(float(ev.get("dur_s", 0.0) or 0.0), 0.0)
+        except (TypeError, ValueError):
+            dur = 0.0
+        end = ts + dur
+        t_lo = ts if t_lo is None else min(t_lo, ts)
+        t_hi = end if t_hi is None else max(t_hi, end)
+
+        launch = ev.get("launch")
+        if launch is not None:
+            try:
+                a = int(launch)
+            except (TypeError, ValueError):
+                a = None
+            if a is not None:
+                lane = lanes.setdefault(a, [ts, end, set()])
+                lane[0] = min(lane[0], ts)
+                lane[1] = max(lane[1], end)
+                lane[2].add(proc)
+
+        if kind == "histogram" and name == "train.step_s":
+            try:
+                v = max(float(ev.get("value", 0.0) or 0.0), 0.0)
+            except (TypeError, ValueError):
+                v = 0.0
+            label = "step"
+            if pending_replay.get(proc, 0) > 0:
+                pending_replay[proc] -= 1
+                label = "replay"
+            # The observation is recorded AT the fence; the interval it
+            # measures ends there.
+            intervals.append((ts - v, ts, label))
+            t_lo = min(t_lo, ts - v)
+            steps_timed += 1
+        elif kind == "span" and name == "train.compile":
+            intervals.append((ts, end, "compile"))
+        elif kind == "span" and name == "ckpt.restore":
+            intervals.append((ts, end, "restore"))
+        elif kind == "span" and name in ("ckpt.save", "ckpt.upload"):
+            intervals.append((ts, end, "ckpt"))
+        elif name in ("data.host_wait_s", "data.batch_wait_s") and kind in (
+            "gauge",
+            "histogram",
+        ):
+            try:
+                v = max(float(ev.get("value", 0.0) or 0.0), 0.0)
+            except (TypeError, ValueError):
+                v = 0.0
+            if v > 0.0:
+                intervals.append((ts - v, ts, "data_wait"))
+                t_lo = min(t_lo, ts - v)
+        elif kind == "event" and name == "health.rollback":
+            try:
+                replayed = int(ev.get("from_step", 0) or 0) - int(
+                    ev.get("step", 0) or 0
+                )
+            except (TypeError, ValueError):
+                replayed = 0
+            if replayed > 0:
+                pending_replay[proc] = pending_replay.get(proc, 0) + replayed
+
+    empty = {
+        "wall_s": 0.0,
+        "fraction": 0.0,
+        "buckets": {b: 0.0 for b in BUCKETS},
+        "attempts": [],
+        "steps_timed": 0,
+    }
+    if t_lo is None or t_hi is None or t_hi <= t_lo:
+        return empty
+
+    # Inter-attempt requeue gaps: uncovered wall between one attempt
+    # lane's envelope end and the next lane's start.
+    ordered = sorted(lanes.items(), key=lambda kv: kv[1][0])
+    attempts_out = [
+        {
+            "attempt": a,
+            "start_s": round(lane[0] - t_lo, 6),
+            "dur_s": round(lane[1] - lane[0], 6),
+            "procs": sorted(lane[2]),
+        }
+        for a, lane in ordered
+    ]
+    for (_a0, l0), (_a1, l1) in zip(ordered, ordered[1:]):
+        if l1[0] > l0[1]:
+            intervals.append((l0[1], l1[0], "requeue_gap"))
+
+    # Priority sweep: charge each elementary segment of [t_lo, t_hi] to
+    # the highest-priority label active over it; uncovered time → other.
+    marks: list[tuple[float, int, str]] = []
+    for s, e, label in intervals:
+        s, e = max(s, t_lo), min(e, t_hi)
+        if e > s:
+            marks.append((s, 0, label))
+            marks.append((e, 1, label))
+    marks.sort(key=lambda m: (m[0], m[1]))
+    buckets = {b: 0.0 for b in BUCKETS}
+    active = {label: 0 for label in _PRIORITY}
+    prev = t_lo
+    for t, closing, label in marks:
+        seg = t - prev
+        if seg > 0:
+            for b in _PRIORITY:
+                if active[b] > 0:
+                    buckets[b] += seg
+                    break
+            else:
+                buckets["other"] += seg
+            prev = t
+        active[label] += -1 if closing else 1
+    tail = t_hi - prev
+    if tail > 0:
+        # No marks can be open past the last close; residual is other.
+        buckets["other"] += tail
+
+    wall = t_hi - t_lo
+    return {
+        "wall_s": wall,
+        "fraction": buckets["step"] / wall if wall > 0 else 0.0,
+        "buckets": buckets,
+        "attempts": attempts_out,
+        "steps_timed": steps_timed,
+    }
+
+
+# --------------------------------------------------------- live ledger
+# bf16 peak FLOP/s per chip for the rolling-MFU gauge, matched (in
+# order) against jax.devices()[0].device_kind — the same table bench.py
+# uses for its one-shot MFU leg, duplicated here because runtime code
+# must not import the benchmark harness.
+_PEAK_FLOPS = (
+    ("v6 lite", 918e12),
+    ("v6lite", 918e12),
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),
+    ("v5lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+)
+_UNSET = object()
+_PEAK_CACHE: Any = _UNSET
+
+
+def _peak_flops_per_device() -> float | None:
+    """bf16 peak FLOP/s of the local accelerator, or None off-TPU (the
+    rolling MFU is then omitted rather than invented)."""
+    global _PEAK_CACHE
+    if _PEAK_CACHE is not _UNSET:
+        return _PEAK_CACHE
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            _PEAK_CACHE = None
+        else:
+            kind = dev.device_kind.lower()
+            _PEAK_CACHE = next(
+                (v for k, v in _PEAK_FLOPS if k in kind), 197e12
+            )
+    except Exception:
+        _PEAK_CACHE = None
+    return _PEAK_CACHE
+
+
+class ProcessLedger:
+    """Incremental per-process goodput accounting, fed at the fences the
+    hot loop already pays (``StepClock``) and by ``TrainContext.report``.
+    The live export endpoint serves ``snapshot()``; the authoritative
+    run-level numbers come from ``compute_goodput`` over the merged
+    stream — this object exists so ``/metrics`` can answer mid-run
+    without re-reading any file."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        """Restart the accounting (called at train-leg start)."""
+        self._t0 = time.monotonic()
+        self.started_ts = time.time()
+        self.steps = 0
+        self.tokens = 0
+        self.reports = 0
+        self.step = 0
+        self.productive_s = 0.0
+        self.compile_s = 0.0
+        self.flops_per_token: float | None = None
+        self.health: dict[str, float] = {}
+        self.nonfinite_steps = 0
+        # (monotonic, cumulative steps+reports, cumulative tokens) marks
+        # for the rolling rates: the window spans the last 128 fences.
+        self._recent: collections.deque = collections.deque(maxlen=128)
+        self._mark()
+
+    def _mark(self) -> None:
+        self._recent.append(
+            (time.monotonic(), self.steps + self.reports, self.tokens)
+        )
+
+    def set_model_flops_per_token(self, flops: float | None) -> None:
+        """The model's FLOP/token estimate (dense transformer: 6·N) —
+        the numerator of the rolling MFU gauge."""
+        self.flops_per_token = float(flops) if flops else None
+
+    def note_compile(self, dur_s: float) -> None:
+        self.compile_s += max(float(dur_s), 0.0)
+        self._mark()
+
+    def note_step(
+        self, dur_s: float, tokens: int = 0, step: int | None = None
+    ) -> None:
+        self.steps += 1
+        self.tokens += int(tokens)
+        self.productive_s += max(float(dur_s), 0.0)
+        if step is not None:
+            try:
+                self.step = int(step)
+            except (TypeError, ValueError):
+                pass
+        self._mark()
+
+    def note_report(self, step: int, loss: float | None = None) -> None:
+        """A ``TrainContext.report`` fence (custom Trainer loops have no
+        StepClock; the report cadence is their liveness signal)."""
+        self.reports += 1
+        try:
+            self.step = max(self.step, int(step))
+        except (TypeError, ValueError):
+            pass
+        if isinstance(loss, (int, float)):
+            self.health["loss"] = float(loss)
+        self._mark()
+
+    def note_health(
+        self, loss: float, grad_norm: float, nonfinite: bool
+    ) -> None:
+        self.health["loss"] = float(loss)
+        self.health["grad_norm"] = float(grad_norm)
+        if nonfinite:
+            self.nonfinite_steps += 1
+
+    def snapshot(self) -> dict[str, Any]:
+        """Point-in-time view for the export endpoint. Rolling rates come
+        from the recent-fence window; MFU only when both the model FLOP
+        estimate and the chip's peak are known."""
+        now = time.monotonic()
+        wall = max(now - self._t0, 1e-9)
+        step_rate = tokens_per_s = None
+        if len(self._recent) >= 2:
+            t_a, n_a, tok_a = self._recent[0]
+            t_b, n_b, tok_b = self._recent[-1]
+            dt = t_b - t_a
+            if dt > 0:
+                step_rate = (n_b - n_a) / dt
+                tokens_per_s = (tok_b - tok_a) / dt
+        mfu = None
+        peak = _peak_flops_per_device()
+        if self.flops_per_token and tokens_per_s and peak:
+            try:
+                import jax
+
+                ndev = max(jax.device_count(), 1)
+            except Exception:
+                ndev = 1
+            mfu = self.flops_per_token * tokens_per_s / (peak * ndev)
+        out: dict[str, Any] = {
+            "uptime_s": round(wall, 3),
+            "started_ts": self.started_ts,
+            "steps": self.steps,
+            "reports": self.reports,
+            "step": self.step,
+            "tokens": self.tokens,
+            "productive_s": round(self.productive_s, 4),
+            "compile_s": round(self.compile_s, 4),
+            "goodput_fraction": round(self.productive_s / wall, 4),
+            "nonfinite_steps": self.nonfinite_steps,
+        }
+        if step_rate is not None:
+            out["step_rate"] = round(step_rate, 4)
+            out["tokens_per_s"] = round(tokens_per_s, 2)
+        if mfu is not None:
+            out["mfu"] = round(mfu, 4)
+        if self.flops_per_token:
+            out["flops_per_token"] = self.flops_per_token
+        for k, v in self.health.items():
+            out[k] = v
+        return out
+
+
+_LEDGER = ProcessLedger()
+
+
+def live() -> ProcessLedger:
+    """This process's live goodput ledger (one per process, reset at
+    train-leg start)."""
+    return _LEDGER
+
+
+def emit_gauges() -> None:
+    """Record the goodput-so-far gauges into the event stream (called at
+    epoch fences and every ~32 steps by ``StepClock``; no-ops when
+    telemetry is disabled — the gauge calls check that themselves)."""
+    led = _LEDGER
+    wall = max(time.monotonic() - led._t0, 1e-9)
+    _rec.gauge("goodput.productive_s", round(led.productive_s, 4))
+    _rec.gauge(
+        "goodput.lost_s", round(max(wall - led.productive_s, 0.0), 4)
+    )
+    _rec.gauge("goodput.fraction", round(led.productive_s / wall, 4))

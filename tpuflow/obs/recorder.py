@@ -22,13 +22,24 @@ Event schema (one JSON object per line)::
     {"kind": "span|counter|gauge|histogram|event",
      "name": "<catalog name>", "ts": <wall-clock start, s>,
      "proc": <gang process index>, "pid": <os pid>,
+     "launch": <launch attempt, gang members only (TPUFLOW_ATTEMPT)>,
      "dur_s": <monotonic duration, spans only>,
      "value": <counter/gauge/histogram payload>, ...attrs}
+
+The ``launch`` stamp (a dedicated key — several flow events already
+carry their own ``attempt`` attribute, which must not be confused with
+the process's launch number) is what lets the goodput ledger
+(``tpuflow.obs.goodput``) stitch a requeued gang's attempts into one
+per-run accounting with an explicit inter-attempt requeue-gap bucket.
+The recorder also keeps a bounded ring of the most recent events — the
+flight recorder's (``tpuflow.obs.flight``) forensic payload when the
+process dies on a fatal path.
 """
 
 from __future__ import annotations
 
 import atexit
+import collections
 import json
 import os
 import threading
@@ -93,6 +104,11 @@ class _Span:
 # final ``obs.dropped`` event at close, so loss is visible, never silent.
 _DEFAULT_MAX_BUFFERED = 65536
 
+# Flight-recorder ring: the last N events kept in memory regardless of
+# flush/drop state, snapshotted into obs/flight/<proc>.json on fatal
+# paths (tpuflow.obs.flight). Small on purpose — forensics, not history.
+_DEFAULT_FLIGHT_RING = 256
+
 
 class Recorder:
     """Buffered JSONL event writer for one process."""
@@ -125,6 +141,25 @@ class Recorder:
                 max_buffered = _DEFAULT_MAX_BUFFERED
         self._max_buffered = max(1, max_buffered)
         self.dropped = 0  # events lost to overflow or failed flushes
+        # Launch attempt (gang members only): stamped into every event so
+        # the goodput ledger can stitch requeued attempts into one run.
+        self.attempt: int | None = None
+        env_attempt = os.environ.get("TPUFLOW_ATTEMPT")
+        if env_attempt:
+            try:
+                self.attempt = int(env_attempt)
+            except ValueError:
+                pass
+        try:
+            ring = int(
+                os.environ.get("TPUFLOW_OBS_FLIGHT_RING", "")
+                or _DEFAULT_FLIGHT_RING
+            )
+        except ValueError:
+            ring = _DEFAULT_FLIGHT_RING
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(0, ring)
+        )
         self._lock = threading.Lock()
         self._closed = False
         self._flush_interval = flush_interval
@@ -144,9 +179,15 @@ class Recorder:
             "pid": os.getpid(),
             **attrs,
         }
+        if self.attempt is not None:
+            ev.setdefault("launch", self.attempt)
         with self._lock:
             if self._closed:
                 return
+            # The flight ring sees every event — including ones the
+            # bounded buffer is about to drop: the newest events are
+            # exactly what a post-mortem needs.
+            self._ring.append(ev)
             if len(self._buf) >= self._max_buffered:
                 # Telemetry must never fail (or bloat) the run: beyond the
                 # cap events are counted and dropped, surfaced at close.
@@ -156,6 +197,25 @@ class Recorder:
 
     def span(self, name: str, **attrs) -> _Span:
         return _Span(self, name, attrs)
+
+    def _ring_snapshot(
+        self, timeout: float = 0.25
+    ) -> tuple[list[dict], bool]:
+        """Copy of the flight ring, signal-handler safe: tries the buffer
+        lock with a timeout (the interrupted frame may hold it) and falls
+        back to a best-effort lockless copy. Returns ``(events,
+        lock_was_free)`` — when the lock could not be acquired, callers
+        must not touch any locked recorder API (a signal handler doing so
+        would deadlock against the frame it interrupted)."""
+        got = self._lock.acquire(timeout=timeout)
+        try:
+            try:
+                return list(self._ring), got
+            except RuntimeError:  # mutated during the lockless iteration
+                return [], got
+        finally:
+            if got:
+                self._lock.release()
 
     # -------------------------------------------------------------- flush
     def _drain(self) -> None:

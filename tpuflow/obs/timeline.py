@@ -99,6 +99,7 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
          "gauges": {name: {last, max}},
          "histograms": {name: {count, mean, p50, max, total}},
          "health": {...},     # anomalies/rollbacks/profiles/last numerics
+         "goodput": {...},    # wall decomposed into labeled buckets
          "headline": {...}}   # step time, tokens/s, ckpt GB/s, data wait
     """
     events = list(events)
@@ -189,11 +190,25 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
         headline["health_rollbacks"] = len(health["rollbacks"])
     if health["dropped_events"]:
         headline["obs_dropped_events"] = health["dropped_events"]
+
+    # Goodput ledger (ISSUE 6): the finalize-time accounting — wall time
+    # decomposed into productive steps vs compile/restore/data-wait/ckpt/
+    # replay/requeue-gap, stitched across gang members and attempts.
+    from tpuflow.obs.goodput import compute_goodput
+
+    goodput = compute_goodput(events)
+    if goodput["steps_timed"]:
+        headline["goodput_fraction"] = round(goodput["fraction"], 4)
+    if goodput["buckets"].get("requeue_gap"):
+        headline["requeue_gap_s"] = round(
+            goodput["buckets"]["requeue_gap"], 3
+        )
     return {
         "spans": spans,
         "counters": counters,
         "gauges": gauges,
         "histograms": hist_out,
         "health": health,
+        "goodput": goodput,
         "headline": headline,
     }

@@ -195,9 +195,14 @@ def step_boundary(step: int) -> None:
             sys.stderr.flush()
             # os._exit skips atexit, so the recorder's close() never runs:
             # drain the telemetry buffer here or the dying member's last
-            # steps vanish from events.jsonl (ISSUE 3 satellite).
+            # steps vanish from events.jsonl (ISSUE 3 satellite). The
+            # flight dump first (ISSUE 6): an injected member death is
+            # exactly the fatal path whose forensic artifact the
+            # supervisor's flow.member_failed event references.
             from tpuflow import obs
+            from tpuflow.obs import flight
 
+            flight.dump_flight("faults.member_exit")
             obs.flush()
             os._exit(1)
 

@@ -229,6 +229,13 @@ def train_gpt(
     _dist.maybe_enable_compile_cache(
         run_dir=os.path.dirname(os.path.abspath(ckpt_dir))
     )
+    # Live metrics endpoint (ISSUE 6, opt-in TPUFLOW_OBS_HTTP_PORT): gang
+    # member 0 — or an in-process run, which is its own member 0 — serves
+    # /metrics + /status for the duration of the leg. Idempotent; one
+    # env lookup when the knob is off.
+    from tpuflow.obs import export as _obs_export
+
+    _obs_export.maybe_start_from_env()
     if cfg.stage_axis > 1:
         if cfg.fsdp_axis > 1:
             log(
@@ -409,6 +416,7 @@ def _train_fsdp(
         # iterator. All no-ops when obs is disabled.
         from tpuflow import obs
         from tpuflow.data.loader import prefetch_to_device
+        from tpuflow.obs import goodput as goodput_mod
         from tpuflow.obs import health as health_mod
         from tpuflow.train.step import (
             DispatchWindow,
@@ -449,7 +457,7 @@ def _train_fsdp(
                 m_gn = float(metrics["grad_norm"])
                 if clock.recording:
                     if timed:
-                        clock.step_done(tokens=tokens)
+                        clock.step_done(tokens=tokens, step=step_no)
                     clock.health_done(
                         loss=m_loss,
                         grad_norm=m_gn,
@@ -471,7 +479,7 @@ def _train_fsdp(
                 # window bounds the in-flight dispatch queue.
                 jax.block_until_ready(metrics["loss"])
                 if timed:
-                    clock.step_done(tokens=tokens)
+                    clock.step_done(tokens=tokens, step=step_no)
 
         def drain_window() -> None:
             for entry in window.drain():
@@ -519,6 +527,17 @@ def _train_fsdp(
             }
 
         clock = StepClock()
+        # Rolling-MFU feed for the live export endpoint: the dense-
+        # transformer 6·N FLOP/token estimate (set AFTER the clock reset
+        # the ledger). state.params is materialized by now on both the
+        # fresh and the restored path.
+        goodput_mod.live().set_model_flops_per_token(
+            6.0
+            * sum(
+                int(l.size)
+                for l in jax.tree_util.tree_leaves(state.params)
+            )
+        )
         cold = True
         # Loader cursor for deterministic mid-epoch resume: epoch + batches
         # consumed, persisted as checkpoint data_state and replayed by
@@ -613,6 +632,7 @@ def _train_fsdp(
                             epoch=epoch, loss=epoch_loss,
                             tokens_per_s=round(tok_s, 1) if tok_s else None,
                         )
+                    clock.goodput_mark()
                     # Held-out validation: token-level loss -> perplexity
                     # over EVERY test window (padded tail masked out). The
                     # best/retention policy keys on real val loss, matching
@@ -945,6 +965,7 @@ def _train_pipeline(
             )
         from tpuflow import obs
         from tpuflow.data.loader import prefetch_to_device
+        from tpuflow.obs import goodput as goodput_mod
         from tpuflow.obs import health as health_mod
         from tpuflow.train.step import (
             DispatchWindow,
@@ -957,6 +978,12 @@ def _train_pipeline(
         lr_scale = 1.0
         fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
         clock = StepClock()
+        # Rolling-MFU feed (see the FSDP leg): 6·N over the pipeline-
+        # sharded params, set after the clock reset the live ledger.
+        goodput_mod.live().set_model_flops_per_token(
+            6.0
+            * sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+        )
         # Dispatch-ahead window, same contract as the FSDP leg: fences
         # (the float() copies in settle) trail dispatch by up to depth-1
         # steps; every drain point below settles to a step boundary.
@@ -971,7 +998,7 @@ def _train_pipeline(
                 m_gn = float(hstats["grad_norm"])
                 if clock.recording:
                     if timed:
-                        clock.step_done(tokens=tokens)
+                        clock.step_done(tokens=tokens, step=step_no)
                     clock.health_done(
                         loss=m_loss,
                         grad_norm=m_gn,
@@ -991,7 +1018,7 @@ def _train_pipeline(
             else:
                 jax.block_until_ready(loss)
                 if timed:
-                    clock.step_done(tokens=tokens)
+                    clock.step_done(tokens=tokens, step=step_no)
 
         def drain_window() -> None:
             for entry in window.drain():
@@ -1088,6 +1115,7 @@ def _train_pipeline(
                     jax.block_until_ready(params)
                     epoch_loss = float(jnp.stack(losses).mean())
                     history.append(epoch_loss)
+                    clock.goodput_mark()
                     log(
                         f"[gpt] pipeline epoch {epoch}: "
                         f"loss={epoch_loss:.4f}"

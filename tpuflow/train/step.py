@@ -23,6 +23,7 @@ from flax.training import train_state
 
 from tpuflow import obs
 from tpuflow.models.losses import accuracy, cross_entropy_loss
+from tpuflow.obs import goodput as _goodput
 from tpuflow.utils.heartbeat import beat as _heartbeat
 
 # Preemption surface of the train layer (ISSUE 2): gang_exec installs the
@@ -144,6 +145,13 @@ class StepClock:
         self._last = time.monotonic() if self._on else 0.0
         self._t0 = self._last
         self._ts0 = time.time() if self._on else 0.0
+        self._steps = 0
+        if self._on:
+            # One clock per train leg: restart the live goodput ledger
+            # (tpuflow.obs.goodput) the export endpoint serves, so
+            # /metrics reflects THIS leg, not a previous run in the same
+            # process.
+            _goodput.live().reset()
 
     def reset(self) -> None:
         """Restart the clock (epoch boundary / after the compile fence)."""
@@ -162,19 +170,36 @@ class StepClock:
                     dur_s=now - self._t0, **attrs,
                 )
             self._last = now
+            _goodput.live().note_compile(now - self._t0)
+            _goodput.emit_gauges()
 
-    def step_done(self, tokens: int = 0) -> None:
+    def step_done(self, tokens: int = 0, step: int | None = None) -> None:
         """A steady-state step just fenced: record its wall time. Also
         stamps this gang member's heartbeat — the step fence is the
         liveness signal the gang supervisor watches (no-op outside a
-        supervised gang)."""
-        _heartbeat()
+        supervised gang), now carrying the CURRENT step number so a stall
+        report can say where the member stopped."""
+        _heartbeat(step)
         if self._on:
             now = time.monotonic()
-            obs.histogram("train.step_s", now - self._last)
+            dur = now - self._last
+            obs.histogram("train.step_s", dur)
             if tokens:
                 obs.counter("train.tokens", tokens)
             self._last = now
+            _goodput.live().note_step(dur, tokens=tokens, step=step)
+            self._steps += 1
+            if self._steps % 32 == 0:
+                # Periodic goodput-so-far gauges: cheap (three buffered
+                # records), and the event stream then carries the
+                # incremental ledger even for runs that die mid-epoch.
+                _goodput.emit_gauges()
+
+    def goodput_mark(self) -> None:
+        """Epoch-fence hook: flush the goodput-so-far gauges so every
+        epoch boundary has a fresh incremental ledger reading."""
+        if self._on:
+            _goodput.emit_gauges()
 
     @property
     def recording(self) -> bool:
@@ -204,6 +229,7 @@ class StepClock:
         obs.gauge("health.param_norm", param_norm)
         if nonfinite:
             obs.counter("health.nonfinite")
+        _goodput.live().note_health(loss, grad_norm, nonfinite)
 
 
 class TrainState(train_state.TrainState):

@@ -221,6 +221,22 @@ class TrainContext:
             **{k: v for k, v in metrics.items()
                if isinstance(v, (int, float))},
         )
+        # Custom loops have no StepClock: the report cadence feeds the
+        # live goodput ledger the export endpoint serves (step number +
+        # last loss; rates derive from the report fences).
+        from tpuflow.obs import goodput as _goodput
+
+        _goodput.live().note_report(
+            save_step,
+            loss=next(
+                (
+                    metrics[k]
+                    for k in ("loss", "train_loss", "val_loss")
+                    if isinstance(metrics.get(k), (int, float))
+                ),
+                None,
+            ),
+        )
         if self._health is not None:
             loss = next(
                 (
@@ -276,7 +292,9 @@ class TrainContext:
         # honor a pending preemption — the state just saved above IS the
         # drain checkpoint, so committing it and raising is all that's
         # left (gang_exec turns Preempted into the requeue exit code).
-        _heartbeat()
+        # The stamp carries the step so a stall report names WHERE the
+        # member stopped, not just how stale the stamp is.
+        _heartbeat(save_step)
         if os.environ.get("TPUFLOW_FAULT"):
             from tpuflow.testing import faults
 
@@ -400,6 +418,14 @@ class Trainer:
         # attempts reload the compiled step instead of re-paying the
         # first-compile wall time. See dist.maybe_enable_compile_cache.
         dist.maybe_enable_compile_cache(run_dir=self.run_config.storage_path)
+        # Live goodput + metrics endpoint (ISSUE 6): restart the ledger
+        # for this fit, and serve /metrics + /status when opted in via
+        # TPUFLOW_OBS_HTTP_PORT (member 0 only; one env lookup when off).
+        from tpuflow.obs import export as _obs_export
+        from tpuflow.obs import goodput as _goodput
+
+        _goodput.live().reset()
+        _obs_export.maybe_start_from_env()
         mesh = self._build_mesh()
         ctx = TrainContext(mesh, self.run_config)
         _ACTIVE_CONTEXT = ctx

@@ -26,17 +26,26 @@ def heartbeat_file() -> str | None:
     return os.environ.get("TPUFLOW_HEARTBEAT_FILE") or None
 
 
-def beat() -> None:
+def beat(step: int | None = None) -> None:
     """Stamp this member's heartbeat file; no-op outside a supervised gang.
-    Never raises — a heartbeat must not fail the step it reports on."""
+    Never raises — a heartbeat must not fail the step it reports on.
+
+    ``step`` (when the caller knows it — StepClock fences,
+    TrainContext.report) is written as the file's CONTENT, so a stall
+    report can name the member's last completed step, not just how old
+    the stamp is; step-less beats keep the last stamped step."""
     path = heartbeat_file()
     if not path:
         return
     try:
-        with open(path, "a"):
-            pass
+        if step is None:
+            with open(path, "a"):
+                pass
+        else:
+            with open(path, "w") as f:
+                f.write(str(int(step)))
         os.utime(path, None)
-    except OSError:
+    except (OSError, TypeError, ValueError):
         return
     if os.environ.get("TPUFLOW_FAULT"):
         from tpuflow.testing import faults
