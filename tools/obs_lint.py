@@ -76,6 +76,11 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("gauge", "goodput.fraction"),
     ("event", "obs.flight"),
     ("event", "obs.export"),
+    # Elastic gang (ISSUE 7): the resize evidence trail — the Elastic
+    # gang runbook and the goodput `resize` bucket both consume these.
+    ("span", "flow.gang_resize"),
+    ("event", "flow.member_lost"),
+    ("gauge", "dist.mesh_generation"),
 )
 
 # Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records

@@ -890,6 +890,49 @@ class CheckpointManager:
                 return
             raise err
 
+    def abandon_pending(self) -> None:
+        """Drop a deferred multi-host commit that can no longer complete.
+
+        An elastic-gang member loss (ISSUE 7) strands the in-flight save:
+        the dead peer's shards will never arrive and the commit's
+        success-allgather/barriers would raise (or hang) on every
+        survivor. This joins THIS host's local shard writes only (no
+        collectives), drops the step's metrics-history entry, and leaves
+        the staged ``.tmp`` dir in place for the next manager's startup
+        GC — deleting it here would race surviving peers whose saver
+        threads are still writing into it. The resume point is the last
+        FULLY committed step; ``ckpt.save_failed`` records the stranded
+        one. Safe to call when nothing is pending (no-op), and leaves the
+        manager clean for ``close()``."""
+        pending = self._pending_commit
+        self._pending_commit = None
+        pending_fail = self._pending_fail
+        self._pending_fail = None
+        err: BaseException | None = None
+        try:
+            self._ckptr.wait_until_finished()
+            self._raw_saver.wait()
+        except BaseException as e:
+            err = e
+        if pending is None and err is None:
+            return
+        step = None
+        if pending_fail is not None:
+            step = pending_fail[0]
+            for m in list(self._metrics_history):
+                if m.get("step") == step:
+                    self._metrics_history.remove(m)
+                    break
+        obs.event(
+            "ckpt.save_failed",
+            step=step if step is not None else -1,
+            error=(
+                str(err)[:300]
+                if err is not None
+                else "abandoned: mesh re-form (staging left for startup GC)"
+            ),
+        )
+
     def close(self) -> None:
         self.wait_until_finished()
         if self._pool is not None:

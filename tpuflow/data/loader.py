@@ -80,6 +80,29 @@ class ShardedLoader:
         """
         self._skip_next = max(int(n), 0)
 
+    def reshard(self, shard_index: int, num_shards: int) -> None:
+        """Re-key this loader to a resized data-parallel world (ISSUE 7:
+        elastic mesh shrink/grow re-forms the gang mid-run).
+
+        Only the stride slice over the per-epoch permutation changes —
+        the permutation itself is a pure function of ``(seed, epoch)``,
+        so ``data_state`` continuity composes with the resize: feeding
+        the cursor recorded by the OLD world into ``set_epoch`` +
+        ``skip_batches`` resumes the epoch DETERMINISTICALLY under the
+        new shard map (same permutation, new stride). Row-level
+        continuity across the resize boundary is approximate — the old
+        and new strides interleave rows differently — but epoch and
+        step accounting stay exact, which is what the train loops key
+        on.
+        """
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(
+                f"shard_index {shard_index} out of range for "
+                f"{num_shards} shards"
+            )
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+
     def state_dict(self, batches_consumed: int) -> dict:
         """The loader cursor a checkpoint should persist for deterministic
         mid-epoch resume: pair with ``set_epoch`` + ``skip_batches`` on

@@ -8,6 +8,8 @@ data-parallel gradient allreduce is emitted by the compiler from shardings —
 there is no user-visible collective API, same encapsulation as the reference.
 """
 
+from tpuflow.dist import membership
+from tpuflow.dist.membership import Generation, MembershipTimeout, MeshReform
 from tpuflow.dist.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
@@ -36,6 +38,10 @@ from tpuflow.dist.mesh import (
 
 __all__ = [
     "AXIS_DATA",
+    "Generation",
+    "MembershipTimeout",
+    "MeshReform",
+    "membership",
     "AXIS_EXPERT",
     "AXIS_FSDP",
     "AXIS_SEQ",

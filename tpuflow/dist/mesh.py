@@ -341,6 +341,31 @@ def initialize(
             if process_id is not None
             else int(os.environ.get("TPUFLOW_PROCESS_ID", "0"))
         )
+    if (
+        num_processes is not None
+        and num_processes > 1
+        and os.environ.get("TPUFLOW_MEMBERSHIP_DIR")
+    ):
+        # Elastic gang (ISSUE 7): generation 0 comes up through the
+        # membership runtime — a teardown-capable client/service pair —
+        # so a later member loss can re-form the mesh in place instead of
+        # requeueing the world. Same rendezvous semantics, same timeout.
+        from tpuflow.dist import membership
+
+        plan = membership.Generation(
+            generation=0,
+            roster=tuple(range(num_processes)),
+            coordinator=coordinator_address or "127.0.0.1:42042",
+            reason="init",
+        )
+        membership.elastic_initialize(plan, timeout_s=timeout_s)
+        _initialized_multihost = True
+        logger.info(
+            "elastic gang initialized: process %d/%d (generation 0)",
+            jax.process_index(),
+            jax.process_count(),
+        )
+        return
     if num_processes is None or num_processes <= 1:
         if num_processes is None and _looks_multihost():
             # Real pod slice with no explicit config: let jax auto-detect the
@@ -377,7 +402,14 @@ def _looks_multihost() -> bool:
 
 
 def shutdown() -> None:
-    """Tear down the multi-host runtime if we started it."""
+    """Tear down the multi-host runtime if we started it.
+
+    An elastic gang that re-formed at least once never reaches here — its
+    members exit via the membership done-handshake + ``os._exit`` (zombie
+    runtime threads from torn-down generations make ordinary interpreter
+    teardown unsafe; see ``dist.membership``). A generation-0 elastic
+    world shuts down like any other: every member is alive, so the
+    client's shutdown barrier completes normally."""
     global _initialized_multihost
     if _initialized_multihost:
         jax.distributed.shutdown()

@@ -155,6 +155,7 @@ _GOODPUT_COLORS = (
     ("restore", "#9268d4"),
     ("data_wait", "#eb6834"),
     ("ckpt", "#8a8782"),
+    ("resize", "#d08a3a"),
     ("requeue_gap", "#d05252"),
     ("other", "#e5e4e0"),
 )

@@ -40,6 +40,7 @@ def tpu(
     num_parallel: int | None = None,
     all_hosts_started_timeout: float = 300.0,
     heartbeat_timeout: float | None = None,
+    min_members: int | None = None,
 ):
     """Gang step (↔ @metaflow_ray(all_nodes_started_timeout=60*5),
     train_flow.py:42): the step body runs as a gang of processes forming one
@@ -54,13 +55,21 @@ def tpu(
     goes silent for this many seconds is treated as hung and the gang is
     killed promptly — well inside the flat rendezvous deadline. ``None``
     falls back to ``TPUFLOW_STALL_TIMEOUT_S`` (default 600). Members that
-    never stamp are never judged."""
+    never stamp are never judged.
+
+    ``min_members``: the elastic-gang floor (ISSUE 7, TPUFLOW_ELASTIC=1):
+    a member loss shrinks the mesh over the survivors as long as at least
+    this many remain; below the floor the supervisor falls back to the
+    classic requeue-the-world path. ``None`` falls back to
+    ``TPUFLOW_GANG_MIN_MEMBERS`` (default 2). Also annotated onto the
+    deployer's JobSet manifests (min/max member annotations)."""
 
     def wrap(fn: Callable) -> Callable:
         fn.__gang__ = {
             "num_parallel": num_parallel,
             "timeout": all_hosts_started_timeout,
             "heartbeat_timeout": heartbeat_timeout,
+            "min_members": min_members,
         }
         return fn
 

@@ -194,10 +194,25 @@ def _gang_jobset(
         node_selector["cloud.google.com/gke-tpu-topology"] = topo["grid"]
     if res.get("compute_pool"):
         node_selector["cloud.google.com/gke-nodepool"] = res["compute_pool"]
+    # Elastic gang envelope (ISSUE 7): the min/max member annotations tell
+    # autoscalers/operators the resize window the supervisor honors — a
+    # member loss shrinks the mesh down to min-members (below that it
+    # falls back to requeue-the-world), and requeued capacity grows it
+    # back up to the full host count.
+    import os as _os
+
+    min_members = gang.get("min_members") or int(
+        _os.environ.get("TPUFLOW_GANG_MIN_MEMBERS", "2")
+    )
+    annotations = {
+        "tpuflow.dev/min-gang-members": str(min(min_members, topo["hosts"])),
+        "tpuflow.dev/max-gang-members": str(topo["hosts"]),
+        "tpuflow.dev/elastic": _os.environ.get("TPUFLOW_ELASTIC", "0"),
+    }
     return {
         "apiVersion": "jobset.x-k8s.io/v1alpha2",
         "kind": "JobSet",
-        "metadata": {"name": name},
+        "metadata": {"name": name, "annotations": annotations},
         "spec": {
             "replicatedJobs": [
                 {

@@ -139,11 +139,29 @@ def main(argv: list[str]) -> None:
             state = pickle.load(f)
 
     from tpuflow import dist
+    from tpuflow.dist import membership
     from tpuflow.flow import store
     from tpuflow.flow.spec import current
 
     timeout = float(os.environ.get("TPUFLOW_GANG_TIMEOUT", "300"))
-    dist.initialize(timeout_s=timeout)
+    if (
+        membership.enabled()
+        and os.environ.get("TPUFLOW_GANG_REJOIN") == "1"
+    ):
+        # Requeued capacity rejoining an elastic gang (ISSUE 7): skip the
+        # gen-0 rendezvous entirely — request inclusion, wait for the
+        # supervisor's grow plan, and enter that generation's world. The
+        # survivors hit the same generation at their next step fence.
+        faults.maybe_rejoin_delay()
+        me = membership.member_id()
+        membership.request_join(me)
+        plan = membership.await_plan_including(me, timeout_s=timeout)
+        membership.join_generation(plan, timeout_s=timeout)
+    else:
+        # dist.initialize routes elastic gangs (TPUFLOW_MEMBERSHIP_DIR
+        # set by the launcher) through the teardown-capable membership
+        # runtime at generation 0.
+        dist.initialize(timeout_s=timeout)
     # Deliberately NO heartbeat here: the first stamp comes from the train
     # loops (fenced steps / reports), so only members that demonstrably
     # adopted the protocol are ever judged for staleness — an arbitrary
@@ -233,6 +251,29 @@ def main(argv: list[str]) -> None:
             with open(os.path.join(tdir, "next.json"), "w") as f:
                 json.dump({"target": transition.target}, f)
     dist.barrier("gang-step-done")
+    if membership.enabled() and membership.current_generation() > 0:
+        # This world was re-formed at least once: torn-down generations
+        # left deliberately-leaked runtime threads (dist.membership), so
+        # ordinary interpreter teardown is unsafe — their services' exit
+        # would race peers' zombie poll threads into a fatal abort. Hand
+        # the supervisor a done marker (its forgiveness token for exactly
+        # that race), let the leaked-runtime holder (the coordinator)
+        # exit LAST, and leave via os._exit.
+        me = membership.member_id()
+        membership.mark_done(me)
+        if membership.holds_leaked_runtime():
+            plan = membership.current_plan()
+            others = set(plan.roster if plan else ()) - {me}
+            membership.await_done(
+                others,
+                timeout_s=float(os.environ.get("TPUFLOW_KILL_GRACE_S", "5")),
+            )
+            import time as _time
+
+            _time.sleep(0.2)  # let peers' exits finish closing sockets
+        obs.flush()
+        sys.stdout.flush()
+        os._exit(0)
     dist.shutdown()
 
 

@@ -284,6 +284,9 @@ class FlowRunner:
                                         "heartbeat_timeout"
                                     ),
                                     attempt=attempt + requeues,
+                                    min_members=(gang or {}).get(
+                                        "min_members"
+                                    ),
                                 )
                             else:
                                 self._exec_local(
@@ -489,6 +492,7 @@ class FlowRunner:
         timeout: float,
         stall_timeout: float | None = None,
         attempt: int = 0,
+        min_members: int | None = None,
     ) -> list[_GangInput]:
         """Launch N processes running the step body as one jax.distributed
         world (local simulation of the pod-slice gang, SURVEY.md §2b D8),
@@ -508,68 +512,100 @@ class FlowRunner:
                 {"artifacts": flow._artifacts, "module": self._flow_module()}, f
             )
         port = _free_port()
-        procs: list[tuple[subprocess.Popen, Any]] = []
+        # Elastic gang (ISSUE 7): with TPUFLOW_ELASTIC=1 a member loss no
+        # longer kills the survivors — the supervisor announces a mesh
+        # re-form through this shared membership dir (cleared per launch:
+        # a previous attempt's plan must not leak into this world).
+        elastic = (
+            os.environ.get("TPUFLOW_ELASTIC") == "1" and num_parallel > 1
+        )
+        membership_dir = None
+        if elastic:
+            import shutil
+
+            membership_dir = os.path.join(tdir, "membership")
+            shutil.rmtree(membership_dir, ignore_errors=True)
+            os.makedirs(membership_dir, exist_ok=True)
         import tpuflow
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(tpuflow.__file__)))
+
+        def launch_member(
+            i: int, *, rejoin: bool = False
+        ) -> tuple[subprocess.Popen, Any]:
+            # Stale heartbeats from a previous attempt (or a lost member's
+            # final stamp) would read as an instant stall — clear before
+            # every launch.
+            hb_path = os.path.join(tdir, f"heartbeat_{i}")
+            try:
+                os.unlink(hb_path)
+            except FileNotFoundError:
+                pass
+            env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+            env.update(
+                TPUFLOW_NUM_PROCESSES=str(num_parallel),
+                TPUFLOW_PROCESS_ID=str(i),
+                TPUFLOW_COORDINATOR=f"127.0.0.1:{port}",
+                TPUFLOW_GANG_TIMEOUT=str(timeout),
+                TPUFLOW_FORCE_CPU=env_force_cpu(),
+                TPUFLOW_ATTEMPT=str(attempt),
+                TPUFLOW_HEARTBEAT_FILE=hb_path,
+            )
+            if membership_dir is not None:
+                env["TPUFLOW_MEMBERSHIP_DIR"] = membership_dir
+            if rejoin:
+                # Requeued capacity: the member skips the gen-0 rendezvous
+                # and instead requests inclusion in the next (grow)
+                # generation. Same TPUFLOW_ATTEMPT as the gang launch so
+                # the goodput ledger keeps ONE attempt lane (an in-place
+                # resize must not read as a requeue gap).
+                env["TPUFLOW_GANG_REJOIN"] = "1"
+            if "TPUFLOW_PREEMPT_GRACE_S" not in env:
+                # The supervisor SIGKILLs TPUFLOW_KILL_GRACE_S after
+                # its SIGTERM — tell members their real termination
+                # grace so the drain's emergency-save decision
+                # (preempt.emergency_save_advised) counts down from
+                # the budget that actually applies here. Deployed,
+                # the pod spec sets TPUFLOW_PREEMPT_GRACE_S from
+                # terminationGracePeriodSeconds instead.
+                env["TPUFLOW_PREEMPT_GRACE_S"] = os.environ.get(
+                    "TPUFLOW_KILL_GRACE_S", "5"
+                )
+            if getattr(self, "_obs_dir", None):
+                # Each member records its own events.p<i>.jsonl in the
+                # run's obs dir; the end-of-run merge unions them.
+                env["TPUFLOW_OBS_DIR"] = self._obs_dir
+                env["TPUFLOW_OBS_PROC"] = str(i)
+            cmd = [
+                sys.executable,
+                "-m",
+                "tpuflow.flow.gang_exec",
+                self._flow_module(),
+                self.flow_cls.__name__,
+                step_name,
+                str(run_id),
+                str(task_id + i),
+                state_path,
+            ]
+            log = open(
+                os.path.join(tdir, f"gang_{i}.log"), "a" if rejoin else "w"
+            )
+            try:
+                p = subprocess.Popen(
+                    cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )
+            except BaseException:
+                log.close()
+                raise
+            return (p, log)
+
+        procs: list[tuple[subprocess.Popen, Any]] = []
         launched = False
         try:
             for i in range(num_parallel):
-                # Stale heartbeats from a previous attempt would read as an
-                # instant stall — clear before every launch.
-                hb_path = os.path.join(tdir, f"heartbeat_{i}")
-                try:
-                    os.unlink(hb_path)
-                except FileNotFoundError:
-                    pass
-                env = dict(os.environ)
-                env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-                env.update(
-                    TPUFLOW_NUM_PROCESSES=str(num_parallel),
-                    TPUFLOW_PROCESS_ID=str(i),
-                    TPUFLOW_COORDINATOR=f"127.0.0.1:{port}",
-                    TPUFLOW_GANG_TIMEOUT=str(timeout),
-                    TPUFLOW_FORCE_CPU=env_force_cpu(),
-                    TPUFLOW_ATTEMPT=str(attempt),
-                    TPUFLOW_HEARTBEAT_FILE=hb_path,
-                )
-                if "TPUFLOW_PREEMPT_GRACE_S" not in env:
-                    # The supervisor SIGKILLs TPUFLOW_KILL_GRACE_S after
-                    # its SIGTERM — tell members their real termination
-                    # grace so the drain's emergency-save decision
-                    # (preempt.emergency_save_advised) counts down from
-                    # the budget that actually applies here. Deployed,
-                    # the pod spec sets TPUFLOW_PREEMPT_GRACE_S from
-                    # terminationGracePeriodSeconds instead.
-                    env["TPUFLOW_PREEMPT_GRACE_S"] = os.environ.get(
-                        "TPUFLOW_KILL_GRACE_S", "5"
-                    )
-                if getattr(self, "_obs_dir", None):
-                    # Each member records its own events.p<i>.jsonl in the
-                    # run's obs dir; the end-of-run merge unions them.
-                    env["TPUFLOW_OBS_DIR"] = self._obs_dir
-                    env["TPUFLOW_OBS_PROC"] = str(i)
-                cmd = [
-                    sys.executable,
-                    "-m",
-                    "tpuflow.flow.gang_exec",
-                    self._flow_module(),
-                    self.flow_cls.__name__,
-                    step_name,
-                    str(run_id),
-                    str(task_id + i),
-                    state_path,
-                ]
-                log = open(os.path.join(tdir, f"gang_{i}.log"), "w")
-                try:
-                    p = subprocess.Popen(
-                        cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
-                        cwd=os.getcwd(),
-                    )
-                except BaseException:
-                    log.close()
-                    raise
-                procs.append((p, log))
+                procs.append(launch_member(i))
             launched = True
         finally:
             if not launched:
@@ -588,6 +624,9 @@ class FlowRunner:
             failure = self._supervise_gang(
                 procs, tdir, step_name,
                 timeout=timeout, stall_timeout=stall_timeout,
+                membership_dir=membership_dir,
+                launch_member=launch_member if elastic else None,
+                min_members=min_members,
             )
             gang_span.set(failed=failure is not None)
         if failure is not None:
@@ -636,6 +675,9 @@ class FlowRunner:
         *,
         timeout: float,
         stall_timeout: float | None,
+        membership_dir: str | None = None,
+        launch_member=None,
+        min_members: int | None = None,
     ):
         """Poll all gang members until they all exit cleanly or one fails.
 
@@ -646,8 +688,26 @@ class FlowRunner:
         can drain a checkpoint) escalating to SIGKILL after
         ``TPUFLOW_KILL_GRACE_S``.
 
+        Elastic mode (ISSUE 7, ``membership_dir`` + ``launch_member``
+        given): a non-coordinator member loss no longer fails the step —
+        the supervisor converts it into a mesh re-form at step-fence
+        granularity: ``flow.member_lost`` is recorded, a shrink generation
+        is announced through the membership dir, and the survivors drain,
+        re-rendezvous and continue. When the lost capacity is requeue-
+        eligible (crash or preemption, not a ``member_lost`` fault) the
+        member is relaunched and, once it requests inclusion, a grow
+        generation re-adds it. Falls back to the classic requeue-the-world
+        verdict when the coordinator (member 0) dies, the survivors would
+        drop below the min-members floor, a re-form misses its deadline,
+        or the resize budget is spent. While a re-form is in flight the
+        heartbeat-stall judgment is suspended — quiesce/rendezvous
+        legitimately stops step fences, so the re-form deadline (not
+        ``TPUFLOW_STALL_TIMEOUT_S``) governs, and ``flow.heartbeat_stall``
+        never fingers a draining survivor.
+
         Returns ``None`` on success or ``(kind, member, detail)`` where
-        kind ∈ {"member_failed", "heartbeat_stall", "timeout", "preempt"}.
+        kind ∈ {"member_failed", "heartbeat_stall", "timeout", "preempt",
+        "reform_timeout"}.
         """
         if stall_timeout is None:
             stall_timeout = float(
@@ -657,8 +717,119 @@ class FlowRunner:
         n = len(procs)
         rcs: list[int | None] = [None] * n
         failure = None
+        elastic = membership_dir is not None and launch_member is not None
+        roster: set[int] = set(range(n))
+        generation = 0
+        resizes = 0
+        forming: dict | None = None  # in-flight re-form bookkeeping
+        formed_at = time.monotonic()
+        pending_rejoin: list[int] = []
+        awaiting_join: set[int] = set()
+        if elastic:
+            from tpuflow.dist import membership as _ms
+            from tpuflow.testing import faults as _faults
+
+            floor = (
+                int(min_members)
+                if min_members
+                else int(os.environ.get("TPUFLOW_GANG_MIN_MEMBERS", "2"))
+            )
+            reform_timeout = float(
+                os.environ.get("TPUFLOW_REFORM_TIMEOUT_S", "120")
+            )
+            max_resizes = int(os.environ.get("TPUFLOW_MAX_RESIZES", "8"))
+            try:
+                # ``member_lost`` faults model PERMANENT capacity loss:
+                # their requeue is suppressed so shrink is exercised
+                # (``member_exit``'s relaunch exercises re-grow).
+                suppressed = {
+                    f.rank for f in _faults.matching("member_lost")
+                }
+            except ValueError:
+                suppressed = set()
+
+        def _announce(reason: str) -> None:
+            nonlocal forming, generation, resizes
+            generation += 1
+            resizes += 1
+            plan = _ms.Generation(
+                generation=generation,
+                roster=tuple(sorted(roster)),
+                coordinator=f"127.0.0.1:{_free_port()}",
+                reason=reason,
+                deadline=time.time() + reform_timeout,
+            )
+            _ms.announce(membership_dir, plan)
+            forming = {
+                "plan": plan,
+                "t0": time.monotonic(),
+                "ts": time.time(),
+                "from": len(roster) + (1 if reason == "shrink" else -1),
+            }
+            print(
+                f"[tpuflow] gang {reason}: generation {generation} over "
+                f"members {sorted(roster)} (deadline "
+                f"{reform_timeout:.0f}s)"
+            )
+
+        def _elastic_loss(i: int, rc: int) -> None:
+            """One roster member exited non-zero: shrink if eligible,
+            else fall back to the classic requeue-the-world verdict."""
+            nonlocal failure
+            survivors = {
+                j for j in roster if j != i and rcs[j] is None
+            }
+            finished_ok = {
+                j for j in roster if j != i and rcs[j] == 0
+            }
+            eligible = (
+                i != 0  # the coordinator hosts every generation's service
+                and forming is None
+                and resizes < max_resizes
+                and len(survivors | finished_ok) >= floor
+            )
+            if not eligible:
+                if rc == REQUEUE_EXIT_CODE:
+                    failure = ("preempt", i, "requeue")
+                    obs.event("flow.preempt", step=step_name, member=i)
+                else:
+                    failure = (
+                        "member_failed", i,
+                        f"member {i} exited {rc} (elastic fallback: "
+                        f"{'coordinator' if i == 0 else 'floor/budget/in-flight'})",
+                    )
+                    attrs = {
+                        "step": step_name,
+                        "member": i,
+                        "rc": rc,
+                        "log_tail": self._log_tail(tdir, i),
+                    }
+                    flight = self._member_flight(i)
+                    if flight:
+                        attrs["flight"] = flight
+                    obs.event("flow.member_failed", **attrs)
+                return
+            roster.discard(i)
+            attrs = {
+                "step": step_name,
+                "member": i,
+                "rc": rc,
+                "survivors": len(roster),
+                "log_tail": self._log_tail(tdir, i),
+            }
+            flight = self._member_flight(i)
+            if flight:
+                attrs["flight"] = flight
+            obs.event("flow.member_lost", **attrs)
+            _announce("shrink")
+            if i not in suppressed:
+                # Requeued capacity returns: crash and preemption both
+                # come back (a preempted pod is rescheduled); a
+                # member_lost fault stays gone.
+                pending_rejoin.append(i)
+
         try:
-            while any(rc is None for rc in rcs):
+            while True:
                 for i, (p, log) in enumerate(procs):
                     if rcs[i] is not None:
                         continue
@@ -667,42 +838,153 @@ class FlowRunner:
                         continue
                     rcs[i] = rc
                     log.close()
-                    if rc != 0 and failure is None:
-                        if rc == REQUEUE_EXIT_CODE:
-                            failure = ("preempt", i, "requeue")
-                            obs.event(
-                                "flow.preempt", step=step_name, member=i
-                            )
-                        else:
-                            failure = (
-                                "member_failed", i, f"member {i} exited {rc}"
-                            )
-                            attrs = {
-                                "step": step_name,
-                                "member": i,
-                                "rc": rc,
-                                "log_tail": self._log_tail(tdir, i),
-                            }
-                            # Crash forensics (ISSUE 6): the dying member
-                            # dumped its flight ring before exiting
-                            # (unhandled exception, SIGTERM, injected
-                            # death) — reference the structured artifact
-                            # beside the log tail.
-                            flight = self._member_flight(i)
-                            if flight:
-                                attrs["flight"] = flight
-                            obs.event("flow.member_failed", **attrs)
+                    if elastic and i in awaiting_join:
+                        # The relaunched member died before it could even
+                        # request to rejoin: stop waiting for it (the
+                        # shrunk gang is already healthy without it).
+                        awaiting_join.discard(i)
+                        continue
+                    if rc == 0 or failure is not None:
+                        continue
+                    if elastic and i in _ms.done_members(membership_dir):
+                        # Post-completion teardown crash of a re-formed
+                        # member (leaked old-generation runtimes make
+                        # interpreter teardown racy): the step body
+                        # finished and its artifacts committed — forgive.
+                        rcs[i] = 0
+                        continue
+                    if elastic and i in roster:
+                        _elastic_loss(i, rc)
+                    elif elastic:
+                        pass  # already counted out of the roster
+                    elif rc == REQUEUE_EXIT_CODE:
+                        failure = ("preempt", i, "requeue")
+                        obs.event(
+                            "flow.preempt", step=step_name, member=i
+                        )
+                    else:
+                        failure = (
+                            "member_failed", i, f"member {i} exited {rc}"
+                        )
+                        attrs = {
+                            "step": step_name,
+                            "member": i,
+                            "rc": rc,
+                            "log_tail": self._log_tail(tdir, i),
+                        }
+                        # Crash forensics (ISSUE 6): the dying member
+                        # dumped its flight ring before exiting
+                        # (unhandled exception, SIGTERM, injected
+                        # death) — reference the structured artifact
+                        # beside the log tail.
+                        flight = self._member_flight(i)
+                        if flight:
+                            attrs["flight"] = flight
+                        obs.event("flow.member_failed", **attrs)
                 if failure is not None:
                     break
-                if stall_timeout and stall_timeout > 0:
+                if elastic:
+                    if forming is not None:
+                        plan = forming["plan"]
+                        if roster <= _ms.joined_members(
+                            membership_dir, plan.generation
+                        ):
+                            dur = time.monotonic() - forming["t0"]
+                            rec = obs.recorder()
+                            if rec is not None:
+                                rec.record(
+                                    "span", "flow.gang_resize",
+                                    ts=forming["ts"], dur_s=dur,
+                                    step=step_name,
+                                    generation=plan.generation,
+                                    reason=plan.reason,
+                                    from_members=forming["from"],
+                                    to_members=len(roster),
+                                )
+                            # Reset the stall clock: a member's first
+                            # post-reform fence may trail a long restore
+                            # + recompile; never-stamped members are
+                            # never judged.
+                            for j in roster:
+                                try:
+                                    os.unlink(
+                                        os.path.join(tdir, f"heartbeat_{j}")
+                                    )
+                                except OSError:
+                                    pass
+                            print(
+                                f"[tpuflow] gang generation "
+                                f"{plan.generation} formed "
+                                f"({plan.reason} → {len(roster)} members, "
+                                f"{dur:.1f}s)"
+                            )
+                            forming = None
+                            formed_at = time.monotonic()
+                        elif time.time() > plan.deadline:
+                            failure = (
+                                "reform_timeout", None,
+                                f"generation {plan.generation} "
+                                f"({plan.reason}) missed its "
+                                f"{reform_timeout:.0f}s re-form deadline; "
+                                "falling back to requeue-the-world",
+                            )
+                            break
+                    if forming is None and pending_rejoin and (
+                        # Hold the relaunch until every survivor passed a
+                        # step fence in the NEW generation (their
+                        # heartbeat files — cleared at formation — exist
+                        # again): a grow fence arriving before the shrunk
+                        # gang banked any progress makes everyone replay
+                        # from scratch, where a deterministic crasher
+                        # fires again. Non-stamping step bodies get a
+                        # bounded hold instead.
+                        all(
+                            os.path.exists(
+                                os.path.join(tdir, f"heartbeat_{j}")
+                            )
+                            for j in roster
+                            if rcs[j] is None
+                        )
+                        or time.monotonic() - formed_at
+                        > float(
+                            os.environ.get("TPUFLOW_REJOIN_HOLD_S", "10")
+                        )
+                    ):
+                        m = pending_rejoin.pop(0)
+                        procs[m] = launch_member(m, rejoin=True)
+                        rcs[m] = None
+                        awaiting_join.add(m)
+                    if forming is None and awaiting_join:
+                        ready = _ms.join_requests(
+                            membership_dir
+                        ) & awaiting_join
+                        if ready:
+                            m = min(ready)
+                            awaiting_join.discard(m)
+                            _ms.clear_join_request(membership_dir, m)
+                            roster.add(m)
+                            _announce("grow")
+                    if forming is None and all(
+                        rcs[j] is not None for j in roster
+                    ):
+                        break  # every current-roster member finished
+                elif all(rc is not None for rc in rcs):
+                    break
+                reforming = elastic and forming is not None
+                if stall_timeout and stall_timeout > 0 and not reforming:
                     # Judge only members that ever stamped: arbitrary step
                     # bodies owe no heartbeats. The member with the OLDEST
                     # stamp is the culprit — its peers went silent later,
-                    # blocked in collectives waiting for it.
+                    # blocked in collectives waiting for it. Suspended
+                    # while a re-form is in flight: quiesce/rendezvous
+                    # stops step fences by design, and the re-form
+                    # deadline already bounds that window.
                     now = time.time()
                     stalled: list[tuple[float, int]] = []
                     for i, (p, _log) in enumerate(procs):
-                        if rcs[i] is not None:
+                        if rcs[i] is not None or (
+                            elastic and i not in roster
+                        ):
                             continue
                         try:
                             age = now - os.path.getmtime(
@@ -746,9 +1028,21 @@ class FlowRunner:
                 time.sleep(_GANG_POLL_S)
         finally:
             if failure is not None or any(rc is None for rc in rcs):
+                # Failure, or success with stragglers (e.g. a relaunched
+                # member still waiting for a grow plan the finished gang
+                # will never form): reap everything still running.
                 self._kill_survivors(procs, rcs)
             for _p, log in procs:
                 log.close()  # idempotent
+        if failure is None and elastic and resizes:
+            print(
+                f"[tpuflow] elastic gang step {step_name!r} completed "
+                f"after {resizes} resize(s), final generation {generation}"
+            )
+        if failure is not None and failure[0] == "reform_timeout":
+            # The fallback verdict: surface as a plain member failure so
+            # @retry requeues the world exactly as with elasticity off.
+            return ("member_failed", failure[1], failure[2])
         return failure
 
     @staticmethod

@@ -49,6 +49,21 @@ CATALOG: dict[str, tuple[str, str]] = {
         "a gang member exited with the requeue code after a preemption "
         "drain; the step reruns without consuming the retry budget",
     ),
+    # Elastic gang (ISSUE 7): member loss becomes a mesh resize at
+    # step-fence granularity instead of a requeue-the-world.
+    "flow.member_lost": (
+        "event",
+        "elastic supervisor: a gang member died (member, rc, log tail, "
+        "flight, survivor count) and the gang SHRINKS over the survivors "
+        "instead of failing the step — contrast flow.member_failed, the "
+        "fail-fast path",
+    ),
+    "flow.gang_resize": (
+        "span",
+        "one mesh re-form, announce → all survivors joined the new "
+        "generation (generation, kind=shrink|grow, from/to member "
+        "counts); feeds the goodput ledger's `resize` bucket",
+    ),
     "flow.card_render": ("span", "card HTML render at step completion"),
     # --------------------------------------------------------------- train
     "train.fit": ("span", "Trainer.fit: mesh build + worker loop + drain"),
@@ -135,6 +150,13 @@ CATALOG: dict[str, tuple[str, str]] = {
     "infer.spec.forwards": ("counter", "speculative verify forwards"),
     "infer.spec.committed": ("counter", "tokens committed by speculation"),
     "infer.spec.acceptance": ("gauge", "realized tokens per verify forward"),
+    # ---------------------------------------------------------------- dist
+    "dist.mesh_generation": (
+        "gauge",
+        "the mesh generation this member (re-)initialized into (elastic "
+        "gang: 0 at launch, bumped by every shrink/grow re-form; carries "
+        "the member count and the resize reason)",
+    ),
     # -------------------------------------------------------------- device
     "device.bytes_in_use": ("gauge", "sampled per-device HBM bytes in use"),
     "device.peak_bytes_in_use": ("gauge", "per-device peak HBM bytes"),

@@ -27,6 +27,12 @@ Bucket semantics (highest sweep priority first):
   (a dedicated key: the head's ``flow.step`` span carries its own
   ``attempt`` attribute spanning ALL launches, which must not collapse
   the lanes).
+- ``resize``      — ``flow.gang_resize`` spans (ISSUE 7): an elastic mesh
+  re-form, announce → every survivor joined the new generation. The
+  restore/recompile the re-formed members pay inside that window charges
+  here (resize outranks them in the sweep), so "what did the shrink
+  cost" is one number. An elastic resize produces NO requeue_gap — that
+  is the point.
 - ``compile``     — ``train.compile`` spans (cold jit trace + compile).
 - ``restore``     — ``ckpt.restore`` spans.
 - ``data_wait``   — consumer-visible input stalls (``data.host_wait_s``
@@ -59,6 +65,7 @@ from tpuflow.obs import recorder as _rec
 # an async checkpoint save hiding under compute charges nothing.
 _PRIORITY = (
     "requeue_gap",
+    "resize",
     "compile",
     "restore",
     "data_wait",
@@ -137,6 +144,8 @@ def compute_goodput(events: Iterable[dict]) -> dict[str, Any]:
             intervals.append((ts - v, ts, label))
             t_lo = min(t_lo, ts - v)
             steps_timed += 1
+        elif kind == "span" and name == "flow.gang_resize":
+            intervals.append((ts, end, "resize"))
         elif kind == "span" and name == "train.compile":
             intervals.append((ts, end, "compile"))
         elif kind == "span" and name == "ckpt.restore":

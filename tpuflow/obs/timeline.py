@@ -203,6 +203,10 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
         headline["requeue_gap_s"] = round(
             goodput["buckets"]["requeue_gap"], 3
         )
+    if goodput["buckets"].get("resize"):
+        # Elastic mesh re-forms (ISSUE 7): what the shrink/grow fences
+        # cost, beside the requeue gap they replaced.
+        headline["resize_s"] = round(goodput["buckets"]["resize"], 3)
     return {
         "spans": spans,
         "counters": counters,

@@ -6,6 +6,16 @@ process. Grammar (one spec per entry)::
 
     member_exit:<rank>@step<k>   die (os._exit(1)) on member <rank> at the
                                  end of train step/report <k>
+    member_lost:<rank>@step<k>   like member_exit, but PERMANENT capacity
+                                 loss: the elastic supervisor suppresses
+                                 the member's relaunch, so the gang
+                                 shrinks and stays shrunk (member_exit's
+                                 relaunch exercises re-grow instead)
+    rejoin_delay:<seconds>@<rank>
+                                 a relaunched member <rank> sleeps before
+                                 requesting to rejoin the elastic gang —
+                                 a late rejoin whose grow fence races the
+                                 survivors' step fences
     preempt:<rank>@step<k>       set the preemption flag on member <rank>
                                  at the end of step <k> (simulated SIGTERM:
                                  the loop drains a checkpoint and exits
@@ -74,6 +84,8 @@ class Fault:
 
 KINDS = (
     "member_exit",
+    "member_lost",
+    "rejoin_delay",
     "preempt",
     "nan_grad",
     "loss_spike",
@@ -116,7 +128,9 @@ def parse(raw: str) -> list[Fault]:
                 f"known: {KINDS}"
             )
         rank = step = value = None
-        if kind in ("member_exit", "preempt", "nan_grad", "loss_spike"):
+        if kind in (
+            "member_exit", "member_lost", "preempt", "nan_grad", "loss_spike"
+        ):
             rank_s, _, step_s = payload.partition("@")
             rank = int(rank_s)
             if not step_s.startswith("step"):
@@ -130,6 +144,15 @@ def parse(raw: str) -> list[Fault]:
             secs_s, _, rank_s = payload.partition("@")
             value = float(secs_s)
             rank = int(rank_s) if rank_s else None
+        elif kind == "rejoin_delay":
+            secs_s, _, rank_s = payload.partition("@")
+            if not rank_s:
+                raise ValueError(
+                    f"rejoin_delay spec needs '<seconds>@<rank>', got "
+                    f"{entry!r}"
+                )
+            value = float(secs_s)
+            rank = int(rank_s)
         elif kind == "ckpt_io_flaky":
             if not payload.startswith("p"):
                 raise ValueError(
@@ -186,25 +209,31 @@ def step_boundary(step: int) -> None:
                 f"[faults] preempt injected at step {step}", file=sys.stderr
             )
             request_preemption()
-    for f in matching("member_exit"):
-        if f.rank == rank and f.step == step:
-            print(
-                f"[faults] member_exit injected at step {step}",
-                file=sys.stderr,
-            )
-            sys.stderr.flush()
-            # os._exit skips atexit, so the recorder's close() never runs:
-            # drain the telemetry buffer here or the dying member's last
-            # steps vanish from events.jsonl (ISSUE 3 satellite). The
-            # flight dump first (ISSUE 6): an injected member death is
-            # exactly the fatal path whose forensic artifact the
-            # supervisor's flow.member_failed event references.
-            from tpuflow import obs
-            from tpuflow.obs import flight
+    # member_lost (ISSUE 7) dies exactly like member_exit — the difference
+    # lives in the ELASTIC SUPERVISOR, which reads the same spec from its
+    # own env and suppresses the member's relaunch (permanent capacity
+    # loss → the shrink sticks; member_exit's relaunch exercises re-grow).
+    for kind in ("member_exit", "member_lost"):
+        for f in matching(kind):
+            if f.rank == rank and f.step == step:
+                print(
+                    f"[faults] {kind} injected at step {step}",
+                    file=sys.stderr,
+                )
+                sys.stderr.flush()
+                # os._exit skips atexit, so the recorder's close() never
+                # runs: drain the telemetry buffer here or the dying
+                # member's last steps vanish from events.jsonl (ISSUE 3
+                # satellite). The flight dump first (ISSUE 6): an injected
+                # member death is exactly the fatal path whose forensic
+                # artifact the supervisor's flow.member_failed /
+                # flow.member_lost event references.
+                from tpuflow import obs
+                from tpuflow.obs import flight
 
-            flight.dump_flight("faults.member_exit")
-            obs.flush()
-            os._exit(1)
+                flight.dump_flight(f"faults.{kind}")
+                obs.flush()
+                os._exit(1)
 
 
 _POISON = {"nan_grad": float("nan"), "loss_spike": 1e3}
@@ -241,6 +270,21 @@ def maybe_rendezvous_delay() -> None:
     """Gang-bootstrap hook: called before ``jax.distributed`` rendezvous."""
     for f in matching("rendezvous_delay"):
         if f.rank is None or f.rank == _rank():
+            time.sleep(f.value or 0.0)
+
+
+def maybe_rejoin_delay() -> None:
+    """Elastic-rejoin hook (gang_exec): a relaunched member sleeps before
+    requesting inclusion in the next grow generation — the late-rejoin
+    case where the grow fence races the survivors' step fences."""
+    for f in matching("rejoin_delay"):
+        if f.rank == _rank():
+            print(
+                f"[faults] rejoin_delay: sleeping {f.value}s before the "
+                "join request",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
             time.sleep(f.value or 0.0)
 
 

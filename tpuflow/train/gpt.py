@@ -249,12 +249,41 @@ def train_gpt(
 def _train_fsdp(
     cfg: GptTrainConfig, ckpt_dir: str, resume_checkpoint, log
 ) -> GptTrainResult:
+    """FSDP leg, elastic-aware (ISSUE 7): each pass of this loop is one
+    mesh GENERATION. A ``MeshReform`` unwinding the generation body (a
+    pending plan seen at a step fence, or a collective that died with a
+    member) has already handed state to the checkpoint; re-rendezvous and
+    re-enter — the in-run resume machinery restores the state (resharded,
+    bit-identical), the histories, and the mid-epoch data cursor exactly
+    as it would for a requeued attempt, minus the process restart."""
+    from tpuflow.dist import membership as _membership
+
+    while True:
+        try:
+            return _run_fsdp_generation(
+                cfg, ckpt_dir, resume_checkpoint, log
+            )
+        except _membership.MeshReform as rf:
+            log(
+                f"[gpt] mesh re-form → generation {rf.plan.generation} "
+                f"({rf.plan.reason}, {rf.plan.num_processes} members)"
+            )
+            _membership.quiesce_and_reform(rf.plan)
+            # The next generation resumes from the manager's newest
+            # committed step, never the original cross-run handle.
+            resume_checkpoint = None
+
+
+def _run_fsdp_generation(
+    cfg: GptTrainConfig, ckpt_dir: str, resume_checkpoint, log
+) -> GptTrainResult:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from tpuflow import dist
     from tpuflow.ckpt import CheckpointManager
+    from tpuflow.dist import membership as _membership
     from tpuflow.models.gpt2 import GPT2
     from tpuflow.parallel import create_sharded_state, gpt2_tensor_rules
     from tpuflow.train import (
@@ -265,16 +294,33 @@ def _train_fsdp(
     )
 
     model_cfg = cfg.model_config()
-    mesh = dist.make_mesh(
-        {
-            "data": cfg.data_axis,
-            "fsdp": cfg.fsdp_axis,
-            "tensor": cfg.tensor_axis,
-            "seq": cfg.seq_axis,
-            "expert": cfg.expert_axis,
-        }
+    axes = {
+        "data": cfg.data_axis,
+        "fsdp": cfg.fsdp_axis,
+        "tensor": cfg.tensor_axis,
+        "seq": cfg.seq_axis,
+        "expert": cfg.expert_axis,
+    }
+    generation = (
+        _membership.current_generation() if _membership.enabled() else 0
     )
-    log(f"[gpt] mesh {dict(mesh.shape)}, preset {cfg.preset}")
+    if generation > 0:
+        # Post-reform world: the data axis absorbs the resize (the
+        # model-parallel axes are fixed by the architecture). A world the
+        # fixed axes don't divide keeps the configured shape and fails
+        # loudly in make_mesh — the supervisor's requeue floor should
+        # have prevented it.
+        ndev = len(jax.devices())
+        fixed = (
+            axes["fsdp"] * axes["tensor"] * axes["seq"] * axes["expert"]
+        )
+        if fixed > 0 and ndev % fixed == 0:
+            axes["data"] = ndev // fixed
+    mesh = dist.make_mesh(axes)
+    log(
+        f"[gpt] mesh {dict(mesh.shape)}, preset {cfg.preset}"
+        + (f", generation {generation}" if generation else "")
+    )
     model = GPT2(model_cfg)
     tx = cfg.optimizer()
 
@@ -432,6 +478,7 @@ def _train_fsdp(
         profile = health_mod.ProfileWindow.from_env()
         lr_scale = 1.0
         fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
+        elastic = _membership.enabled()
 
         # Dispatch-ahead (ISSUE 4): up to `depth` steps run in flight;
         # the oldest step's scalars are settled (the float() host copies
@@ -517,6 +564,35 @@ def _train_fsdp(
                     mgr.wait_until_finished()
             mgr.close()
             raise Preempted(f"drained checkpoint at step {opt_step}")
+
+        def drain_reform(plan) -> None:
+            # Mesh re-form fence (ISSUE 7): hand state to the checkpoint
+            # and unwind to the generation loop. At a grow fence every
+            # member is alive, so the CURRENT step commits (the
+            # emergency-checkpoint-if-none-fresh clause); after a loss
+            # the survivors cannot assemble a full sharded checkpoint —
+            # the stranded save is abandoned and resume replays from the
+            # last FULLY committed step.
+            if plan.reason == "grow":
+                drain_window()
+                payload = {
+                    "step": state.step,
+                    "params": state.params,
+                    "opt_state": state.opt_state,
+                }
+                if cfg.ema_decay > 0.0:
+                    payload["ema_params"] = state.ema_params
+                if mgr.latest_step() != opt_step:
+                    mgr.save(
+                        opt_step, payload, metrics={},
+                        data_state=loader.state_dict(cursor["batch"]),
+                    )
+                mgr.wait_until_finished()
+            else:
+                window.clear()
+                mgr.abandon_pending()
+            mgr.close()
+            raise _membership.MeshReform(plan)
 
         def place_batch(b):
             # Runs on the prefetch thread: host→device placement onto
@@ -614,6 +690,10 @@ def _train_fsdp(
                             faults.step_boundary(opt_step)
                         if preemption_requested():
                             drain_preempt()
+                        if elastic:
+                            plan = _membership.pending_reform()
+                            if plan is not None:
+                                drain_reform(plan)
                     # Settle the tail of the window BEFORE any epoch
                     # accounting: a flagged in-flight step must roll the
                     # epoch back, never reach the history or the save.
@@ -687,11 +767,14 @@ def _train_fsdp(
                             "seed": loader.seed,
                         },
                     )
-                    if launch_attempt() > 0:
-                        # Retried attempt: commit eagerly so this epoch is
-                        # durable before the crashing step reruns (see
+                    if launch_attempt() > 0 or generation > 0:
+                        # Retried attempt or re-formed elastic generation:
+                        # commit eagerly so this epoch is durable before
+                        # the crashing step reruns (see
                         # utils.preempt.launch_attempt — deferred commits
-                        # livelock deterministic crashes).
+                        # livelock deterministic crashes; a post-reform
+                        # gang replays abandoned steps for the same
+                        # reason).
                         mgr.wait_until_finished()
                 break
             except health_mod.TrainingDiverged:
@@ -756,6 +839,23 @@ def _train_fsdp(
                     f"restored verified step {rb.target} "
                     f"(epoch {start_epoch}, lr_scale {lr_scale:g})"
                 )
+            except (_membership.MeshReform, Preempted):
+                raise
+            except Exception as e:
+                # A collective died mid-epoch. In an elastic gang a dead
+                # peer's sockets close instantly and this is the FIRST
+                # place the survivor notices — classify against the
+                # supervisor's re-form plan before giving up; a genuine
+                # error (or a non-elastic gang) re-raises unchanged.
+                if not elastic:
+                    raise
+                plan = _membership.reform_after_failure(e)
+                if plan is None:
+                    raise
+                window.clear()
+                mgr.abandon_pending()
+                mgr.close()
+                raise _membership.MeshReform(plan) from e
         if profile is not None:
             profile.close()
         mgr.wait_until_finished()
@@ -977,6 +1077,9 @@ def _train_pipeline(
         profile = health_mod.ProfileWindow.from_env()
         lr_scale = 1.0
         fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
+        from tpuflow.dist import membership as _membership
+
+        elastic = _membership.enabled()
         clock = StepClock()
         # Rolling-MFU feed (see the FSDP leg): 6·N over the pipeline-
         # sharded params, set after the clock reset the live ledger.
@@ -1048,6 +1151,25 @@ def _train_pipeline(
             mgr.close()
             raise Preempted(f"drained checkpoint at step {global_step}")
 
+        def drain_reform_fallback(plan) -> None:
+            # Pipeline state shards by LAYER slice over 'stage': a lost
+            # member removes a pipeline STAGE, which no data-axis reshard
+            # can absorb — elastic re-form degrades to the preemption
+            # requeue here (the relaunched attempt re-forms at
+            # generation 0 over whatever capacity remains). A grow fence
+            # (everyone alive) drains and commits first; after a loss the
+            # stranded save is abandoned (its commit collectives would
+            # only raise again).
+            if plan.reason == "grow":
+                drain_preempt()  # commits + raises Preempted
+            window.clear()
+            mgr.abandon_pending()
+            mgr.close()
+            raise Preempted(
+                f"mesh re-form (generation {plan.generation}) requeues "
+                "the pipeline leg"
+            )
+
         def place_batch(b):
             # Prefetch-thread placement onto the pipeline's 'data' axis.
             return {
@@ -1111,6 +1233,10 @@ def _train_pipeline(
                             faults.step_boundary(global_step)
                         if preemption_requested():
                             drain_preempt()
+                        if elastic:
+                            plan = _membership.pending_reform()
+                            if plan is not None:
+                                drain_reform_fallback(plan)
                     drain_window()
                     jax.block_until_ready(params)
                     epoch_loss = float(jnp.stack(losses).mean())
@@ -1134,9 +1260,11 @@ def _train_pipeline(
                             "seed": loader.seed,
                         },
                     )
-                    if launch_attempt() > 0:
-                        # Retried attempt: eager commit for monotonic
-                        # progress (see utils.preempt.launch_attempt).
+                    if launch_attempt() > 0 or elastic:
+                        # Retried attempt (or an elastic gang, where a
+                        # re-form may strand a deferred commit): eager
+                        # commit for monotonic progress (see
+                        # utils.preempt.launch_attempt).
                         mgr.wait_until_finished()
                 break
             except health_mod.TrainingDiverged:

@@ -42,6 +42,12 @@ from tpuflow.utils.preempt import (  # noqa: F401  (re-exported API)
     request_preemption,
 )
 
+# Elastic gang (ISSUE 7): the mesh re-form control-flow signal mirrors
+# the rollback/preemption surface above — raised at step fences when the
+# supervisor announced a new mesh generation, handled by the generation
+# loops (train.gpt, Trainer.fit).
+from tpuflow.dist.membership import MeshReform  # noqa: F401  (re-export)
+
 
 def dispatch_depth(default: int = 2) -> int:
     """Resolve the dispatch-ahead window depth (ISSUE 4).

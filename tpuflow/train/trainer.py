@@ -206,6 +206,15 @@ class TrainContext:
         and skips exactly the consumed batches (deterministic mid-epoch
         resume, ISSUE 5).
         """
+        from tpuflow.dist import membership as _membership
+
+        # Elastic gang (ISSUE 7): the report IS this loop's step fence —
+        # a pending mesh generation unwinds the loop here, BEFORE this
+        # step's metrics/save land (the re-formed loop replays it). One
+        # env lookup when not in an elastic gang.
+        plan = _membership.pending_reform()
+        if plan is not None:
+            raise _membership.MeshReform(plan)
         metrics = {
             k: (float(v) if hasattr(v, "__float__") else v)
             for k, v in metrics.items()
@@ -263,30 +272,55 @@ class TrainContext:
                         "newest committed step is clean — a gang retry "
                         "resumes from it",
                     )
-        if state is not None and self._manager is not None:
-            self._manager.save(
-                save_step, state, metrics=metrics, data_state=data_state
-            )
-            if launch_attempt() > 0:
-                # Retried attempt: commit THIS step before returning to
-                # the loop (see launch_attempt — the async deferred commit
-                # would otherwise livelock a deterministic crash: the
-                # dying step never becomes the resume point).
-                self._manager.wait_until_finished()
-        if self.run_config.storage_path and jax.process_index() == 0:
-            # Observability stream (SURVEY.md §5): one JSON line per report,
-            # aggregated on process 0, appendable/tail-able during the run.
-            with open(
-                os.path.join(self.run_config.storage_path, "metrics.jsonl"),
-                "a",
-            ) as f:
-                f.write(
-                    json.dumps({"step": save_step, "time": time.time(), **metrics})
-                    + "\n"
+        try:
+            if state is not None and self._manager is not None:
+                self._manager.save(
+                    save_step, state, metrics=metrics, data_state=data_state
                 )
-        if self.run_config.verbose:
-            logger.info("report[%d]: %s", len(self._reported), metrics)
-        dist.barrier("report")
+                if (
+                    launch_attempt() > 0
+                    or _membership.current_generation() > 0
+                ):
+                    # Retried attempt OR re-formed elastic generation:
+                    # commit THIS step before returning to the loop (see
+                    # launch_attempt — the async deferred commit would
+                    # otherwise livelock a deterministic crash: the dying
+                    # step never becomes the resume point. A post-reform
+                    # gang replays for the same reason: a shrink abandons
+                    # the stranded commit, so without eager banking a
+                    # deterministic crasher re-fires on the replayed step
+                    # and the gang oscillates shrink/grow until the
+                    # resize budget forces the requeue fallback).
+                    self._manager.wait_until_finished()
+            if self.run_config.storage_path and jax.process_index() == 0:
+                # Observability stream (SURVEY.md §5): one JSON line per
+                # report, aggregated on process 0, appendable/tail-able
+                # during the run.
+                with open(
+                    os.path.join(
+                        self.run_config.storage_path, "metrics.jsonl"
+                    ),
+                    "a",
+                ) as f:
+                    f.write(
+                        json.dumps(
+                            {"step": save_step, "time": time.time(), **metrics}
+                        )
+                        + "\n"
+                    )
+            if self.run_config.verbose:
+                logger.info("report[%d]: %s", len(self._reported), metrics)
+            dist.barrier("report")
+        except Exception as e:
+            # A dead gang peer closes its sockets instantly, so the save
+            # drain's commit collective or the report barrier raises here
+            # within milliseconds of the loss. Give the supervisor — which
+            # detects the death on its own poll — a bounded window to
+            # announce the re-form; a genuine error re-raises unchanged.
+            plan = _membership.reform_after_failure(e)
+            if plan is None:
+                raise
+            raise _membership.MeshReform(plan) from e
         # Step boundary: stamp this member's liveness for the gang
         # supervisor, give the fault harness its injection point, then
         # honor a pending preemption — the state just saved above IS the
@@ -396,6 +430,19 @@ class Trainer:
         n = sc.num_workers
         if n is None or n == -1:
             n = ndev
+        from tpuflow.dist import membership as _membership
+
+        if n != ndev and _membership.current_generation() > 0:
+            # Re-formed elastic world (ISSUE 7): the world the caller
+            # originally asked for no longer exists — the data-parallel
+            # axis absorbs the resize. (Clamping, not erroring, is the
+            # contract: a shrink must not crash the survivors into the
+            # requeue path this machinery exists to avoid.)
+            logger.info(
+                "elastic generation %d: scaling num_workers %d → %d "
+                "devices", _membership.current_generation(), n, ndev,
+            )
+            n = ndev
         if n > ndev:
             raise ValueError(f"num_workers={n} but only {ndev} devices present")
         if jax.process_count() > 1 and n != ndev:
@@ -426,19 +473,79 @@ class Trainer:
 
         _goodput.live().reset()
         _obs_export.maybe_start_from_env()
-        mesh = self._build_mesh()
-        ctx = TrainContext(mesh, self.run_config)
-        _ACTIVE_CONTEXT = ctx
+        from tpuflow.dist import membership as _membership
+
         start = time.monotonic()
-        try:
-            with obs.span(
-                "train.fit", workers=dist.data_axis_size(mesh)
-            ), mesh:
-                self.train_loop_per_worker(dict(self.train_loop_config))
-        finally:
-            _ACTIVE_CONTEXT = None
-            if ctx.checkpoint_manager is not None:
-                ctx.checkpoint_manager.wait_until_finished()
+        reported_carry: list[dict[str, Any]] = []
+        reforms = 0
+        while True:
+            mesh = self._build_mesh()
+            ctx = TrainContext(mesh, self.run_config)
+            if reported_carry:
+                # Metrics reported by earlier generations of this fit —
+                # the loop body restarted, the run did not.
+                ctx._reported = list(reported_carry)
+            _ACTIVE_CONTEXT = ctx
+            reform_plan = None
+            try:
+                with obs.span(
+                    "train.fit", workers=dist.data_axis_size(mesh),
+                    generation=_membership.current_generation(),
+                ), mesh:
+                    try:
+                        self.train_loop_per_worker(
+                            dict(self.train_loop_config)
+                        )
+                    except _membership.MeshReform as rf:
+                        reform_plan = rf.plan
+                    except Exception as e:
+                        # The loop body's own collective died (a peer's
+                        # sockets close instantly): classify against the
+                        # supervisor's plan before giving up.
+                        plan = _membership.reform_after_failure(e)
+                        if plan is None:
+                            raise
+                        reform_plan = plan
+            finally:
+                _ACTIVE_CONTEXT = None
+                if (
+                    reform_plan is None
+                    and ctx.checkpoint_manager is not None
+                ):
+                    ctx.checkpoint_manager.wait_until_finished()
+            if reform_plan is None:
+                break
+            # Mesh re-form (ISSUE 7): hand everything to the checkpoint,
+            # tear the old world down, re-rendezvous as the new
+            # generation, and re-enter the loop body — which resumes via
+            # ctx.latest_step() exactly like a requeued attempt, at
+            # step-fence cost instead of process-lifecycle cost.
+            reforms += 1
+            mgr = ctx.checkpoint_manager
+            if mgr is not None:
+                if reform_plan.reason == "grow":
+                    # Every current member is alive at a grow fence: the
+                    # deferred commit completes normally, so the grown
+                    # gang resumes from the CURRENT step (the "emergency
+                    # checkpoint if none is fresh" clause — report-driven
+                    # loops save every step, so the drain commits it).
+                    try:
+                        mgr.wait_until_finished()
+                    except Exception:
+                        mgr.abandon_pending()
+                else:
+                    # A peer died mid-save: its shards will never arrive;
+                    # the deferred commit is unfinishable. Abandon it and
+                    # resume from the last FULLY committed step.
+                    mgr.abandon_pending()
+                mgr.close()
+            reported_carry = list(ctx._reported)
+            logger.info(
+                "mesh re-form: generation %d (%s, %d members)",
+                reform_plan.generation, reform_plan.reason,
+                reform_plan.num_processes,
+            )
+            _membership.quiesce_and_reform(reform_plan)
         if self.run_config.verbose:
             logger.info(
                 "fit() finished in %.1fs (%d reports)",
@@ -459,7 +566,12 @@ class Trainer:
         # construction, extended by this attempt's saves — is continuous
         # from the first attempt's first save. Prefer it when it knows
         # more (reports without ``state=`` still fall back to _reported).
-        if mgr is not None and len(mgr._metrics_history) > len(ctx._reported):
+        # After a mesh re-form the manager's view is also the DEDUPED one:
+        # a step reported right before the loss was replayed by the next
+        # generation, so _reported can carry it twice.
+        if mgr is not None and mgr._metrics_history and (
+            reforms or len(mgr._metrics_history) > len(ctx._reported)
+        ):
             metrics_history = [dict(m) for m in mgr._metrics_history]
         else:
             metrics_history = list(ctx._reported)
