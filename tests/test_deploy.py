@@ -162,3 +162,59 @@ def test_deploy_cli_writes_manifests(isolated_home, tmp_path):
     files = os.listdir(tmp_path / "m")
     assert any(f.endswith(".jobset.yaml") for f in files)
     assert any(f.endswith(".cronjob.yaml") for f in files)
+
+
+def test_serving_deployment_manifest(tmp_path):
+    """Serving Deployment (ISSUE 8): long-lived replicas with TPU node
+    selectors, the /status readiness probe on the live-export port, the
+    TPUFLOW_SERVE_* engine shape in the pod env, and a drain grace
+    window covering serve_forever's SIGTERM drain."""
+    from tpuflow.flow.deploy import materialize_serving
+
+    files = materialize_serving(
+        "gpt2_serve",
+        str(tmp_path / "m"),
+        topology="v5e-8",
+        replicas=3,
+        metrics_port=9100,
+        max_slots=16,
+        prefill_chunk=128,
+        buckets=[64, 128, 256],
+        drain_grace_s=90,
+        env={"TPUFLOW_SERVE_DECODE_BLOCK": "16"},
+    )
+    assert sorted(os.path.basename(f) for f in files) == [
+        "gpt2-serve.deployment.yaml",
+        "gpt2-serve.service.yaml",
+    ]
+    with open(tmp_path / "m" / "gpt2-serve.deployment.yaml") as f:
+        dep = yaml.safe_load(f)
+    assert dep["kind"] == "Deployment"
+    assert dep["spec"]["replicas"] == 3
+    pod = dep["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 90
+    assert (
+        pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+        == "tpu-v5-lite-podslice"
+    )
+    (container,) = pod["containers"]
+    assert container["resources"]["limits"]["google.com/tpu"] == 4
+    probe = container["readinessProbe"]["httpGet"]
+    assert probe == {"path": "/status", "port": 9100}
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TPUFLOW_OBS_HTTP_PORT"] == "9100"
+    assert env["TPUFLOW_OBS_HTTP_HOST"] == "0.0.0.0"
+    assert env["TPUFLOW_SERVE_SLOTS"] == "16"
+    assert env["TPUFLOW_SERVE_PREFILL_CHUNK"] == "128"
+    assert env["TPUFLOW_SERVE_BUCKETS"] == "64,128,256"
+    assert env["TPUFLOW_SERVE_DECODE_BLOCK"] == "16"
+    assert env["TPUFLOW_PREEMPT_GRACE_S"] == "90"
+    # Service fronts the same selector on the same port.
+    with open(tmp_path / "m" / "gpt2-serve.service.yaml") as f:
+        svc = yaml.safe_load(f)
+    assert svc["kind"] == "Service"
+    assert svc["spec"]["selector"] == {"app": "gpt2-serve"}
+    assert svc["spec"]["ports"][0]["port"] == 9100
+    assert (
+        dep["spec"]["template"]["metadata"]["labels"]["app"] == "gpt2-serve"
+    )

@@ -300,6 +300,20 @@ def test_obs_catalog_lint():
         ("gauge", "goodput.fraction"),
         ("event", "obs.flight"),
         ("event", "obs.export"),
+        # Continuous-batching serving engine (ISSUE 8) with the right
+        # kinds (also enforced via REQUIRED_EMITTERS below — same
+        # standalone-tool/pytest-twin cross-check as the ckpt names).
+        ("gauge", "serve.queue_depth"),
+        ("gauge", "serve.slot_occupancy"),
+        ("gauge", "serve.ttft_s"),
+        ("gauge", "serve.tokens_per_s"),
+        ("counter", "serve.tokens"),
+        ("counter", "serve.requests"),
+        ("event", "serve.admit"),
+        ("event", "serve.complete"),
+        ("span", "serve.warmup"),
+        ("span", "serve.prefill"),
+        ("span", "serve.decode"),
         # Durable checkpointing (ISSUE 5) — the lint itself also enforces
         # these via REQUIRED_EMITTERS; asserting through both keeps the
         # standalone tool and the pytest twin honest about each other.

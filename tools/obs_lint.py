@@ -81,6 +81,20 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("span", "flow.gang_resize"),
     ("event", "flow.member_lost"),
     ("gauge", "dist.mesh_generation"),
+    # Serving engine (ISSUE 8): the Serving runbook's operator surface —
+    # queue depth, occupancy, TTFT, per-request decode rate, plus the
+    # admission/completion evidence trail and the AOT warm marker.
+    ("gauge", "serve.queue_depth"),
+    ("gauge", "serve.slot_occupancy"),
+    ("gauge", "serve.ttft_s"),
+    ("gauge", "serve.tokens_per_s"),
+    ("counter", "serve.tokens"),
+    ("counter", "serve.requests"),
+    ("event", "serve.admit"),
+    ("event", "serve.complete"),
+    ("span", "serve.warmup"),
+    ("span", "serve.prefill"),
+    ("span", "serve.decode"),
 )
 
 # Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records

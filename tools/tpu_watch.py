@@ -208,6 +208,18 @@ def follow(url: str, interval: float, max_s: float) -> int:
                 flush=True,
             )
         else:
+            serving = ""
+            if "serve_slot_occupancy" in st:
+                # A serving process (tpuflow.infer.serve feeds these):
+                # the operator's live queue/TTFT/throughput view.
+                serving = (
+                    f" | serve q={st.get('serve_queue_depth', '-')} "
+                    f"occ={fmt(st, 'serve_slot_occupancy', '{:.2f}')} "
+                    f"tok/s={fmt(st, 'serve_tokens_per_s', '{:.0f}')} "
+                    f"ttft50={fmt(st, 'serve_ttft_p50_s', '{:.3f}')}s "
+                    f"p99={fmt(st, 'serve_ttft_p99_s', '{:.3f}')}s "
+                    f"done={st.get('serve_requests', '-')}"
+                )
             print(
                 f"[tpu_watch {stamp}] step={st.get('step', '-')} "
                 f"rate={fmt(st, 'step_rate')}/s "
@@ -215,7 +227,7 @@ def follow(url: str, interval: float, max_s: float) -> int:
                 f"mfu={fmt(st, 'mfu', '{:.4f}')} "
                 f"goodput={fmt(st, 'goodput_fraction', '{:.3f}')} "
                 f"loss={fmt(st, 'loss', '{:.4f}')} "
-                f"up={fmt(st, 'uptime_s', '{:.0f}')}s",
+                f"up={fmt(st, 'uptime_s', '{:.0f}')}s" + serving,
                 flush=True,
             )
         time.sleep(interval)

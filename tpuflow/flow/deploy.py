@@ -315,6 +315,157 @@ def _cronjob(flow_name: str, cron: str, *, image: str, script: str) -> dict:
     }
 
 
+def serving_deployment(
+    name: str,
+    *,
+    topology: str = "v5e-8",
+    image: str = "tpuflow:latest",
+    replicas: int = 1,
+    metrics_port: int = 8080,
+    command: list[str] | None = None,
+    compute_pool: str | None = None,
+    max_slots: int | None = None,
+    prefill_chunk: int | None = None,
+    buckets: list[int] | None = None,
+    drain_grace_s: int = 120,
+    env: dict[str, str] | None = None,
+) -> dict:
+    """apps/v1 Deployment for a LONG-LIVED serving gang (ISSUE 8): each
+    replica is one single-host TPU pod running a continuous-batching
+    ``ServeEngine`` loop (``tpuflow.infer.serve.serve_forever`` — the
+    container ``command`` must build the engine and enter it).
+
+    A Deployment, not a Job: serving has no completion — replicas restart
+    forever, scale horizontally, and drain on SIGTERM
+    (``terminationGracePeriodSeconds`` covers the engine finishing its
+    live slots before the pod dies; serve_forever stops admitting the
+    moment the preemption flag is raised). The live ``/metrics`` +
+    ``/status`` exporter doubles as the readiness probe — a pod is
+    routable exactly when its engine answers — and the ``TPUFLOW_SERVE_*``
+    knobs ride the pod env so the engine shape is declared beside the
+    hardware it runs on.
+    """
+    dep_name = name.lower().replace("_", "-")
+    topo = parse_topology(topology)
+    penv = [
+        {"name": "TPUFLOW_OBS_HTTP_PORT", "value": str(metrics_port)},
+        # The probe (and a cluster scraper) come in over the pod IP.
+        {"name": "TPUFLOW_OBS_HTTP_HOST", "value": "0.0.0.0"},
+        {"name": "TPUFLOW_PREEMPT_GRACE_S", "value": str(drain_grace_s)},
+    ]
+    if max_slots is not None:
+        penv.append(
+            {"name": "TPUFLOW_SERVE_SLOTS", "value": str(max_slots)}
+        )
+    if prefill_chunk is not None:
+        penv.append(
+            {
+                "name": "TPUFLOW_SERVE_PREFILL_CHUNK",
+                "value": str(prefill_chunk),
+            }
+        )
+    if buckets:
+        penv.append(
+            {
+                "name": "TPUFLOW_SERVE_BUCKETS",
+                "value": ",".join(str(int(b)) for b in buckets),
+            }
+        )
+    for k, v in sorted((env or {}).items()):
+        penv.append({"name": str(k), "value": str(v)})
+    container = {
+        "name": dep_name,
+        "image": image,
+        "command": command
+        or ["python", "-m", "tpuflow.infer.serve"],
+        "env": penv,
+        "ports": [{"name": "metrics", "containerPort": metrics_port}],
+        "resources": {
+            "limits": {"google.com/tpu": topo["chips_per_host"]}
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/status", "port": metrics_port},
+            "periodSeconds": 5,
+        },
+    }
+    node_selector = {
+        "cloud.google.com/gke-tpu-accelerator": topo["accelerator"],
+    }
+    if topo["grid"]:
+        node_selector["cloud.google.com/gke-tpu-topology"] = topo["grid"]
+    if compute_pool:
+        node_selector["cloud.google.com/gke-nodepool"] = compute_pool
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": dep_name,
+            "annotations": {"tpuflow.dev/serving": "1"},
+        },
+        "spec": {
+            "replicas": int(replicas),
+            "selector": {"matchLabels": {"app": dep_name}},
+            "template": {
+                "metadata": {"labels": {"app": dep_name}},
+                "spec": {
+                    "nodeSelector": node_selector,
+                    "terminationGracePeriodSeconds": int(drain_grace_s),
+                    "containers": [container],
+                },
+            },
+        },
+    }
+
+
+def serving_service(name: str, *, metrics_port: int = 8080) -> dict:
+    """ClusterIP Service in front of the serving Deployment's replicas
+    (the scrape/ingress target; selector matches serving_deployment)."""
+    dep_name = name.lower().replace("_", "-")
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": dep_name},
+        "spec": {
+            "selector": {"app": dep_name},
+            "ports": [
+                {
+                    "name": "metrics",
+                    "port": metrics_port,
+                    "targetPort": metrics_port,
+                }
+            ],
+        },
+    }
+
+
+def materialize_serving(
+    name: str, out_dir: str, *, image: str = "tpuflow:latest", **kw
+) -> list[str]:
+    """Write the serving Deployment + Service YAML into ``out_dir``;
+    returns the files written (kubectl-apply shapes, like materialize)."""
+    import yaml
+
+    os.makedirs(out_dir, exist_ok=True)
+    dep_name = name.lower().replace("_", "-")
+    metrics_port = int(kw.get("metrics_port", 8080))
+    written = []
+    for fname, payload in (
+        (
+            f"{dep_name}.deployment.yaml",
+            serving_deployment(name, image=image, **kw),
+        ),
+        (
+            f"{dep_name}.service.yaml",
+            serving_service(name, metrics_port=metrics_port),
+        ),
+    ):
+        path = os.path.join(out_dir, fname)
+        with open(path, "w") as f:
+            yaml.safe_dump(payload, f, sort_keys=False)
+        written.append(path)
+    return written
+
+
 def materialize(flow_cls, out_dir: str, *, image: str = "tpuflow:latest") -> list[str]:
     """Write manifests + requirement locks for ``flow_cls`` into ``out_dir``.
 

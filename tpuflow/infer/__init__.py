@@ -19,11 +19,15 @@ from tpuflow.infer.quant import (
     teacher_forced_agreement,
 )
 from tpuflow.infer.score import best_of_n, sequence_logprob
+from tpuflow.infer.serve import ServeEngine, ServeRequest, serve_forever
 from tpuflow.infer.speculative import speculative_generate
 
 __all__ = [
     "BatchPredictor",
     "GenerationPredictor",
+    "ServeEngine",
+    "ServeRequest",
+    "serve_forever",
     "QuantDecision",
     "QuantizedModel",
     "beam_search",

@@ -17,6 +17,7 @@ dataframe join, eval_flow.py:91) is index-aligned with the input rows.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Sequence
 
 import jax
@@ -246,6 +247,17 @@ class GenerationPredictor:
         self.speculative = speculative
         self.draft_len = draft_len
         self.ngram = ngram
+        # Continuous-batching route (ISSUE 8): from the SECOND batch on,
+        # greedy non-speculative streams decode through one shared
+        # ServeEngine — per-request bucketed prefill into a persistent
+        # slot-based decode program — so a stream of varying batch shapes
+        # stops paying one compile per shape. The first batch keeps the
+        # legacy path (a single batch gains nothing from engine warmup).
+        # TPUFLOW_SERVE=0 opts out; pad_to keeps the legacy single-program
+        # contract it already guarantees; sampling/speculation are
+        # engine-incompatible (greedy-exactness is the serving contract).
+        self._serve_engine = None
+        self._batches_seen = 0
         # Long-prompt memory bound, passed through to every decode entry
         # point (generate and the speculative fast path alike). Same
         # fail-loudly-at-construction contract as the knobs above.
@@ -271,6 +283,50 @@ class GenerationPredictor:
             zero_copy=zero_copy,
         )
         return cls(model, params, **kw)
+
+    def _serve_batch(self, prompt, lens) -> "np.ndarray | None":
+        """Decode one (possibly LEFT-padded) batch through the shared
+        continuous-batching engine: each row becomes a request, outputs
+        re-assemble into the exact ``generate()`` contract — eos emitted,
+        remaining positions frozen to ``pad_id`` (greedy engine tokens are
+        bit-identical to the legacy path, pinned by tests/test_serve.py).
+        Returns None when a row doesn't fit the engine's bucket capacity
+        (bucket pads eat cache columns the dense batch wouldn't) — the
+        caller falls back to the legacy per-batch program."""
+        from tpuflow.infer.serve import ServeEngine
+
+        if self._serve_engine is None:
+            engine = ServeEngine(
+                self.model,
+                self.params,
+                prefill_chunk=self.prefill_chunk,
+                pad_id=self.pad_id,
+            )
+            engine.warmup()
+            self._serve_engine = engine
+        engine = self._serve_engine
+        B, W = prompt.shape
+        rows = [
+            np.asarray(prompt[i, W - (W if lens is None else int(lens[i])):])
+            for i in range(B)
+        ]
+        try:
+            for row in rows:
+                engine.bucket_for(row.size, self.max_new_tokens)
+        except ValueError:
+            return None
+        with obs.span(
+            "infer.generate_batch", rows=B,
+            new_tokens=self.max_new_tokens, speculative=False, serve=True,
+        ):
+            outs = engine.generate_many(
+                rows, max_new_tokens=self.max_new_tokens,
+                eos_id=self.eos_id,
+            )
+            full = np.full((B, self.max_new_tokens), self.pad_id, np.int32)
+            for i, toks in enumerate(outs):
+                full[i, : toks.size] = toks
+        return full
 
     def __call__(self, batch: dict) -> dict:
         from tpuflow.infer.generate import generate, pad_ragged
@@ -307,6 +363,17 @@ class GenerationPredictor:
             # was actually padded, so drop the lens and take the dense
             # program (faster attention masks; enables speculation).
             lens = None
+        self._batches_seen += 1
+        if (
+            self._batches_seen > 1
+            and self.temperature == 0.0
+            and not self.speculative
+            and self.pad_to is None
+            and os.environ.get("TPUFLOW_SERVE", "1") != "0"
+        ):
+            out = self._serve_batch(prompt, lens)
+            if out is not None:
+                return {"generated": out}
         if (
             self.speculative
             and lens is None

@@ -1,0 +1,678 @@
+"""Continuous-batching serving engine: persistent slot-based KV decode
+with interleaved chunked prefill.
+
+The batch predictor (``tpuflow.infer.engine``) compiles one KV program
+per batch and decodes lockstep: aggregate tokens/s collapses the moment
+requests have unequal lengths or arrive at different times, because every
+row waits for the slowest and every new shape recompiles. TPU serving
+throughput comes from the opposite design (the Gemma-on-TPU serving
+comparison, PAPERS.md): keep ONE persistently-compiled decode program
+saturated and move requests through it independently.
+
+Shape of the engine:
+
+- **Slot-based KV cache.** One fixed ``(max_slots, n_ctx)`` cache owned
+  by one compiled decode-block program. Each slot carries its own
+  ``live`` / ``length`` / ``pad`` / ``remaining`` state as (S,) operand
+  arrays — admissions, generation, and evictions are DATA, never shape,
+  so nothing recompiles. The per-row cache positions ride the model's
+  ``slot_index`` decode path (``GPT2.__call__``): row b writes its k/v
+  at its own column and its queries see ``[pad[b], length[b]]`` only, so
+  a reused slot's stale columns stay invisible.
+
+- **Chunked prefill as the admission path.** A waiting request is
+  admitted by LEFT-padding its prompt to a small set of bucket widths
+  (``pad_to`` semantics: a handful of prefill programs compile, ever)
+  and running ``chunked_prefill`` on a (1, W) row — bounding peak
+  attention memory to O(chunk x n_ctx) — then a jitted insert writes the
+  row's cache into the free slot. Prefill interleaves with decode blocks
+  at the scheduler loop, the continuous-batching core.
+
+- **Decode blocks.** Between admissions the engine runs the persistent
+  decode program: a ``lax.scan`` of ``decode_block`` single-token steps
+  over all slots at once, with per-slot eos / budget / capacity freezing
+  inside the program (one host sync per BLOCK, not per token). Greedy
+  decoding; ``decode_precision`` (PR 4) makes batched decode
+  width-independent, so every request's tokens are exactly what a solo
+  ``generate()`` of its prompt produces.
+
+- **AOT warm path.** ``warmup()`` routes through
+  ``maybe_enable_compile_cache`` and executes the decode program, the
+  insert, and every prefill bucket once, so a restarted server pays
+  cache loads instead of the measured 62.9 s compile / 125.1 s
+  wall-to-first-step gap (BENCH_r05). ``compile_stats()`` exposes the
+  jit cache sizes; after warmup they must never grow — pinned by
+  tests/test_serve.py.
+
+Knobs: ``TPUFLOW_SERVE_SLOTS`` (default 8), ``TPUFLOW_SERVE_PREFILL_CHUNK``
+(default off), ``TPUFLOW_SERVE_BUCKETS`` (comma widths; default a
+power-of-two ladder up to ``n_ctx``), ``TPUFLOW_SERVE_DECODE_BLOCK``
+(tokens per decode dispatch, default 8), ``TPUFLOW_SERVE`` (=0 keeps
+``GenerationPredictor`` on the legacy per-batch path).
+
+Telemetry (``serve.*``, catalog-enforced): queue depth, slot occupancy,
+per-request TTFT and decode tokens/s, admission/completion events,
+prefill/decode spans — riding ``tpuflow.obs`` and the live ``/metrics``
+exporter (``tpuflow.obs.export``), watchable via
+``tools/tpu_watch.py --follow``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuflow import obs
+from tpuflow.infer.generate import (
+    chunked_prefill,
+    normalize_prefill_chunk,
+    prompt_lens_to_pad_lens,
+)
+
+
+def _env_int(name: str, default: int, *, minimum: int = 1) -> int:
+    """Malformed env values fall to the default (the dispatch_depth
+    idiom: a typo'd knob must not crash a server at start)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return max(int(raw), minimum)
+    except ValueError:
+        print(
+            f"[tpuflow] malformed {name}={raw!r} (want an integer); "
+            f"using {default}"
+        )
+        return default
+
+
+def default_buckets(n_ctx: int) -> list[int]:
+    """Power-of-two prefill-width ladder, topped by ``n_ctx - 1`` (the
+    widest ADMITTABLE width: a bucket of n_ctx leaves no cache column for
+    even one generated token, since capacity is checked on the padded
+    bucket width). The whole compile set for admission prefill."""
+    top = max(n_ctx - 1, 1)
+    out: list[int] = []
+    w = min(16, top)
+    while w < top:
+        out.append(w)
+        w *= 2
+    out.append(top)
+    return out
+
+
+def resolve_buckets(n_ctx: int, buckets=None) -> list[int]:
+    """Bucket widths from the explicit arg, TPUFLOW_SERVE_BUCKETS, or the
+    default ladder — validated, deduped, ascending, capped at the widest
+    admittable width (``n_ctx - 1``)."""
+    if buckets is None:
+        raw = os.environ.get("TPUFLOW_SERVE_BUCKETS")
+        if raw:
+            try:
+                buckets = [int(x) for x in raw.split(",") if x.strip()]
+            except ValueError:
+                print(
+                    f"[tpuflow] malformed TPUFLOW_SERVE_BUCKETS={raw!r} "
+                    "(want comma-separated ints); using the default ladder"
+                )
+                buckets = None
+    if buckets is None:
+        return default_buckets(n_ctx)
+    out = sorted({int(b) for b in buckets if 1 <= int(b) <= n_ctx - 1})
+    if not out:
+        raise ValueError(
+            f"no usable prefill bucket in {buckets!r} (need 1 <= b <= "
+            f"n_ctx - 1 = {n_ctx - 1})"
+        )
+    return out
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One request's lifecycle, owned by the engine that created it."""
+
+    id: int
+    prompt: np.ndarray  # (L,) int32
+    max_new_tokens: int
+    eos_id: int | None
+    t_submit: float
+    bucket: int | None = None
+    t_admit: float | None = None
+    t_first: float | None = None
+    t_done: float | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    state: str = "queued"  # queued | running | done
+    finish_reason: str | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.state == "done"
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Submit → first generated token (the prefill logits' argmax)."""
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def decode_tokens_per_s(self) -> float | None:
+        """Post-first-token decode rate (the slot's steady-state share of
+        the batched decode program)."""
+        if self.t_done is None or self.t_first is None:
+            return None
+        n = len(self.tokens) - 1
+        dur = self.t_done - self.t_first
+        if n <= 0 or dur <= 0:
+            return None
+        return n / dur
+
+    def result(self) -> np.ndarray:
+        """Generated tokens so far (complete once ``done``)."""
+        return np.asarray(self.tokens, np.int32)
+
+
+class ServeEngine:
+    """Request-level continuous-batching engine over one model.
+
+    Greedy decoding only (the serving contract is token-exactness vs a
+    solo ``generate(temperature=0)`` of the same prompt; stochastic
+    per-request sampling would need per-slot rng plumbing that nothing
+    consumes yet). Single-process: the cache lives on the default device
+    set; on a sharded mesh the slot axis shards over 'data' through
+    GSPMD exactly like the batch predictor's batches.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int | None = None,
+        prefill_chunk: int | None = None,
+        buckets=None,
+        decode_block: int | None = None,
+        pad_id: int = 0,
+    ):
+        self.model = model
+        self.params = params
+        self.n_ctx = int(model.config.n_ctx)
+        self.max_slots = (
+            int(max_slots)
+            if max_slots is not None
+            else _env_int("TPUFLOW_SERVE_SLOTS", 8)
+        )
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
+        if prefill_chunk is None:
+            prefill_chunk = (
+                _env_int("TPUFLOW_SERVE_PREFILL_CHUNK", 0, minimum=0) or None
+            )
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.prefill_chunk = prefill_chunk
+        self.buckets = resolve_buckets(self.n_ctx, buckets)
+        self.decode_block = (
+            int(decode_block)
+            if decode_block is not None
+            else _env_int("TPUFLOW_SERVE_DECODE_BLOCK", 8)
+        )
+        if self.decode_block < 1:
+            raise ValueError(
+                f"decode_block must be >= 1, got {self.decode_block}"
+            )
+        self.pad_id = int(pad_id)
+
+        S = self.max_slots
+        self._queue: collections.deque[ServeRequest] = collections.deque()
+        self._slots: list[ServeRequest | None] = [None] * S
+        self._tok = np.zeros((S,), np.int32)
+        self._lengths = np.zeros((S,), np.int32)
+        self._pads = np.zeros((S,), np.int32)
+        self._remaining = np.zeros((S,), np.int32)
+        self._live = np.zeros((S,), bool)
+        self._eos = np.full((S,), -1, np.int32)
+        self._next_id = 0
+        self._iters = 0
+        self._completed = 0
+        self._emitted_tokens = 0
+        self._last_gauges: tuple[int, int] | None = None
+        self._cache = self._init_cache()
+
+        self._prefill = jax.jit(
+            self._prefill_fn, static_argnames=("chunk",)
+        )
+        self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+
+    # ------------------------------------------------------- jitted programs
+    def _init_cache(self):
+        """Zeroed (max_slots, n_ctx) KV cache with the model's exact cache
+        pytree (eval_shape — no compile, no garbage forward)."""
+
+        def mk(params):
+            _, variables = self.model.apply(
+                {"params": params},
+                jnp.zeros((self.max_slots, 1), jnp.int32),
+                decode=True,
+                mutable=["cache"],
+            )
+            return variables["cache"]
+
+        shapes = jax.eval_shape(mk, self.params)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+
+    def _prefill_fn(self, params, prompt, pads, *, chunk):
+        """(1, W) admission prefill → (first greedy token (1,), cache row).
+        One program per bucket width W (chunk is fixed per engine)."""
+        logits, cache = chunked_prefill(
+            self.model, params, prompt, chunk, pad_lens=pads
+        )
+        tok0 = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return tok0, cache
+
+    def _insert_fn(self, cache, row_cache, slot):
+        """Write a (1, n_ctx) prefill cache row into ``slot`` of the big
+        cache. K/V leaves are (S, n_ctx, H, D) (or (L, S, n_ctx, H, D)
+        under scan_layers — the slot axis sits 4 dims from the end);
+        scalar index leaves pass through untouched (slot mode never reads
+        them)."""
+
+        def put(big, row):
+            if big.ndim >= 4:
+                start = (0,) * (big.ndim - 4) + (slot, 0, 0, 0)
+                return jax.lax.dynamic_update_slice(
+                    big, row.astype(big.dtype), start
+                )
+            return big
+
+        return jax.tree_util.tree_map(put, cache, row_cache)
+
+    def _decode_fn(self, params, cache, tok, lengths, pads, remaining,
+                   live, eos):
+        """THE persistent decode program: ``decode_block`` single-token
+        steps over every slot, per-slot freezing inside the scan. One
+        host sync per block. Dead slots keep rewriting one cache column
+        with pad-token k/v — masked out of every live row, overwritten by
+        the next admission's insert."""
+        n_ctx = self.n_ctx
+        pad_id = self.pad_id
+
+        def one(carry, _):
+            cache, tok, lengths, remaining, live = carry
+            logits, variables = self.model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                decode=True,
+                mutable=["cache"],
+                pad_lens=pads,
+                slot_index=lengths,
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            emitted = jnp.where(live, nxt, pad_id)
+            lengths = jnp.where(live, lengths + 1, lengths)
+            remaining = jnp.where(live, remaining - 1, remaining)
+            # eos itself IS emitted (generate()'s contract); the slot
+            # freezes after it. `lengths < n_ctx` guards the NEXT write.
+            live = (
+                live
+                & (nxt != eos)
+                & (remaining > 0)
+                & (lengths < n_ctx)
+            )
+            return (
+                variables["cache"], emitted, lengths, remaining, live
+            ), emitted
+
+        (cache, tok, lengths, remaining, live), toks = jax.lax.scan(
+            one,
+            (cache, tok, lengths, remaining, live),
+            None,
+            length=self.decode_block,
+        )
+        return cache, toks.T, tok, lengths, remaining, live
+
+    # ------------------------------------------------------------ scheduling
+    def bucket_for(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Smallest bucket width holding the prompt whose padded width
+        still fits the generation budget in the cache. Bucket pads eat
+        cache columns, so the capacity check is on the BUCKET width."""
+        for w in self.buckets:
+            if prompt_len <= w and w + max_new_tokens <= self.n_ctx:
+                return w
+        raise ValueError(
+            f"no prefill bucket fits prompt_len={prompt_len} + "
+            f"max_new_tokens={max_new_tokens} within n_ctx={self.n_ctx} "
+            f"(buckets: {self.buckets})"
+        )
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        eos_id: int | None = None,
+    ) -> ServeRequest:
+        """Enqueue one request; returns its live handle. Validation is
+        eager (a request that can never fit must fail at submit, not
+        half-way through a decode block)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        bucket = self.bucket_for(prompt.size, max_new_tokens)
+        req = ServeRequest(
+            id=self._next_id,
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            eos_id=None if eos_id is None else int(eos_id),
+            t_submit=time.monotonic(),
+            bucket=bucket,
+        )
+        self._next_id += 1
+        self._queue.append(req)
+        return req
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def live_slots(self) -> int:
+        return int(self._live.sum())
+
+    def compile_stats(self) -> dict[str, int]:
+        """Jit-cache sizes of the engine's three programs. After
+        ``warmup()`` these must never grow — the never-recompile
+        contract, pinned by tests/test_serve.py."""
+        return {
+            "prefill": int(self._prefill._cache_size()),
+            "insert": int(self._insert._cache_size()),
+            "decode": int(self._decode._cache_size()),
+        }
+
+    def _free_slot(self) -> int | None:
+        for s, req in enumerate(self._slots):
+            if req is None:
+                return s
+        return None
+
+    def _admit_one(self, req: ServeRequest, slot: int) -> None:
+        now = time.monotonic()
+        req.t_admit = now
+        W = req.bucket
+        L = req.prompt.size
+        padded = np.full((1, W), self.pad_id, np.int32)
+        padded[0, W - L:] = req.prompt
+        pads = prompt_lens_to_pad_lens([L], 1, W)
+        chunk = normalize_prefill_chunk(self.prefill_chunk, W)
+        with obs.span(
+            "serve.prefill", request=req.id, bucket=W, prompt_len=int(L),
+            chunk=chunk,
+        ):
+            tok0, row_cache = self._prefill(
+                self.params, jnp.asarray(padded), pads, chunk=chunk
+            )
+            first = int(np.asarray(tok0)[0])
+        req.t_first = time.monotonic()
+        req.tokens.append(first)
+        req.state = "running"
+        obs.event(
+            "serve.admit", request=req.id, slot=slot, bucket=W,
+            prompt_len=int(L),
+            queue_wait_s=round(now - req.t_submit, 6),
+        )
+        obs.gauge("serve.ttft_s", round(req.ttft_s, 6))
+        led = obs.goodput_live()
+        led.note_serve_ttft(req.ttft_s)
+        done = (req.eos_id is not None and first == req.eos_id) or (
+            req.max_new_tokens == 1
+        )
+        self._emitted_tokens += 1
+        led.note_serve_tokens(1)
+        obs.counter("serve.tokens", 1)
+        if done:
+            self._finish(
+                req, "eos" if req.max_new_tokens > 1 else "budget"
+            )
+            return
+        self._cache = self._insert(
+            self._cache, row_cache, np.int32(slot)
+        )
+        self._slots[slot] = req
+        self._tok[slot] = first
+        self._lengths[slot] = W
+        self._pads[slot] = W - L
+        self._remaining[slot] = req.max_new_tokens - 1
+        self._live[slot] = True
+        self._eos[slot] = -1 if req.eos_id is None else req.eos_id
+
+    def _finish(self, req: ServeRequest, reason: str) -> None:
+        req.t_done = time.monotonic()
+        req.state = "done"
+        req.finish_reason = reason
+        self._completed += 1
+        rate = req.decode_tokens_per_s
+        obs.event(
+            "serve.complete", request=req.id, tokens=len(req.tokens),
+            reason=reason, ttft_s=round(req.ttft_s, 6),
+            decode_tokens_per_s=None if rate is None else round(rate, 2),
+        )
+        obs.counter("serve.requests", 1)
+        if rate is not None:
+            obs.gauge("serve.tokens_per_s", round(rate, 2))
+        obs.goodput_live().note_serve_complete()
+
+    def _emit_state_gauges(self) -> None:
+        """Queue-depth / occupancy gauges on change (plus a periodic
+        refresh) — a long idle server must not flood the event stream."""
+        state = (len(self._queue), self.live_slots)
+        if state != self._last_gauges or self._iters % 64 == 0:
+            self._last_gauges = state
+            obs.gauge("serve.queue_depth", state[0])
+            obs.gauge(
+                "serve.slot_occupancy",
+                round(state[1] / self.max_slots, 4),
+            )
+        obs.goodput_live().note_serve_state(
+            state[0], state[1], self.max_slots
+        )
+
+    def step(self, admit: bool = True) -> bool:
+        """One scheduler iteration: admit waiting requests into free
+        slots (chunked prefill), then run one decode block over the live
+        slots. Returns False when there was nothing to do (idle)."""
+        self._iters += 1
+        did = False
+        while admit and self._queue:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            self._admit_one(self._queue.popleft(), slot)
+            did = True
+        if self._live.any():
+            did = True
+            old_remaining = self._remaining.copy()
+            with obs.span("serve.decode", slots=self.live_slots) as sp:
+                (
+                    self._cache, toks, tok, lengths, remaining, live
+                ) = self._decode(
+                    self.params,
+                    self._cache,
+                    self._tok,
+                    self._lengths,
+                    self._pads,
+                    self._remaining,
+                    self._live,
+                    self._eos,
+                )
+                # The host copy of the block's tokens IS the fence.
+                # np.array (not asarray): the zero-copy view of a jax
+                # array is read-only, and admissions write these.
+                toks = np.asarray(toks)
+                self._tok = np.array(tok)
+                self._lengths = np.array(lengths)
+                self._remaining = np.array(remaining)
+                self._live = np.array(live)
+                emitted = int((old_remaining - self._remaining).sum())
+                sp.set(tokens=emitted)
+            for s, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                n = int(old_remaining[s] - self._remaining[s])
+                if n:
+                    req.tokens.extend(int(t) for t in toks[s, :n])
+                if not self._live[s]:
+                    last = req.tokens[-1] if req.tokens else None
+                    if req.eos_id is not None and last == req.eos_id:
+                        reason = "eos"
+                    elif len(req.tokens) >= req.max_new_tokens:
+                        reason = "budget"
+                    else:
+                        reason = "capacity"  # n_ctx frontier hit
+                    self._finish(req, reason)
+                    self._slots[s] = None
+            self._emitted_tokens += emitted
+            obs.goodput_live().note_serve_tokens(emitted)
+            if emitted:
+                obs.counter("serve.tokens", emitted)
+        self._emit_state_gauges()
+        return did
+
+    def run_until_idle(self, max_iters: int | None = None) -> None:
+        """Drive the scheduler until queue and slots are empty."""
+        iters = 0
+        while self._queue or self._live.any():
+            self.step()
+            iters += 1
+            if max_iters is not None and iters >= max_iters:
+                raise RuntimeError(
+                    f"engine not idle after {max_iters} iterations "
+                    f"(queue={len(self._queue)}, live={self.live_slots})"
+                )
+
+    def generate_many(
+        self,
+        prompts,
+        *,
+        max_new_tokens: int,
+        eos_id: int | None = None,
+    ) -> list[np.ndarray]:
+        """Submit every prompt, run to completion, return each request's
+        generated tokens in submit order (the batch-predictor adapter)."""
+        reqs = [
+            self.submit(p, max_new_tokens=max_new_tokens, eos_id=eos_id)
+            for p in prompts
+        ]
+        self.run_until_idle()
+        return [r.result() for r in reqs]
+
+    # ---------------------------------------------------------------- warmup
+    def warmup(self, run_dir: str | None = None) -> dict[str, int]:
+        """Compile-or-load every program the engine will ever run: the
+        decode block, the insert, and one prefill per bucket — through
+        the persistent compile cache (``maybe_enable_compile_cache``), so
+        a server restart pays cache loads, not the BENCH_r05 62.9 s
+        compile / 125.1 s wall-to-first-step gap. Executes each program
+        once on dead-slot state (guaranteed jit-cache hits afterwards;
+        the garbage forwards are masked by ``live=False`` everywhere) and
+        restores a pristine cache. Returns ``compile_stats()``."""
+        from tpuflow.dist import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache(run_dir)
+        with obs.span("serve.warmup", buckets=len(self.buckets)) as sp:
+            row_cache = None
+            for w in self.buckets:
+                chunk = normalize_prefill_chunk(self.prefill_chunk, w)
+                _, row_cache = self._prefill(
+                    self.params,
+                    jnp.zeros((1, w), jnp.int32),
+                    prompt_lens_to_pad_lens([w], 1, w),
+                    chunk=chunk,
+                )
+            if row_cache is not None:
+                # First insert: the fresh (uncommitted) init cache.
+                self._cache = self._insert(
+                    self._cache, row_cache, np.int32(0)
+                )
+            out = self._decode(
+                self.params, self._cache, self._tok, self._lengths,
+                self._pads, self._remaining, self._live, self._eos,
+            )
+            self._cache = out[0]
+            if row_cache is not None:
+                # Second insert: the steady-state signature — a cache
+                # COMMITTED by the decode program (with sharded params
+                # the jit key differs from the fresh-zeros variant; both
+                # must be warm or the first post-decode admission would
+                # recompile, breaking the never-recompile contract).
+                self._cache = self._insert(
+                    self._cache, row_cache, np.int32(0)
+                )
+            # Warmup wrote garbage k/v into slot 0's columns; every query
+            # of a future occupant is masked to its own [pad, length]
+            # window and the insert overwrites the row, but start zeroed
+            # anyway so warmup is observationally a no-op. x*0 (not a
+            # fresh zeros tree): the result stays committed exactly like
+            # every later decode/insert output, so the program signatures
+            # warmed above are the ones the serving loop replays.
+            self._cache = jax.tree_util.tree_map(
+                lambda x: x * 0, self._cache
+            )
+            jax.block_until_ready(self._cache)
+            stats = self.compile_stats()
+            sp.set(**stats)
+        return stats
+
+
+def serve_forever(
+    engine: ServeEngine,
+    *,
+    idle_sleep_s: float = 0.005,
+    max_s: float | None = None,
+    should_stop=None,
+) -> None:
+    """Long-lived serving loop reusing the gang machinery: heartbeat
+    stamps every iteration (the supervisor's stall detector works on a
+    serving gang exactly as on a training gang), the live ``/metrics`` +
+    ``/status`` exporter starts when ``TPUFLOW_OBS_HTTP_PORT`` is set,
+    and a SIGTERM preemption drains — stops admitting, finishes the live
+    slots, exits — instead of killing requests mid-decode.
+
+    ``max_s`` bounds the loop (tests / bounded jobs); ``should_stop`` is
+    an optional callable polled each iteration.
+    """
+    from tpuflow.utils import heartbeat, preempt
+
+    obs.maybe_start_export()
+    preempt.install_sigterm_handler()
+    deadline = None if max_s is None else time.monotonic() + max_s
+    draining = False
+    while True:
+        if preempt.preemption_requested():
+            draining = True
+        did = engine.step(admit=not draining)
+        heartbeat.beat(step=engine._iters)
+        if draining and not engine._live.any():
+            return
+        if should_stop is not None and should_stop():
+            return
+        if deadline is not None and time.monotonic() > deadline:
+            return
+        if not did:
+            if draining:
+                return
+            time.sleep(idle_sleep_s)

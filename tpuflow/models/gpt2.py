@@ -231,7 +231,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False, pad_lens=None,
-                 prefill: bool = False):
+                 prefill: bool = False, slot_index=None):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -255,7 +255,7 @@ class Block(nn.Module):
         k = k.reshape(B, T, cfg.n_head, head_dim)
         v = v.reshape(B, T, cfg.n_head, head_dim)
         if decode:
-            a = self._cached_attention(q, k, v, pad_lens, prec)
+            a = self._cached_attention(q, k, v, pad_lens, prec, slot_index)
         elif pad_lens is not None:
             # Ragged (LEFT-padded) batch without a cache — the scoring path:
             # pad columns are masked out of every key set and real positions
@@ -293,7 +293,8 @@ class Block(nn.Module):
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
-    def _cached_attention(self, q, k, v, pad_lens=None, precision=None):
+    def _cached_attention(self, q, k, v, pad_lens=None, precision=None,
+                          slot_index=None):
         """Fixed-size KV-cache attention (decode mode).
 
         Writes the new k/v at ``cache_index`` and attends q over the whole
@@ -305,6 +306,16 @@ class Block(nn.Module):
         ``pad_lens`` (B,) marks rows as LEFT-padded: cache columns
         ``< pad_lens[b]`` are invisible to every query of row b (ragged
         prompt batches; tpuflow.infer.generate ``prompt_lens``).
+
+        ``slot_index`` (B,) switches to PER-ROW cache positions (the
+        continuous-batching serving engine, tpuflow.infer.serve): row b's
+        k/v land at column ``slot_index[b]`` via a vmapped update, and
+        row b's queries see columns ``[pad_lens[b], slot_index[b] + t]``
+        only — so sequences of different lengths admit, decode, and evict
+        independently inside ONE compiled program, and a reused slot's
+        stale columns beyond the new sequence's frontier stay invisible.
+        The scalar ``cache_index`` is not consulted or advanced: the
+        engine owns per-slot lengths.
 
         Multi-token calls: a fresh-cache prefill (``start == 0``, no pads)
         takes the T x T fast path through the pluggable attention dispatch;
@@ -335,6 +346,31 @@ class Block(nn.Module):
         idx = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
+        if slot_index is not None:
+            def row_write(cache_row, new_row, s):
+                return jax.lax.dynamic_update_slice(
+                    cache_row, new_row, (s, 0, 0)
+                )
+
+            ck.value = jax.vmap(row_write)(
+                ck.value, k.astype(cdt), slot_index
+            )
+            cv.value = jax.vmap(row_write)(
+                cv.value, v.astype(cdt), slot_index
+            )
+            q_pos = slot_index[:, None] + jnp.arange(T)[None, :]  # (B, T)
+            k_pos = jnp.arange(cfg.n_ctx)
+            valid = (
+                k_pos[None, None, None, :] <= q_pos[:, None, :, None]
+            )  # (B, 1, T, n_ctx)
+            if pad_lens is not None:
+                valid = valid & (
+                    k_pos[None, None, None, :]
+                    >= pad_lens[:, None, None, None]
+                )
+            return _masked_attention(
+                q, ck.value, cv.value, valid, precision=precision
+            )
         start = idx.value
         ck.value = jax.lax.dynamic_update_slice(
             ck.value, k.astype(cdt), (0, start, 0, 0)
@@ -387,10 +423,10 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False, pad_lens=None,
-                 prefill: bool = False):
+                 prefill: bool = False, slot_index=None):
         return (
             Block(self.config, name="block")(
-                x, train, decode, pad_lens, prefill
+                x, train, decode, pad_lens, prefill, slot_index
             ),
             None,
         )
@@ -404,7 +440,7 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(
         self, tokens, *, train: bool = False, decode: bool = False,
-        pad_lens=None, prefill: bool = False,
+        pad_lens=None, prefill: bool = False, slot_index=None,
     ):
         """``pad_lens`` (B,) int32 marks LEFT-padded rows: row b's first
         ``pad_lens[b]`` columns are padding — their positions clamp to 0,
@@ -414,11 +450,17 @@ class GPT2(nn.Module):
         compute dtype (same-width in every decode strategy, so no
         width-dependent rounding; and it is the compute-bound decode
         call) while verify chunks and single-token steps run in
-        ``decode_dtype``."""
+        ``decode_dtype``. ``slot_index`` (B,) int32 switches decode mode
+        to PER-ROW cache positions (the serving engine's slot-based KV
+        cache): row b writes/reads at its own column, positions come
+        from ``slot_index - pad_lens``, and the model-level ``pos_index``
+        is neither consulted nor advanced."""
         cfg = self.config
         B, T = tokens.shape
         if pad_lens is not None:
             pad_lens = jnp.asarray(pad_lens, jnp.int32)
+        if slot_index is not None:
+            slot_index = jnp.asarray(slot_index, jnp.int32)
         wte = self.param(
             "wte",
             nn.initializers.normal(0.02),
@@ -441,8 +483,18 @@ class GPT2(nn.Module):
                 "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
             )
             start = pos.value
-            pos.value = start + T
-            if pad_lens is not None:
+            if slot_index is None:
+                pos.value = start + T
+            if slot_index is not None:
+                # Slot mode: per-row positions from the engine's per-slot
+                # lengths (pad columns shift them down, as in ragged
+                # decode). The shared pos_index stays untouched.
+                base = slot_index[:, None] + jnp.arange(T)[None, :]
+                if pad_lens is not None:
+                    base = base - pad_lens[:, None]
+                positions = jnp.clip(base, 0, cfg.n_ctx - 1)
+                pe = wpe[positions]  # (B, T, C)
+            elif pad_lens is not None:
                 # Left-padded rows: real positions shift down by the row's
                 # pad count (clamped — pad columns read position 0, whose
                 # output real tokens never attend to).
@@ -481,11 +533,12 @@ class GPT2(nn.Module):
                         "names are the jax.checkpoint_policies attributes"
                     ) from None
             # Args (with the module at 0): x=1, train=2, decode=3,
-            # pad_lens=4, prefill=5. train/decode/prefill are Python bools
-            # that steer tracing — static. pad_lens is a DATA array (it is
-            # a tracer during ragged decode): marking it static, as
-            # (2, 3, 4) once did, crashed every remat=True decode-mode
-            # call with TracerBoolConversionError.
+            # pad_lens=4, prefill=5, slot_index=6. train/decode/prefill
+            # are Python bools that steer tracing — static. pad_lens and
+            # slot_index are DATA arrays (tracers during ragged/slot
+            # decode): marking pad_lens static, as (2, 3, 4) once did,
+            # crashed every remat=True decode-mode call with
+            # TracerBoolConversionError.
             return nn.remat(mod, static_argnums=(2, 3, 5), policy=policy)
 
         if cfg.scan_layers:
@@ -499,12 +552,14 @@ class GPT2(nn.Module):
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,
             )
-            x, _ = blocks(cfg, name="h")(x, train, decode, pad_lens, prefill)
+            x, _ = blocks(cfg, name="h")(
+                x, train, decode, pad_lens, prefill, slot_index
+            )
         else:
             block_cls = remat_wrap(Block) if cfg.remat else Block
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h{i}")(
-                    x, train, decode, pad_lens, prefill
+                    x, train, decode, pad_lens, prefill, slot_index
                 )
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_f")(x)
         # Weight-tied LM head; logits come straight out of the MXU's f32

@@ -150,6 +150,54 @@ CATALOG: dict[str, tuple[str, str]] = {
     "infer.spec.forwards": ("counter", "speculative verify forwards"),
     "infer.spec.committed": ("counter", "tokens committed by speculation"),
     "infer.spec.acceptance": ("gauge", "realized tokens per verify forward"),
+    # --------------------------------------------------------------- serve
+    # Continuous-batching serving engine (ISSUE 8): request-level
+    # telemetry from tpuflow.infer.serve, also mirrored live on the
+    # /metrics exporter via the process ledger's serve_* snapshot keys.
+    "serve.warmup": (
+        "span",
+        "AOT warm pass at server start: decode program + insert + every "
+        "prefill bucket compiled-or-cache-loaded once (carries the jit "
+        "cache sizes — the never-recompile baseline)",
+    ),
+    "serve.prefill": (
+        "span",
+        "one admission: chunked prefill of a request's prompt at its "
+        "bucket width, fenced on the first generated token",
+    ),
+    "serve.decode": (
+        "span",
+        "one decode block of the persistent slot-based program "
+        "(decode_block tokens per live slot, one host sync)",
+    ),
+    "serve.admit": (
+        "event",
+        "a queued request entered a free slot (request, slot, bucket, "
+        "queue_wait_s)",
+    ),
+    "serve.complete": (
+        "event",
+        "a request finished (tokens, reason=eos|budget|capacity, "
+        "ttft_s, decode_tokens_per_s)",
+    ),
+    "serve.queue_depth": (
+        "gauge", "requests waiting for a free slot (sampled per iteration)"
+    ),
+    "serve.slot_occupancy": (
+        "gauge", "live fraction of the engine's fixed decode slots"
+    ),
+    "serve.ttft_s": (
+        "gauge",
+        "one request's submit → first-token latency (queue wait + "
+        "bucketed prefill)",
+    ),
+    "serve.tokens_per_s": (
+        "gauge",
+        "one completed request's post-first-token decode rate (its slot's "
+        "share of the batched decode program)",
+    ),
+    "serve.tokens": ("counter", "generated tokens served by the engine"),
+    "serve.requests": ("counter", "requests completed by the engine"),
     # ---------------------------------------------------------------- dist
     "dist.mesh_generation": (
         "gauge",

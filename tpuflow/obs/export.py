@@ -49,6 +49,15 @@ _PROM_SPEC = (
     ("tpuflow_loss", "loss", "gauge"),
     ("tpuflow_grad_norm", "grad_norm", "gauge"),
     ("tpuflow_nonfinite_steps_total", "nonfinite_steps", "counter"),
+    # Serving engine (tpuflow.infer.serve): keys only present when an
+    # engine feeds this process's ledger, omitted on training runs.
+    ("tpuflow_serve_requests_total", "serve_requests", "counter"),
+    ("tpuflow_serve_tokens_total", "serve_tokens", "counter"),
+    ("tpuflow_serve_queue_depth", "serve_queue_depth", "gauge"),
+    ("tpuflow_serve_slot_occupancy", "serve_slot_occupancy", "gauge"),
+    ("tpuflow_serve_tokens_per_s", "serve_tokens_per_s", "gauge"),
+    ("tpuflow_serve_ttft_p50_seconds", "serve_ttft_p50_s", "gauge"),
+    ("tpuflow_serve_ttft_p99_seconds", "serve_ttft_p99_s", "gauge"),
 )
 
 

@@ -303,6 +303,16 @@ class ProcessLedger:
         self.flops_per_token: float | None = None
         self.health: dict[str, float] = {}
         self.nonfinite_steps = 0
+        # Serving view (tpuflow.infer.serve feeds these each scheduler
+        # iteration); zero serve_max_slots = no engine in this process,
+        # and the snapshot omits the serve_* keys entirely.
+        self.serve_requests = 0
+        self.serve_tokens = 0
+        self.serve_queue_depth = 0
+        self.serve_live_slots = 0
+        self.serve_max_slots = 0
+        self._serve_ttfts: collections.deque = collections.deque(maxlen=512)
+        self._serve_recent: collections.deque = collections.deque(maxlen=128)
         # (monotonic, cumulative steps+reports, cumulative tokens) marks
         # for the rolling rates: the window spans the last 128 fences.
         self._recent: collections.deque = collections.deque(maxlen=128)
@@ -355,6 +365,27 @@ class ProcessLedger:
         if nonfinite:
             self.nonfinite_steps += 1
 
+    # ------------------------------------------------------------- serving
+    def note_serve_state(
+        self, queue_depth: int, live_slots: int, max_slots: int
+    ) -> None:
+        """One serving-scheduler iteration's instantaneous state."""
+        self.serve_queue_depth = int(queue_depth)
+        self.serve_live_slots = int(live_slots)
+        self.serve_max_slots = max(int(max_slots), self.serve_max_slots)
+
+    def note_serve_tokens(self, n: int) -> None:
+        if n:
+            self.serve_tokens += int(n)
+        self._serve_recent.append((time.monotonic(), self.serve_tokens))
+
+    def note_serve_ttft(self, ttft_s: float | None) -> None:
+        if isinstance(ttft_s, (int, float)):
+            self._serve_ttfts.append(float(ttft_s))
+
+    def note_serve_complete(self) -> None:
+        self.serve_requests += 1
+
     def snapshot(self) -> dict[str, Any]:
         """Point-in-time view for the export endpoint. Rolling rates come
         from the recent-fence window; MFU only when both the model FLOP
@@ -391,6 +422,28 @@ class ProcessLedger:
             "goodput_fraction": round(self.productive_s / wall, 4),
             "nonfinite_steps": self.nonfinite_steps,
         }
+        if self.serve_max_slots:
+            out["serve_requests"] = self.serve_requests
+            out["serve_tokens"] = self.serve_tokens
+            out["serve_queue_depth"] = self.serve_queue_depth
+            out["serve_slot_occupancy"] = round(
+                self.serve_live_slots / self.serve_max_slots, 4
+            )
+            if len(self._serve_recent) >= 2:
+                t_a, tok_a = self._serve_recent[0]
+                t_b, tok_b = self._serve_recent[-1]
+                if t_b > t_a:
+                    out["serve_tokens_per_s"] = round(
+                        (tok_b - tok_a) / (t_b - t_a), 2
+                    )
+            if self._serve_ttfts:
+                ts = sorted(self._serve_ttfts)
+                out["serve_ttft_p50_s"] = round(
+                    ts[len(ts) // 2], 6
+                )
+                out["serve_ttft_p99_s"] = round(
+                    ts[min(len(ts) - 1, int(len(ts) * 0.99))], 6
+                )
         if step_rate is not None:
             out["step_rate"] = round(step_rate, 4)
             out["tokens_per_s"] = round(tokens_per_s, 2)
