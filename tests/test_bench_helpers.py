@@ -94,26 +94,32 @@ def test_peak_flops_table_matches_device_kind_strings():
 
 
 def test_bench_int8_decode_leg(tiny_lm):
-    """The TPU-gated int8 decode sub-leg must be executable (CPU drive:
-    speedup is noise here, but the record shape — both modes, the gate
-    verdict, and the teacher-forced agreement stat — is pinned before
-    real chip time is spent on it)."""
+    """The int8 decode sub-leg must be executable (CPU drive: speedup is
+    noise here, but the record shape — both sub-legs under the ISSUE 9
+    names, the gate verdict, the token-agreement stat, and the fused
+    leg's dispatch record — is pinned before real chip time is spent
+    on it)."""
     model, params, cfg = tiny_lm
     prompt = np.arange(2 * 12, dtype=np.int32).reshape(2, 12) % cfg.vocab_size
     rec = bench._bench_int8_decode(model, params, prompt, n_new=8)
-    assert set(rec) == {"fp_tokens_per_s", "weight_mode_gate", "weight",
-                        "mxu"}
+    assert set(rec) == {"fp_tokens_per_s", "weight_mode_gate",
+                        "weight_only", "fused_native"}
     assert rec["fp_tokens_per_s"] > 0
     # A tiny test model sits far below the measured threshold: gated off.
     gate = rec["weight_mode_gate"]
     assert set(gate) == {"apply", "reason"}
     assert gate["apply"] is False
     assert "gated OFF" in gate["reason"]
-    for mode in ("weight", "mxu"):
+    for mode in ("weight_only", "fused_native"):
         sub = rec[mode]
         assert sub["tokens_per_s"] > 0 and sub["speedup_vs_fp"] > 0
-        assert 0.0 <= sub["teacher_forced_agreement"] <= 1.0
+        assert 0.0 <= sub["token_agreement"] <= 1.0
         assert 0.0 <= sub["greedy_seq_agreement"] <= 1.0
+    # The fused leg says which impl each hot decode shape dispatches to
+    # on this host (CPU: always the XLA int8 path).
+    impl = rec["fused_native"]["impl"]
+    assert set(impl) == {"qkv", "mlp", "lm_head"}
+    assert all(v in ("xla", "pallas") for v in impl.values())
 
 
 def test_compact_summary_is_small_and_carries_headline():
@@ -310,10 +316,10 @@ def test_compact_summary_carries_r5_perf_verdicts():
                                            "speedup": 1.6},
                         },
                         "int8": {
-                            "weight": {"speedup_vs_fp": 0.8,
-                                       "teacher_forced_agreement": 0.97},
-                            "mxu": {"speedup_vs_fp": 1.4,
-                                    "teacher_forced_agreement": 0.96},
+                            "weight_only": {"speedup_vs_fp": 0.8,
+                                            "token_agreement": 0.97},
+                            "fused_native": {"speedup_vs_fp": 1.4,
+                                             "token_agreement": 0.96},
                         },
                     },
                     "flash_attention": {"measured_crossover_T": 1024},
@@ -324,8 +330,12 @@ def test_compact_summary_carries_r5_perf_verdicts():
     s = bench._compact_summary(record, train=None)
     d = s["summary"]
     assert d["spec_decode"] == {"numerics_ok": True, "speedup": 1.6}
-    assert d["int8_mxu"] == {"speedup": 1.4, "tf_agreement": 0.96}
-    assert d["int8_weight"] == {"speedup": 0.8, "tf_agreement": 0.97}
+    assert d["int8_fused_native"] == {
+        "speedup": 1.4, "token_agreement": 0.96,
+    }
+    assert d["int8_weight_only"] == {
+        "speedup": 0.8, "token_agreement": 0.97,
+    }
     assert d["flash_crossover_T"] == 1024
     assert len(json.dumps(s)) < 1000, len(json.dumps(s))
 
@@ -341,6 +351,8 @@ def test_compact_summary_r5_verdicts_from_fresh_train():
         "decode": {
             "speculative": {"repetitive": {"numerics_ok": True,
                                            "speedup": 1.5}},
+            # Legacy r5 sub-leg name: cached evidence written before the
+            # ISSUE 9 rename must stay digest-readable.
             "int8": {"mxu": {"speedup_vs_fp": 1.3,
                              "teacher_forced_agreement": 0.98}},
         },
@@ -349,7 +361,7 @@ def test_compact_summary_r5_verdicts_from_fresh_train():
     d = bench._compact_summary(record, train)["summary"]
     assert d["train"]["fresh"] is True and d["train"]["mfu"] == 0.46
     assert d["spec_decode"] == {"numerics_ok": True, "speedup": 1.5}
-    assert d["int8_mxu"] == {"speedup": 1.3, "tf_agreement": 0.98}
+    assert d["int8_mxu"] == {"speedup": 1.3, "token_agreement": 0.98}
     assert d["flash_crossover_T"] == 2048
 
 

@@ -1,0 +1,175 @@
+"""Fused native int8 matmul (tpuflow.ops.int8_matmul, ISSUE 9): the
+bit-exactness contract between the Pallas fused kernel and the XLA
+fallback, the per-row quantization properties, and the dispatch table.
+
+The load-bearing claim: the two implementations share the SAME rounding
+(round half to even), the SAME symmetric clip, EXACT int32 accumulation
+(integer adds are associative, so K-blocked partial sums equal the
+full-K dot), and the SAME epilogue op order — so they are bit-identical,
+and an on-chip fused-vs-interceptor token disagreement is attributable
+to hardware, never to impl skew."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.ops.int8_matmul import (
+    _KERNEL_MAX_M,
+    impl_override,
+    int8_matmul,
+    kernel_supported,
+    quantize_rows,
+    resolve_int8_impl,
+    row_scales,
+)
+
+
+def _quant_weight(w, axis):
+    """Reference per-out-channel weight quantization for the tests."""
+    amax = np.abs(w).max(axis=axis, keepdims=True)
+    s = np.where(amax > 0, amax, 1.0) / 127.0
+    q = np.clip(np.round(w / s), -127, 127).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def operands():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 256)).astype(np.float32)
+    w = rng.standard_normal((256, 384)).astype(np.float32)
+    wq, ws = _quant_weight(w, axis=0)  # (K, N), per-column scales
+    return x, w, wq, ws
+
+
+def test_quantize_rows_properties():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 64)).astype(np.float32) * 10
+    x[2] = 0.0  # an all-zero row must not divide by zero
+    q, s = quantize_rows(jnp.asarray(x))
+    q, s = np.asarray(q), np.asarray(s)
+    assert q.dtype == np.int8 and s.shape == (4, 1)
+    assert np.abs(q).max() <= 127
+    # Symmetric per-row bound: |x - q*s| <= s/2 elementwise.
+    assert np.all(np.abs(x - q * s) <= s / 2 + 1e-6)
+    assert np.all(q[2] == 0)
+    # The scale formula is the shared one (row_scales).
+    np.testing.assert_array_equal(s, np.asarray(row_scales(jnp.asarray(x))))
+
+
+def test_pallas_and_xla_bit_identical_kn(operands):
+    x, w, wq, ws = operands
+    a = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                               jnp.asarray(ws), impl="xla"))
+    b = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                               jnp.asarray(ws), impl="pallas"))
+    np.testing.assert_array_equal(a, b)
+    # And both sit near the dequantized-float reference: the combined
+    # activation+weight quantization noise stays ~1% of the output scale.
+    ref = x @ (wq.astype(np.float32) * ws)
+    assert np.abs(a - ref).max() / np.abs(ref).max() < 0.02
+
+
+def test_pallas_and_xla_bit_identical_nk_lm_head_layout(operands):
+    x, w, _, _ = operands
+    wt = np.ascontiguousarray(w.T)  # (N, K): the tied-wte head layout
+    wq, ws = _quant_weight(wt, axis=-1)  # per-vocab-row scales (N, 1)
+    a = np.asarray(int8_matmul(
+        jnp.asarray(x), jnp.asarray(wq), jnp.asarray(ws),
+        w_contract_last=True, impl="xla",
+    ))
+    b = np.asarray(int8_matmul(
+        jnp.asarray(x), jnp.asarray(wq), jnp.asarray(ws),
+        w_contract_last=True, impl="pallas",
+    ))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (8, 384)
+
+
+def test_leading_dims_flatten_and_restore(operands):
+    x, _, wq, ws = operands
+    x3 = x.reshape(2, 4, 256)
+    out = np.asarray(int8_matmul(jnp.asarray(x3), jnp.asarray(wq),
+                                 jnp.asarray(ws), impl="pallas"))
+    flat = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                                  jnp.asarray(ws), impl="xla"))
+    assert out.shape == (2, 4, 384)
+    np.testing.assert_array_equal(out.reshape(8, 384), flat)
+
+
+def test_untileable_shape_falls_back_correctly(operands):
+    """Forced pallas on a shape the kernel can't tile (N % 128 != 0 —
+    e.g. GPT-2's 50257-column LM head) silently takes the XLA path with
+    identical numerics — never a crash, never different tokens."""
+    x, _, _, _ = operands
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((256, 200)).astype(np.float32)
+    wq, ws = _quant_weight(w, axis=0)
+    assert not kernel_supported(8, 256, 200)
+    a = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                               jnp.asarray(ws), impl="pallas"))
+    b = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                               jnp.asarray(ws), impl="xla"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_impl_override_context(operands):
+    """The trace-region override (what QuantizedModel.int8_impl rides)
+    steers calls that didn't pass an explicit impl."""
+    x, _, wq, ws = operands
+    with impl_override("pallas"):
+        a = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                                   jnp.asarray(ws)))
+    b = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                               jnp.asarray(ws), impl="xla"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_resolve_dispatch_table(monkeypatch):
+    monkeypatch.delenv("TPUFLOW_INT8_MATMUL", raising=False)
+    monkeypatch.delenv("TPUFLOW_INT8_KERNEL_MIN_KN", raising=False)
+    # CPU: always the XLA path under auto.
+    assert resolve_int8_impl(8, 768, 2304, backend="cpu") == "xla"
+    # TPU, tiled, big enough: the fused kernel.
+    assert resolve_int8_impl(8, 768, 2304, backend="tpu") == "pallas"
+    # Below the profitability floor: XLA.
+    assert resolve_int8_impl(8, 128, 128, backend="tpu") == "xla"
+    # Untileable N (the raw GPT-2 vocab): XLA.
+    assert resolve_int8_impl(8, 768, 50257, backend="tpu") == "xla"
+    # M outside the one-VMEM-block window: XLA.
+    assert resolve_int8_impl(4, 768, 2304, backend="tpu") == "xla"
+    assert resolve_int8_impl(
+        _KERNEL_MAX_M + 1, 768, 2304, backend="tpu"
+    ) == "xla"
+    # Env forcing beats everything, including backend.
+    monkeypatch.setenv("TPUFLOW_INT8_MATMUL", "pallas")
+    assert resolve_int8_impl(8, 128, 128, backend="cpu") == "pallas"
+    monkeypatch.setenv("TPUFLOW_INT8_MATMUL", "xla")
+    assert resolve_int8_impl(8, 768, 2304, backend="tpu") == "xla"
+    # The threshold knob moves the profitability floor.
+    monkeypatch.setenv("TPUFLOW_INT8_MATMUL", "auto")
+    monkeypatch.setenv("TPUFLOW_INT8_KERNEL_MIN_KN", "1")
+    assert resolve_int8_impl(8, 128, 128, backend="tpu") == "pallas"
+    # Malformed threshold falls to the default.
+    monkeypatch.setenv("TPUFLOW_INT8_KERNEL_MIN_KN", "banana")
+    assert resolve_int8_impl(8, 128, 128, backend="tpu") == "xla"
+
+
+def test_validation_errors(operands):
+    x, w, wq, ws = operands
+    with pytest.raises(TypeError, match="int8"):
+        int8_matmul(jnp.asarray(x), jnp.asarray(w), jnp.asarray(ws))
+    with pytest.raises(ValueError, match="contraction mismatch"):
+        int8_matmul(jnp.asarray(x[:, :128]), jnp.asarray(wq),
+                    jnp.asarray(ws))
+    with pytest.raises(ValueError, match="w_scale"):
+        int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                    jnp.asarray(ws[:, :7]))
+    with pytest.raises(ValueError, match="unknown int8 impl"):
+        int8_matmul(jnp.asarray(x), jnp.asarray(wq), jnp.asarray(ws),
+                    impl="triton")
+    # A per-tensor (size-1) scale is accepted.
+    out = int8_matmul(jnp.asarray(x), jnp.asarray(wq),
+                      jnp.asarray(np.float32(0.01)), impl="xla")
+    assert out.shape == (8, 384)

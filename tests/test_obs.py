@@ -314,6 +314,12 @@ def test_obs_catalog_lint():
         ("span", "serve.warmup"),
         ("span", "serve.prefill"),
         ("span", "serve.decode"),
+        # Native int8 decode (ISSUE 9) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
+        ("span", "serve.quant_decode"),
+        ("counter", "serve.quant_requests"),
+        ("event", "quant.decision"),
+        ("event", "quant.kernel_fallback"),
         # Durable checkpointing (ISSUE 5) — the lint itself also enforces
         # these via REQUIRED_EMITTERS; asserting through both keeps the
         # standalone tool and the pytest twin honest about each other.

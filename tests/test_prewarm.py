@@ -1,0 +1,73 @@
+"""Compile-cache prewarm (ISSUE 9 startup-latency satellite):
+``tools/prewarm_cache.py`` AOT-lowers the run's signatures into the
+persistent cache ahead of gang launch, and ``dist.seed_compile_cache``
+(called by ``flow/gang_exec`` under ``TPUFLOW_PREWARM_CACHE``) copies
+the prewarmed entries into a member's cache before any jit runs."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpuflow.dist import seed_compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_seed_compile_cache_copies_missing_only(tmp_path):
+    src = tmp_path / "prewarmed"
+    dst = tmp_path / "cache"
+    src.mkdir()
+    dst.mkdir()
+    (src / "entry_a").write_bytes(b"compiled-a")
+    (src / "entry_b").write_bytes(b"compiled-b")
+    (src / "subdir").mkdir()  # non-files are skipped, never an error
+    (dst / "entry_b").write_bytes(b"already-here")
+    assert seed_compile_cache(str(src), str(dst)) == 1
+    assert (dst / "entry_a").read_bytes() == b"compiled-a"
+    # Existing entries are NEVER overwritten (content-keyed names: same
+    # name would be same bytes from a real cache; a pre-existing entry
+    # may be in use by a running process).
+    assert (dst / "entry_b").read_bytes() == b"already-here"
+    # Idempotent; missing source is a no-op, not a launch failure.
+    assert seed_compile_cache(str(src), str(dst)) == 0
+    assert seed_compile_cache(str(tmp_path / "nope"), str(dst)) == 0
+    # Destination auto-created.
+    dst2 = tmp_path / "fresh" / "cache"
+    assert seed_compile_cache(str(src), str(dst2)) == 2
+
+
+@pytest.mark.slow
+def test_prewarm_tool_populates_cache_end_to_end(tmp_path):
+    """The tool AOT-compiles the train-step + serving signatures (fp AND
+    the int8 twin) into a chosen cache dir WITHOUT executing a step —
+    run in a subprocess because force-enabling the persistent cache on
+    CPU must not leak into this test process (the XLA:CPU AOT reloader
+    is the documented SIGABRT risk maybe_enable_compile_cache guards)."""
+    cache = tmp_path / "prewarm"
+    cache.mkdir()
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "prewarm_cache.py"),
+            "--preset", "test", "--batch", "2", "--seq-len", "32",
+            "--cache-dir", str(cache), "--buckets", "8", "--slots", "2",
+            "--decode-block", "2", "--max-new", "8", "--quant",
+            "--allow-cpu",
+        ],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    entries = [p for p in cache.iterdir() if p.is_file()]
+    assert entries, "prewarm wrote no cache entries"
+    # fp + int8 serving programs and the train step all lowered:
+    # 1 train step + 2 decodes + 2 prefills (one bucket) + 1 insert.
+    import json
+
+    rec = json.loads(out.stdout.splitlines()[0])
+    assert rec["programs_compiled"] == 6
+    assert rec["cache_entries"] == len(entries)
+    # A gang member pointed at the prewarmed dir seeds its own cache.
+    member_cache = tmp_path / "member"
+    assert seed_compile_cache(str(cache), str(member_cache)) == len(entries)

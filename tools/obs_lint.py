@@ -95,6 +95,13 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("span", "serve.warmup"),
     ("span", "serve.prefill"),
     ("span", "serve.decode"),
+    # Native int8 decode (ISSUE 9): the per-request int8 serving trail
+    # and the quantization-decision evidence the Quantization runbook
+    # reads — deleting these emitters would orphan it.
+    ("span", "serve.quant_decode"),
+    ("counter", "serve.quant_requests"),
+    ("event", "quant.decision"),
+    ("event", "quant.kernel_fallback"),
 )
 
 # Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records

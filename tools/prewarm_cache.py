@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""AOT compile-cache prewarm: pay the jit compiles BEFORE gang launch.
+
+BENCH_r05 measured compile 62.9 s and wall-to-first-step 125.1 s — over
+half the startup wall is XLA compiling programs whose shapes were known
+before the gang ever scheduled (ROADMAP item 4 startup latency). This
+tool AOT-lowers (``jit(...).lower(...).compile()``) the signatures a
+run will execute — the train step, the serving engine's decode-block
+program (fp and, with ``--quant``, the int8 twin), every bucket-prefill
+program, and the slot insert — with JAX's persistent compilation cache
+pointed at a durable directory, so the compiled executables land on
+disk without running a single step. ``flow/gang_exec`` then seeds each
+member's cache from that directory ahead of member start
+(``TPUFLOW_PREWARM_CACHE=<dir>``, rsync-style: only missing entries
+copy), so the first real step is a cache LOAD.
+
+Cache keys are HLO + compile options: the prewarmed entries hit only
+when the shapes, mesh/sharding, and jax/XLA versions match the run —
+prewarm on the same host image with the run's real ``--preset``/
+``--batch``/``--seq-len``. A mismatch is harmless (the run compiles
+normally); prewarm is an optimization, never a launch gate.
+
+Usage::
+
+    python tools/prewarm_cache.py --preset gpt2 --batch 8 --seq-len 512 \
+        --cache-dir /shared/prewarm [--no-train] [--no-serve] \
+        [--quant] [--slots 8] [--buckets 16,32,64] [--max-new 128]
+
+Then launch the gang with ``TPUFLOW_PREWARM_CACHE=/shared/prewarm``.
+
+CPU note: the persistent cache is OFF on CPU by default (the XLA:CPU
+AOT loader can abort reloading entries across machine-feature changes —
+see ``maybe_enable_compile_cache``); ``--allow-cpu`` force-enables it
+for tests and dry runs of this tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Runnable from anywhere (the gang launcher's image bake step, a shared
+# volume init container): put the repo root on sys.path like the other
+# standalone tools.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="test",
+                   help="GPT2Config.from_preset name (test|gpt2|medium)")
+    p.add_argument("--batch", type=int, default=2,
+                   help="train-step global batch rows")
+    p.add_argument("--seq-len", type=int, default=64,
+                   help="train-step sequence length")
+    p.add_argument("--cache-dir", default=None,
+                   help="cache directory (default: TPUFLOW_COMPILE_CACHE "
+                        "resolution / $TPUFLOW_HOME/compile_cache)")
+    p.add_argument("--run-dir", default=None,
+                   help="run dir for TPUFLOW_COMPILE_CACHE=run keying")
+    p.add_argument("--no-train", action="store_true",
+                   help="skip the train-step signature")
+    p.add_argument("--no-serve", action="store_true",
+                   help="skip the serving decode/prefill/insert signatures")
+    p.add_argument("--quant", action="store_true",
+                   help="also prewarm the int8 (fused-native) serving twin")
+    p.add_argument("--slots", type=int, default=None,
+                   help="serving slots (default TPUFLOW_SERVE_SLOTS/8)")
+    p.add_argument("--buckets", default=None,
+                   help="comma prefill bucket widths (default ladder)")
+    p.add_argument("--decode-block", type=int, default=None,
+                   help="serving decode-block tokens")
+    p.add_argument("--max-new", type=int, default=128,
+                   help="capacity headroom the bucket ladder must keep")
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="force-enable the persistent cache on CPU (tests)")
+    return p.parse_args(argv)
+
+
+def prewarm(args) -> dict:
+    # Env staging must precede backend-touching imports/config.
+    if args.allow_cpu:
+        os.environ["TPUFLOW_COMPILE_CACHE_CPU"] = "1"
+    if args.cache_dir:
+        os.environ["TPUFLOW_COMPILE_CACHE"] = args.cache_dir
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.dist import maybe_enable_compile_cache
+
+    cache_dir = maybe_enable_compile_cache(args.run_dir)
+    if cache_dir is None:
+        raise SystemExit(
+            "[prewarm] persistent compile cache is disabled here "
+            "(TPUFLOW_COMPILE_CACHE=0, or a CPU platform without "
+            "--allow-cpu) — nothing to prewarm into"
+        )
+    # Prewarm wants EVERY program persisted, including ones under the
+    # default min-compile-time threshold (the whole point is that the
+    # run skips even the small compiles). Old jax without the knobs:
+    # the defaults still persist the expensive programs.
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):
+            pass
+
+    from tpuflow.models.gpt2 import GPT2, GPT2Config
+
+    t0 = time.monotonic()
+    cfg = GPT2Config.from_preset(args.preset, seq_len=args.seq_len)
+    model = GPT2(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(
+        rng, jnp.zeros((1, min(8, cfg.n_ctx)), jnp.int32)
+    )["params"]
+    programs = 0
+
+    if not args.no_train:
+        from tpuflow.train.optim import make_optimizer
+        from tpuflow.train.step import create_train_state, make_train_step
+
+        state = create_train_state(
+            model, rng, jnp.zeros((1, args.seq_len), jnp.int32),
+            make_optimizer(3e-4),
+        )
+        batch = {
+            "x": jnp.zeros((args.batch, args.seq_len), jnp.int32),
+            "y": jnp.zeros((args.batch, args.seq_len), jnp.int32),
+        }
+        step = jax.jit(make_train_step(), donate_argnums=(0,))
+        # lower().compile() goes through the same backend compile path
+        # the hot loop's first step would — the executable lands in the
+        # persistent cache without executing anything.
+        step.lower(state, batch, rng).compile()
+        programs += 1
+
+    if not args.no_serve:
+        import functools
+
+        from tpuflow.infer.generate import (
+            normalize_prefill_chunk,
+            prompt_lens_to_pad_lens,
+        )
+        from tpuflow.infer.serve import ServeEngine
+
+        buckets = (
+            [int(b) for b in args.buckets.split(",")]
+            if args.buckets else None
+        )
+        engine = ServeEngine(
+            model, params,
+            max_slots=args.slots,
+            buckets=buckets,
+            decode_block=args.decode_block,
+            quant="fused_native" if args.quant else None,
+        )
+        pairs = [(engine._prefill, engine._decode, engine.params)]
+        if args.quant:
+            pairs.append(
+                (engine._prefill_q, engine._decode_q, engine._qparams)
+            )
+        row_shape = None
+        for prefill, decode, prm in pairs:
+            decode.lower(
+                prm, engine._cache, engine._tok, engine._lengths,
+                engine._pads, engine._remaining, engine._live, engine._eos,
+            ).compile()
+            programs += 1
+            for w in engine.buckets:
+                if w + args.max_new > engine.n_ctx:
+                    continue  # bucket the run could never admit into
+                chunk = normalize_prefill_chunk(engine.prefill_chunk, w)
+                pf_args = (
+                    prm,
+                    jnp.zeros((1, w), jnp.int32),
+                    prompt_lens_to_pad_lens([w], 1, w),
+                )
+                prefill.lower(*pf_args, chunk=chunk).compile()
+                programs += 1
+                row_shape = jax.eval_shape(
+                    functools.partial(prefill, chunk=chunk), *pf_args
+                )[1]
+        if row_shape is not None:
+            # The insert signature (abstract row cache from eval_shape —
+            # no prefill ever executes). The decode-committed second
+            # signature only diverges under sharded params; the
+            # engine's own warmup() covers it at server start.
+            engine._insert.lower(
+                engine._cache, row_shape, jnp.int32(0)
+            ).compile()
+            programs += 1
+
+    try:
+        entries = len([
+            f for f in os.listdir(cache_dir)
+            if os.path.isfile(os.path.join(cache_dir, f))
+        ])
+    except OSError:
+        entries = 0
+    return {
+        "cache_dir": cache_dir,
+        "programs_compiled": programs,
+        "cache_entries": entries,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "backend": jax.default_backend(),
+        "preset": args.preset,
+    }
+
+
+def main(argv=None) -> int:
+    rec = prewarm(_parse(argv if argv is not None else sys.argv[1:]))
+    print(json.dumps(rec))
+    print(
+        f"[prewarm] {rec['programs_compiled']} programs -> "
+        f"{rec['cache_entries']} cache entries in {rec['cache_dir']} "
+        f"({rec['wall_s']}s); launch gangs with "
+        f"TPUFLOW_PREWARM_CACHE={rec['cache_dir']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

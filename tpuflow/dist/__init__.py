@@ -30,6 +30,7 @@ from tpuflow.dist.mesh import (
     process_index,
     replicate,
     replicated,
+    seed_compile_cache,
     serialize_steps,
     step_fence,
     shard_batch,
@@ -60,6 +61,7 @@ __all__ = [
     "process_index",
     "replicate",
     "replicated",
+    "seed_compile_cache",
     "serialize_steps",
     "step_fence",
     "shard_batch",

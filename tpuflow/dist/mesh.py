@@ -143,6 +143,43 @@ def maybe_enable_compile_cache(run_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+def seed_compile_cache(src_dir: str, cache_dir: str) -> int:
+    """Rsync-style one-way seed of a prewarmed persistent compile cache
+    (ISSUE 9 startup-latency satellite): copy every cache entry from
+    ``src_dir`` (written ahead of time by ``tools/prewarm_cache.py``)
+    into ``cache_dir`` that isn't already there. Entries are
+    content-keyed by XLA (filename = hash of HLO + compile options), so
+    an existing name IS the same bytes — never overwritten, and a
+    half-copied file can't poison the cache because the copy goes
+    through a temp name + atomic rename. Returns the number of entries
+    copied; missing/unreadable source dirs are a no-op (prewarm is an
+    optimization, never a launch gate)."""
+    import shutil
+
+    copied = 0
+    try:
+        names = sorted(os.listdir(src_dir))
+    except OSError:
+        return 0
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return 0
+    for name in names:
+        src = os.path.join(src_dir, name)
+        dst = os.path.join(cache_dir, name)
+        if not os.path.isfile(src) or os.path.exists(dst):
+            continue
+        try:
+            tmp = f"{dst}.seed.{os.getpid()}.tmp"
+            shutil.copy2(src, tmp)
+            os.replace(tmp, dst)
+            copied += 1
+        except OSError:
+            continue  # best effort: a bad entry just compiles normally
+    return copied
+
+
 def force_cpu_platform(n_devices: int = 8, *, exact: bool = False) -> None:
     """Select an n-device host-CPU JAX platform, if backends aren't up yet.
 

@@ -180,20 +180,28 @@ class GenerationPredictor:
         if quantize is not None:
             # Explicit modes are FORCED — 'int8' (weight-only at rest; a
             # memory-capacity ask the throughput gate must not override)
-            # and 'int8-mxu' (W8A8 dynamic activation quantization).
-            # 'auto' delegates to the measured policy (quant_decision):
-            # weight-only only above the size threshold where it pays
-            # (0.76x vs fp at 124M/b8 on chip, r4), fp otherwise. The
-            # verdict lands on ``self.quant_decision`` either way; the
-            # wrapper is a drop-in static model, everything below is
-            # unchanged.
+            # and 'int8-native' (fused-native W8A8: dynamic activation
+            # quantization + int8 MXU matmuls + int8 LM head through
+            # tpuflow.ops.int8_matmul; 'int8-mxu' is the pre-ISSUE-9
+            # spelling of the same path). 'auto' delegates to the
+            # measured policy (quant_decision): weight-only only above
+            # the size threshold where it pays (0.76x vs fp at 124M/b8
+            # on chip, r4), fp otherwise. The verdict lands on
+            # ``self.quant_decision`` either way; the wrapper is a
+            # drop-in static model, everything below is unchanged —
+            # including the shared-ServeEngine route, which decodes the
+            # quantized model through the same persistent slot programs.
             from tpuflow.infer.quant import (
                 maybe_quantize,
                 quant_decision,
                 quantize_model,
             )
 
-            modes = {"int8": "weight", "int8-mxu": "mxu"}
+            modes = {
+                "int8": "weight",
+                "int8-mxu": "mxu",
+                "int8-native": "mxu",
+            }
             if quantize == "auto":
                 model, params, self.quant_decision = maybe_quantize(
                     model, params, mode="weight"
@@ -296,11 +304,16 @@ class GenerationPredictor:
         from tpuflow.infer.serve import ServeEngine
 
         if self._serve_engine is None:
+            # quant=False explicitly: the predictor already applied its
+            # own quantize= policy to model/params, so the engine must
+            # not ALSO arm per-request int8 from TPUFLOW_SERVE_QUANT —
+            # it would double-quantize (and refuse the wrapped model).
             engine = ServeEngine(
                 self.model,
                 self.params,
                 prefill_chunk=self.prefill_chunk,
                 pad_id=self.pad_id,
+                quant=False,
             )
             engine.warmup()
             self._serve_engine = engine

@@ -372,12 +372,22 @@ def generate(
         # obs off the call returns the in-flight arrays untouched).
         import time
 
+        from tpuflow.infer.quant import QuantizedModel
+
         out = jax.block_until_ready(out)
         dur = time.monotonic() - t0
         n = B * max_new_tokens
+        # The numeric path is part of the measurement's identity: a
+        # tokens/s record that doesn't say fp vs int8 (and which int8
+        # mode) can't be compared across runs — the bench's sub-legs
+        # and the serving telemetry both key on it (ISSUE 9).
+        quant = (
+            model.mode if isinstance(model, QuantizedModel) else "fp"
+        )
         rec.record(
             "span", "infer.generate", ts=ts0, dur_s=dur, batch=B,
             prompt_len=T, new_tokens=max_new_tokens,
             tokens_per_s=n / dur if dur > 0 else 0.0,
+            quant=quant,
         )
     return out

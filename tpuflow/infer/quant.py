@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for the decode path.
+"""int8 quantization for the decode path: weight-only and fused native.
 
 Autoregressive decode is HBM-bandwidth-bound: every emitted token
 streams the full weight set through the chip (the bench's decode leg is
@@ -7,33 +7,40 @@ with per-channel scales cuts that stream 4x vs f32 (2x vs bf16) — a
 direct decode-throughput lever on TPU, where the MXU natively consumes
 low-precision operands.
 
-Design (TPU/XLA-first):
+Two modes, one wrapper:
 
-- **Quantize once, outside jit**: ``quantize_params`` walks the param
-  pytree and replaces big floating matrices with ``QuantLeaf(q, scale)``
-  — int8 values + a per-channel f32 scale (symmetric, max-abs / 127,
-  reduced over every axis but the last; biases, norms, and small leaves
-  stay exact).
-- **Dequantize inside the compiled program**: ``QuantizedModel`` wraps
-  any Flax model and rebuilds float weights *inside* ``apply`` — i.e.
-  inside the caller's jit trace — as ``q.astype(dtype) * scale``. At
-  rest (and across host→device transfer) only int8 bytes exist.
-  CAVEAT, measured on-chip (r4, TPU_EVIDENCE.json decode.int8 = 0.76x
-  vs fp at 124M/b8): XLA fusions do not cross dot boundaries, so the
-  dequantized weights CAN materialize as a per-step bf16 buffer —
-  convert+scale+write+read on top of the matmul — making weight-only
-  int8 a *memory capacity* feature (half/quarter-sized resident
-  weights, cheap transfer), not a decode-throughput feature, at small
-  model sizes. A throughput win needs either much larger models (where
-  the resident-set halving keeps weights HBM-side at all) or a true
-  int8-operand MXU matmul (dynamic activation quantization), which is
-  future work.
-- **Zero integration surface**: the wrapper exposes ``apply`` and
-  ``config`` — exactly what ``generate`` / ``beam_search`` /
-  ``speculative_generate`` / ``score`` use — and is hashable, so it
-  rides the same ``static_argnums`` slot the raw model does. Every
-  decode feature (ragged prompts, chunked prefill, eos freezing, KV
-  cache) works unchanged.
+- ``mode='weight'`` (alias ``weight_only``) — **quantize once, outside
+  jit** (``quantize_params``: big floating matrices become
+  ``QuantLeaf(q, scale)``), **dequantize inside the compiled program**
+  (``QuantizedModel.apply`` rebuilds floats inside the caller's jit
+  trace). At rest only int8 bytes exist. CAVEAT, measured on-chip (r4,
+  TPU_EVIDENCE.json decode.int8 = 0.76x vs fp at 124M/b8): XLA fusions
+  do not cross dot boundaries, so the dequantized weights CAN
+  materialize as a per-step bf16 buffer — convert+scale+write+read on
+  top of the matmul — making weight-only int8 a *memory capacity*
+  feature (half/quarter-sized resident weights, cheap transfer), not a
+  decode-throughput feature, at small model sizes.
+- ``mode='mxu'`` (alias ``fused_native``) — the **native int8 compute
+  path** that 0.76x number motivated (ROADMAP item 4): Dense kernels
+  AND the LM head stay int8 *through the matmul*. Activations are
+  dynamically quantized per row at the matmul boundary, the contraction
+  runs int8 x int8 -> int32 on the MXU, and the combined
+  ``act_scale (x) weight_scale`` dequant folds into the epilogue — one
+  fused op (``tpuflow.ops.int8_matmul``: Pallas fused
+  quantize-matmul-dequant kernel where the shape profits, XLA int8
+  ``dot_general`` everywhere else, bit-identical numerics between the
+  two). No dequantized weight copy ever materializes. The LM head rides
+  a ``wte_q`` sibling leaf (per-vocab-row scales) that
+  ``QuantizedModel.apply`` hands the model as the ``quant`` collection
+  — the param tree stays a derived VIEW of the fp checkpoint, never a
+  fork of it (checkpoints keep restoring unchanged).
+
+**Zero integration surface** either way: the wrapper exposes ``apply``
+and ``config`` — exactly what ``generate`` / ``beam_search`` /
+``speculative_generate`` / ``score`` / ``ServeEngine`` use — and is
+hashable, so it rides the same ``static_argnums`` slot the raw model
+does. Every decode feature (ragged prompts, chunked prefill, eos
+freezing, KV cache, serving slots) works unchanged.
 
 No parity counterpart in the reference (its engine serves f32 torch
 modules); this is a TPU-first capability on top of the D12 engine.
@@ -146,14 +153,20 @@ def quantized_nbytes(qparams) -> int:
 
 def _int8_dense_interceptor(next_fun, args, kwargs, context):
     """Flax method interceptor implementing W8A8 Dense: when the bound
-    kernel is a ``QuantLeaf``, dynamically quantize the activations
-    per-row (symmetric max-abs/127) and run an int8 x int8 -> int32
-    ``dot_general`` — the contraction the MXU executes natively at 2x
-    its bf16 rate on v5e — then rescale in f32 and cast to the module's
-    compute dtype. Weights never materialize as a bf16 buffer (the
-    r4-measured failure mode of the dequantize-into-matmul path:
-    convert+scale+write+read cost 0.76x vs fp at 124M/b8)."""
+    kernel is a ``QuantLeaf``, route the matmul through the shared fused
+    op (``tpuflow.ops.int8_matmul``) — dynamic per-row activation
+    quantization, int8 x int8 -> int32 on the MXU (the contraction the
+    chip executes natively at 2x its bf16 rate on v5e), and the combined
+    ``act_scale (x) weight_scale`` dequant folded into the epilogue.
+    Weights never materialize as a bf16 buffer (the r4-measured failure
+    mode of the dequantize-into-matmul path: convert+scale+write+read
+    cost 0.76x vs fp at 124M/b8). The op dispatches to its Pallas fused
+    kernel or the XLA int8 ``dot_general`` per shape
+    (``TPUFLOW_INT8_MATMUL`` / ``resolve_int8_impl``) — the two are
+    bit-identical, so the choice never shifts tokens."""
     import flax.linen as nn
+
+    from tpuflow.ops.int8_matmul import int8_matmul
 
     mod = context.module
     if (
@@ -175,19 +188,9 @@ def _int8_dense_interceptor(next_fun, args, kwargs, context):
             "use mode='weight'"
         )
     (x,) = args
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    s_x = jnp.where(amax > 0.0, amax, 1.0) / 127.0
-    xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
-    acc = jax.lax.dot_general(
-        xq,
-        kernel.q,
-        (((xq.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
+    out = int8_matmul(
+        x, kernel.q, kernel.scale, out_dtype=jnp.float32
     )
-    # Epilogue in f32: per-row activation scale x per-out-channel weight
-    # scale; XLA fuses this elementwise chain into the dot's output.
-    out = acc.astype(jnp.float32) * s_x * kernel.scale.astype(jnp.float32)
     if mod.use_bias:
         out = out + mod.get_variable("params", "bias").astype(jnp.float32)
     return out.astype(mod.dtype or x.dtype)
@@ -198,12 +201,18 @@ class QuantizedModel:
     """Hashable shim exposing the two surfaces the decode stack uses
     (``apply`` + ``config``). Two modes:
 
-    - ``mode='weight'``: every large leaf is int8 at rest; float weights
-      are rebuilt inside the traced apply (memory-capacity feature).
-    - ``mode='mxu'``: Dense kernels stay int8 *through the matmul* —
-      activations are dynamically quantized per-row and the contraction
-      runs int8 x int8 -> int32 on the MXU (W8A8). Non-Dense leaves
-      (embeddings, norms) are exact floats.
+    - ``mode='weight'`` (alias ``weight_only``): every large leaf is
+      int8 at rest; float weights are rebuilt inside the traced apply
+      (memory-capacity feature).
+    - ``mode='mxu'`` (alias ``fused_native``): Dense kernels stay int8
+      *through the matmul* — activations are dynamically quantized
+      per-row and the contraction runs int8 x int8 -> int32 on the MXU
+      (W8A8) via ``tpuflow.ops.int8_matmul``. A ``wte_q`` sibling leaf
+      (when the model has a big tied ``wte``) carries the int8 LM head
+      with per-vocab-row scales; apply hands it to the model as the
+      ``quant`` collection, so the ``params`` tree the model sees keeps
+      the exact fp structure it was initialized with. Non-Dense leaves
+      (embedding gather, norms) are exact floats.
 
     Use: ``qm, qp = quantize_model(model, params)`` then pass
     ``(qm, qp)`` anywhere ``(model, params)`` went."""
@@ -211,13 +220,35 @@ class QuantizedModel:
     model: Any
     dtype: Any = None  # compute dtype for dequantized weights
     mode: str = "weight"
+    # Pin of the int8 matmul implementation ('xla' | 'pallas'; None =
+    # per-shape auto dispatch). Part of this hashable static arg, so two
+    # wrappers pinned differently compile separate programs — the
+    # fused-kernel-vs-interceptor numerics tests key on exactly that.
+    int8_impl: str | None = None
 
     def apply(self, variables, *args, **kwargs):
         import flax.linen as nn
 
+        from tpuflow.ops.int8_matmul import impl_override
+
         if self.mode == "mxu":
-            with nn.intercept_methods(_int8_dense_interceptor):
-                return self.model.apply(variables, *args, **kwargs)
+            import collections.abc
+
+            params = variables.get("params", {})
+            if isinstance(params, collections.abc.Mapping) and (
+                "wte_q" in params
+            ):
+                # The quantized LM head travels inside the qparams tree
+                # (one tree to device_put / shard / pass around) but the
+                # model consumes it as its own collection — the params
+                # structure the module tree declares stays untouched.
+                variables = dict(variables)
+                params = dict(params)
+                variables["quant"] = {"wte_q": params.pop("wte_q")}
+                variables["params"] = params
+            with impl_override(self.int8_impl):
+                with nn.intercept_methods(_int8_dense_interceptor):
+                    return self.model.apply(variables, *args, **kwargs)
         variables = dict(variables)
         variables["params"] = dequantize_params(
             variables["params"], self.dtype
@@ -229,12 +260,41 @@ class QuantizedModel:
         return self.model.config
 
 
-def _quantize_dense_kernels(params, *, min_size: int):
+# Mode aliases: the bench's sub-leg names (weight_only / fused_native)
+# resolve to the same two internal modes, so callers can speak either
+# vocabulary (ISSUE 9: the bench records sub-legs under the alias names).
+_MODE_ALIASES = {
+    "weight": "weight",
+    "weight_only": "weight",
+    "mxu": "mxu",
+    "native": "mxu",
+    "fused_native": "mxu",
+}
+
+
+def canonical_mode(mode: str) -> str:
+    """'weight' | 'mxu' from any accepted spelling; loud on unknowns."""
+    try:
+        return _MODE_ALIASES[mode]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"unknown quantization mode {mode!r}; supported: "
+            f"{sorted(_MODE_ALIASES)}"
+        ) from None
+
+
+def _quantize_dense_kernels(params, *, min_size: int, head: bool = True):
     """Quantize ONLY Dense-consumed ``kernel`` leaves (2-D, or 3-D
     scan-stacked — ``nn.scan`` slices the QuantLeaf's q and scale along
-    the layer axis together). Everything else stays exact float: the
-    mxu interceptor handles Dense calls only, so a quantized non-Dense
-    leaf would flow into ordinary float ops as a NamedTuple and fail."""
+    the layer axis together), plus — when ``head`` and the tree has a
+    big top-level ``wte`` — an int8 LM-head view ``wte_q`` with
+    PER-VOCAB-ROW scales (the head contracts ``wte``'s last axis, so
+    per-out-channel there means per vocab row, not the per-column
+    layout ``quantize_params`` would pick). ``wte`` itself stays exact
+    float: the embedding gather reads it directly. Everything else
+    stays exact float too: the mxu interceptor handles Dense calls
+    only, so a quantized non-Dense leaf would flow into ordinary float
+    ops as a NamedTuple and fail."""
 
     def one(path, leaf):
         names = [str(getattr(p, "key", getattr(p, "name", ""))) for p in path]
@@ -251,27 +311,49 @@ def _quantize_dense_kernels(params, *, min_size: int):
         # the QuantLeaf comes back directly.
         return quantize_params(x, min_size=min_size)
 
-    return jax.tree_util.tree_map_with_path(one, params)
+    out = jax.tree_util.tree_map_with_path(one, params)
+    if head:
+        try:
+            wte = jnp.asarray(params["wte"])
+        except (KeyError, TypeError, IndexError):
+            wte = None
+        if (
+            wte is not None
+            and wte.ndim == 2
+            and wte.size >= min_size
+            and jnp.issubdtype(wte.dtype, jnp.floating)
+        ):
+            from tpuflow.ops.int8_matmul import quantize_rows
+
+            q, scale = quantize_rows(wte)
+            out = dict(out)
+            out["wte_q"] = QuantLeaf(q, scale)
+    return out
 
 
 def quantize_model(
-    model, params, *, min_size: int = 4096, dtype=None, mode: str = "weight"
+    model, params, *, min_size: int = 4096, dtype=None,
+    mode: str = "weight", head: bool = True, int8_impl: str | None = None,
 ):
     """One-call form: returns ``(QuantizedModel, qparams)`` ready for
-    ``generate(qm, qp, ...)`` / ``BatchPredictor`` / beam / speculative.
+    ``generate(qm, qp, ...)`` / ``BatchPredictor`` / beam / speculative
+    / ``ServeEngine``.
 
-    ``mode='weight'`` quantizes every large leaf and dequantizes inside
-    jit; ``mode='mxu'`` quantizes Dense kernels only and keeps them int8
-    through the matmul (dynamic activation quantization, W8A8)."""
+    ``mode='weight'`` (alias ``weight_only``) quantizes every large leaf
+    and dequantizes inside jit; ``mode='mxu'`` (alias ``fused_native``)
+    quantizes Dense kernels + the LM head (``head=False`` opts the head
+    out) and keeps them int8 through the matmul (dynamic activation
+    quantization, W8A8 — ``tpuflow.ops.int8_matmul``). ``int8_impl``
+    pins the op's implementation ('xla' | 'pallas') for every matmul
+    this wrapper traces; default per-shape auto dispatch."""
+    mode = canonical_mode(mode)
     if mode == "mxu":
         return (
-            QuantizedModel(model, dtype, mode),
-            _quantize_dense_kernels(params, min_size=min_size),
+            QuantizedModel(model, dtype, mode, int8_impl),
+            _quantize_dense_kernels(params, min_size=min_size, head=head),
         )
-    if mode != "weight":
-        raise ValueError(f"unknown quantization mode {mode!r}")
     return (
-        QuantizedModel(model, dtype, mode),
+        QuantizedModel(model, dtype, mode, int8_impl),
         quantize_params(params, min_size=min_size),
     )
 
@@ -301,10 +383,11 @@ class QuantDecision:
 def quant_decision(params, *, mode: str = "weight") -> QuantDecision:
     """Policy gate for ``quantize_model``: weight-only quantization is
     OFF below ``WEIGHT_QUANT_MIN_BYTES`` of float weights (measured
-    throughput regression, see constant above); mxu (W8A8) mode is
-    ungated — its int8 operands never materialize as floats, so it has
-    no size floor (each bench records its measured speedup alongside
-    the teacher-forced agreement)."""
+    throughput regression, see constant above); mxu (fused-native W8A8)
+    mode is ungated — its int8 operands never materialize as floats, so
+    it has no size floor (each bench records its measured speedup
+    alongside the teacher-forced agreement)."""
+    mode = canonical_mode(mode)
     nbytes = sum(
         leaf.nbytes
         for leaf in jax.tree_util.tree_leaves(params)
@@ -313,7 +396,8 @@ def quant_decision(params, *, mode: str = "weight") -> QuantDecision:
     if mode == "mxu":
         return QuantDecision(
             True, mode,
-            "mxu (W8A8) mode: int8 operands feed the MXU directly, no "
+            "fused-native (mxu, W8A8) mode: int8 operands feed the MXU "
+            "directly through the fused quantize-matmul-dequant path, no "
             "dequant materialization — ungated at any size",
             nbytes,
         )
@@ -338,8 +422,19 @@ def quant_decision(params, *, mode: str = "weight") -> QuantDecision:
 def maybe_quantize(model, params, *, mode: str = "weight", dtype=None):
     """Gated form of ``quantize_model``: consults ``quant_decision`` and
     returns ``(model, params, decision)`` — unchanged model/params when
-    the gate says quantization loses at this size."""
+    the gate says quantization loses at this size. The verdict is
+    recorded on the telemetry stream (``quant.decision``) so a run's
+    events say which numeric path its decode actually took."""
     decision = quant_decision(params, mode=mode)
+    from tpuflow import obs
+
+    obs.event(
+        "quant.decision",
+        apply=decision.apply,
+        mode=decision.mode,
+        weight_mib=round(decision.weight_bytes / 2**20, 1),
+        reason=decision.reason,
+    )
     if not decision.apply:
         return model, params, decision
     qm, qp = quantize_model(model, params, mode=mode, dtype=dtype)

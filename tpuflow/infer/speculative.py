@@ -277,6 +277,15 @@ def speculative_generate(
     bench FAILS loudly (exit 3) on a fresh on-chip mismatch rather than
     recording a null speedup.
 
+    Composes with fused-native int8 (``quantize_model(mode='mxu')``,
+    ISSUE 9): the quantized matmuls are integer contractions with exact
+    accumulation, so THEY are width-independent by construction — the
+    (K+1)-chunk verify forward and single-token decode quantize each
+    token's activations identically (per-row = per-token) and the int8
+    dot cannot round differently across chunk widths. The remaining
+    width-sensitive ops (LayerNorm, softmax, residual adds) stay under
+    the same decode_dtype/decode_precision pins as the fp path.
+
     ``prompt``: dense (B, T) int32 (ragged batches: decode rows
     separately, or use ``generate``). ``ngram`` is the match-key length
     + 1 (3 = match on the trailing 2-gram). Returns (B, max_new_tokens);

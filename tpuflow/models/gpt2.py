@@ -562,6 +562,24 @@ class GPT2(nn.Module):
                     x, train, decode, pad_lens, prefill, slot_index
                 )
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_f")(x)
+        if self.has_variable("quant", "wte_q"):
+            # Native int8 LM head (ISSUE 9): the fused-native quantizer
+            # (tpuflow.infer.quant mode='mxu') supplies an int8 view of
+            # the tied wte with PER-VOCAB-ROW scales as its own 'quant'
+            # collection — the 'params' tree keeps the fp structure this
+            # module declares, so checkpoints and shardings never see a
+            # fork. Decode streams the (vocab, n_embd) head — a third of
+            # GPT-2-124M's bytes — as int8, and the integer contraction
+            # is exact, hence width-independent on the MXU: the
+            # decode_precision pinning below exists to fix exactly the
+            # rounding an int8 matmul cannot exhibit.
+            from tpuflow.ops.int8_matmul import int8_matmul
+
+            head = self.get_variable("quant", "wte_q")
+            return int8_matmul(
+                x, head.q, head.scale, w_contract_last=True,
+                out_dtype=jnp.float32,
+            )
         # Weight-tied LM head; logits come straight out of the MXU's f32
         # accumulator (preferred_element_type) — never rounded through
         # bf16. The old einsum→bf16→f32 path collapsed near-tie logits

@@ -198,6 +198,35 @@ CATALOG: dict[str, tuple[str, str]] = {
     ),
     "serve.tokens": ("counter", "generated tokens served by the engine"),
     "serve.requests": ("counter", "requests completed by the engine"),
+    # Per-request int8 serving (ISSUE 9): the quantized twin of the
+    # persistent decode program, plus the completion trail that lets an
+    # operator split throughput by numeric path.
+    "serve.quant_decode": (
+        "span",
+        "one decode block of the INT8 (fused-native W8A8) persistent "
+        "program over the quantize=True slots — runs beside serve.decode "
+        "when fp and int8 requests share the engine",
+    ),
+    "serve.quant_requests": (
+        "counter",
+        "completed requests that decoded through the int8 path (subset "
+        "of serve.requests)",
+    ),
+    # --------------------------------------------------------------- quant
+    "quant.decision": (
+        "event",
+        "quantization policy verdict at model load (mode, apply, float "
+        "weight MiB, measured rationale) — emitted by maybe_quantize and "
+        "by a quant-armed ServeEngine, so a run's events say which "
+        "numeric path its decode took",
+    ),
+    "quant.kernel_fallback": (
+        "event",
+        "a forced-pallas int8 matmul shape could not tile (K/N % 128) "
+        "and fell back to the XLA int8 path — numerics identical, "
+        "recorded once per shape so a bench can attribute perf to the "
+        "impl that actually ran",
+    ),
     # ---------------------------------------------------------------- dist
     "dist.mesh_generation": (
         "gauge",

@@ -5,5 +5,17 @@ from tpuflow.ops.attention import (
     resolve_attention_impl,
     xla_attention,
 )
+from tpuflow.ops.int8_matmul import (
+    int8_matmul,
+    quantize_rows,
+    resolve_int8_impl,
+)
 
-__all__ = ["attention", "resolve_attention_impl", "xla_attention"]
+__all__ = [
+    "attention",
+    "int8_matmul",
+    "quantize_rows",
+    "resolve_attention_impl",
+    "resolve_int8_impl",
+    "xla_attention",
+]
