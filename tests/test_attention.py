@@ -202,6 +202,124 @@ def test_flash_pallas_backward_matches_blockwise_fallback(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def _flash_grads(q, k, v, mode, causal, monkeypatch, lse=None):
+    """Grads through flash_attention with TPUFLOW_FLASH_BWD=mode (and
+    optionally TPUFLOW_FLASH_LSE). Fresh trace per call — both knobs
+    resolve at trace time."""
+    if mode is None:
+        monkeypatch.delenv("TPUFLOW_FLASH_BWD", raising=False)
+    else:
+        monkeypatch.setenv("TPUFLOW_FLASH_BWD", mode)
+    if lse is None:
+        monkeypatch.delenv("TPUFLOW_FLASH_LSE", raising=False)
+    else:
+        monkeypatch.setenv("TPUFLOW_FLASH_LSE", lse)
+    jax.clear_caches()
+
+    def loss(q, k, v):
+        return (
+            flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+            * 0.1
+        ).sum()
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def test_flash_bwd_fused_bit_identical_to_split(monkeypatch):
+    """ISSUE 10 tentpole gate: the fused two-kernel backward (row-delta
+    folded into the dq kernel's first block visit + the lane-packed
+    residual feeding the merged dk/dv walk) is BIT-identical to the
+    split kernels it replaces, in interpret mode, across causal/
+    non-causal, both LSE residual layouts, and multiple q/k blocks —
+    and the default config matches the blockwise-recompute VJP to float
+    tolerance. (Tier 1 runs both LSE layouts on the causal path; the
+    non-causal configs and per-config blockwise agreement ride the slow
+    full-grid twin below — the 820 s guard.)"""
+    for causal, lse in ((True, None), (True, "compact")):
+        # 3 q/k blocks (uneven vs the 16-block), small B/H to keep the
+        # interpret-mode grad compiles inside the tier-1 wall.
+        q, k, v = _qkv(B=1, T=48, H=2, D=16, seed=1)
+        g_fused = _flash_grads(q, k, v, None, causal, monkeypatch,
+                               lse=lse)
+        g_split = _flash_grads(q, k, v, "split", causal, monkeypatch,
+                               lse=lse)
+        for a, b, name in zip(g_fused, g_split, "qkv"):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"d{name} causal={causal} lse={lse}",
+            )
+        if causal and lse is None:
+            g_block = _flash_grads(q, k, v, "blockwise", causal,
+                                   monkeypatch, lse=lse)
+            for a, b, name in zip(g_fused, g_block, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-4,
+                    err_msg=f"d{name} causal={causal} lse={lse}",
+                )
+
+
+@pytest.mark.slow
+def test_flash_bwd_fused_bit_identical_to_split_full_grid(monkeypatch):
+    """The full causal × LSE-layout grid incl. the non-causal configs
+    and per-config blockwise agreement (slow tier), plus the
+    below-boundary fallback edge T=31 the fast twin drops."""
+    q31 = _qkv(B=1, T=31, H=2, D=16, seed=31)
+    g31_fused = _flash_grads(*q31, None, True, monkeypatch)
+    g31_ref = jax.grad(
+        lambda q, k, v: (xla_attention(q, k, v) * 0.1).sum(),
+        argnums=(0, 1, 2),
+    )(*q31)
+    for a, b in zip(g31_fused, g31_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+    for causal in (True, False):
+        for lse in (None, "compact"):
+            q, k, v = _qkv(B=2, T=64, H=2, D=32, seed=1)
+            g_fused = _flash_grads(q, k, v, None, causal, monkeypatch,
+                                   lse=lse)
+            g_split = _flash_grads(q, k, v, "split", causal, monkeypatch,
+                                   lse=lse)
+            g_block = _flash_grads(q, k, v, "blockwise", causal,
+                                   monkeypatch, lse=lse)
+            for a, b, name in zip(g_fused, g_split, "qkv"):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"d{name} causal={causal} lse={lse}",
+                )
+            for a, b, name in zip(g_fused, g_block, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-4,
+                    err_msg=f"d{name} causal={causal} lse={lse}",
+                )
+
+
+def test_flash_bwd_parity_at_block_boundary_edges(monkeypatch):
+    """Odd-T edges around the block boundary (block 16; T = 31/32/33):
+    the tiling T takes the kernels, the ±1 neighbors take the documented
+    blockwise fallback — every mode's gradients must agree with the XLA
+    reference, and fused must stay bit-identical to split where the
+    kernels actually run (at the fallback T both env modes trace the
+    SAME blockwise program, so only one is compiled; the below-boundary
+    edge T=31 rides the slow twin)."""
+    for T in (32, 33):
+        q, k, v = _qkv(B=1, T=T, H=2, D=16, seed=T)
+        g_ref = jax.grad(
+            lambda q, k, v: (xla_attention(q, k, v) * 0.1).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_fused = _flash_grads(q, k, v, None, True, monkeypatch)
+        if T % 16 == 0:
+            g_split = _flash_grads(q, k, v, "split", True, monkeypatch)
+            for a, b in zip(g_fused, g_split):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)
+                )
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4,
+                err_msg=f"T={T}",
+            )
+
+
 def test_ring_attention_ragged_T_falls_back():
     """T not divisible by the ring size takes the documented blockwise
     fallback instead of a shard_map error, and under jax.set_mesh (the
@@ -417,4 +535,56 @@ def test_flash_tuning_file_per_path_keys(tmp_path, monkeypatch):
         "auto", 512, needs_bwd=True, backend="tpu") == "xla"
     assert resolve_attention_impl(
         "auto", 256, needs_bwd=False, backend="tpu") == "flash"
+    monkeypatch.setattr(att, "_flash_tuning_cache", None)
+
+
+def test_flash_tuning_bwd_only_crossover_governs_training_path(
+    tmp_path, monkeypatch
+):
+    """ISSUE 10 satellite: the fitted bwd-ONLY crossover
+    (``flash_min_seq_bwd``, from bench's T512/T2048 vjp timing split)
+    raises the effective fwd+bwd threshold — below the measured
+    backward-kernel loss region, auto dispatch picks XLA even when the
+    fwd+bwd composition entry would have allowed flash. The fwd-only
+    path never consults it; malformed entries degrade to the shipped
+    default with a once-per-process warning."""
+    import importlib
+    import json
+
+    from tpuflow.ops.attention import resolve_attention_impl
+
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ", raising=False)
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ_FWD", raising=False)
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))
+    att = importlib.import_module("tpuflow.ops.attention")
+
+    def retune(entries):
+        with open(tmp_path / "flash_tuning.json", "w") as f:
+            json.dump(entries, f)
+        monkeypatch.setattr(att, "_flash_tuning_cache", None)
+
+    # The bwd crossover is the binding constraint: max(512, 2048).
+    retune({"flash_min_seq": 512, "flash_min_seq_bwd": 2048,
+            "flash_min_seq_fwd": 256})
+    assert resolve_attention_impl(
+        "auto", 1024, needs_bwd=True, backend="tpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 2048, needs_bwd=True, backend="tpu") == "flash"
+    # The fwd-only path is governed by its own key alone.
+    assert resolve_attention_impl(
+        "auto", 256, needs_bwd=False, backend="tpu") == "flash"
+    # bwd entry alone still gates the training path.
+    retune({"flash_min_seq_bwd": 1024})
+    assert resolve_attention_impl(
+        "auto", 512, needs_bwd=True, backend="tpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 1024, needs_bwd=True, backend="tpu") == "flash"
+    # Malformed entries are ignored (warn once) → shipped default 2048.
+    retune({"flash_min_seq": "garbage", "flash_min_seq_bwd": -3})
+    monkeypatch.setattr(att, "_warned_malformed_tuning", False)
+    with pytest.warns(UserWarning, match="flash tuning entry"):
+        assert resolve_attention_impl(
+            "auto", 1024, needs_bwd=True, backend="tpu") == "xla"
+    assert resolve_attention_impl(
+        "auto", 2048, needs_bwd=True, backend="tpu") == "flash"
     monkeypatch.setattr(att, "_flash_tuning_cache", None)

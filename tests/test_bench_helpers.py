@@ -214,6 +214,29 @@ def test_flash_tuning_roundtrip(tmp_path, monkeypatch):
     attn._warned_malformed_env = False
 
 
+def test_flash_tuning_bwd_key_roundtrip(tmp_path, monkeypatch):
+    """ISSUE 10 satellite: the bwd-only crossover persists as
+    flash_min_seq_bwd and the dispatcher's training path maxes it
+    against the fwd+bwd composition key."""
+    import importlib
+    import json
+
+    attn = importlib.import_module("tpuflow.ops.attention")
+    monkeypatch.setenv("TPUFLOW_HOME", str(tmp_path))
+    monkeypatch.delenv("TPUFLOW_FLASH_MIN_SEQ", raising=False)
+    bench._persist_flash_tuning(512, 256, 2048)
+    with open(attn.flash_tuning_path()) as f:
+        rec = json.load(f)
+    assert rec["flash_min_seq"] == 512
+    assert rec["flash_min_seq_fwd"] == 256
+    assert rec["flash_min_seq_bwd"] == 2048
+    attn._flash_tuning_cache = None
+    # Training path: the measured backward loss region gates dispatch.
+    assert attn._flash_min_seq(needs_bwd=True) == 2048
+    assert attn._flash_min_seq(needs_bwd=False) == 256
+    attn._flash_tuning_cache = None
+
+
 def test_flash_tuning_not_persisted_on_suspect_sweep(tmp_path, monkeypatch):
     """A jitter-polluted sweep (any timing_suspect point) must not clobber
     the host tuning file — dropping suspect points can only RAISE the

@@ -320,6 +320,12 @@ def test_obs_catalog_lint():
         ("counter", "serve.quant_requests"),
         ("event", "quant.decision"),
         ("event", "quant.kernel_fallback"),
+        # Raise-MFU step work (ISSUE 10) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
+        ("event", "ops.flash_bwd_fused"),
+        ("event", "train.remat_policy"),
+        ("gauge", "train.exposed_comm_s"),
+        ("gauge", "train.comm_overlap_s"),
         # Durable checkpointing (ISSUE 5) — the lint itself also enforces
         # these via REQUIRED_EMITTERS; asserting through both keeps the
         # standalone tool and the pytest twin honest about each other.

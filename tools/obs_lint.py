@@ -102,6 +102,13 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("counter", "serve.quant_requests"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
+    # Raise-MFU step work (ISSUE 10): backward-kernel provenance, the
+    # remat selector, and the comm-overlap attribution pair the step
+    # pipeline runbook's "reading exposed comm" section consumes.
+    ("event", "ops.flash_bwd_fused"),
+    ("event", "train.remat_policy"),
+    ("gauge", "train.exposed_comm_s"),
+    ("gauge", "train.comm_overlap_s"),
 )
 
 # Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records

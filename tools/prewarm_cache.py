@@ -63,6 +63,13 @@ def _parse(argv):
                    help="run dir for TPUFLOW_COMPILE_CACHE=run keying")
     p.add_argument("--no-train", action="store_true",
                    help="skip the train-step signature")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="also AOT-lower the comm-overlapped FSDP "
+                        "accumulation train step at this depth (ISSUE "
+                        "10: the per-microbatch reduce-scatter program "
+                        "is a DIFFERENT jit key than the plain step — "
+                        "without this twin a gang arming "
+                        "TPUFLOW_COMM_OVERLAP pays its compile cold)")
     p.add_argument("--no-serve", action="store_true",
                    help="skip the serving decode/prefill/insert signatures")
     p.add_argument("--quant", action="store_true",
@@ -141,6 +148,59 @@ def prewarm(args) -> dict:
         # persistent cache without executing anything.
         step.lower(state, batch, rng).compile()
         programs += 1
+        if args.accum_steps > 1:
+            # The comm-overlapped accumulation signature (ISSUE 10):
+            # FSDP-sharded state + per-microbatch grad reduce-scatter —
+            # the program train_gpt runs when accum_steps > 1 and
+            # TPUFLOW_COMM_OVERLAP is armed. Mesh/shardings mirror the
+            # FSDP leg's defaults on this host's device count; as with
+            # every prewarm signature, a mismatch with the real run is
+            # harmless (it just compiles normally).
+            from tpuflow import dist
+            from tpuflow.parallel import create_sharded_state
+            from tpuflow.train.step import TrainState
+            from tpuflow.train.optim import make_optimizer
+
+            if args.batch % args.accum_steps:
+                raise SystemExit(
+                    f"[prewarm] --batch {args.batch} does not split "
+                    f"into --accum-steps {args.accum_steps} equal "
+                    "microbatches"
+                )
+            mesh = dist.make_mesh({"fsdp": len(jax.devices())})
+            tx = make_optimizer(3e-4)
+
+            def init_fn(rng):
+                p = model.init(
+                    rng, jnp.zeros((1, min(8, cfg.n_ctx)), jnp.int32)
+                )["params"]
+                return TrainState.create(
+                    apply_fn=model.apply, params=p, tx=tx
+                )
+
+            with mesh:
+                sstate, shardings = create_sharded_state(
+                    init_fn, mesh, jax.random.PRNGKey(0), fsdp=True
+                )
+                ostep = make_train_step(
+                    accum_steps=args.accum_steps,
+                    grad_shardings=shardings.params,
+                    comm_overlap=True,
+                )
+                bspec = jax.sharding.NamedSharding(
+                    mesh,
+                    jax.sharding.PartitionSpec(("data", "fsdp"), None),
+                )
+                obatch = {
+                    k: jax.ShapeDtypeStruct(
+                        (args.batch, args.seq_len), jnp.int32,
+                        sharding=bspec,
+                    )
+                    for k in ("x", "y")
+                }
+                ostep.lower(sstate, obatch, rng).compile()
+                programs += 1
+            del sstate
 
     if not args.no_serve:
         import functools

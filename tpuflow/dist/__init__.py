@@ -23,6 +23,7 @@ from tpuflow.dist.mesh import (
     force_cpu_platform,
     initialize,
     is_initialized,
+    maybe_enable_async_collectives,
     maybe_enable_compile_cache,
     make_hybrid_mesh,
     make_mesh,
@@ -55,6 +56,7 @@ __all__ = [
     "initialize",
     "is_initialized",
     "make_hybrid_mesh",
+    "maybe_enable_async_collectives",
     "maybe_enable_compile_cache",
     "make_mesh",
     "process_count",

@@ -143,6 +143,56 @@ def maybe_enable_compile_cache(run_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+# libtpu scheduling flags that let the TPU compiler's latency-hiding
+# scheduler run collectives ASYNCHRONOUSLY and slide them behind compute
+# (ISSUE 10 comm/compute overlap — the other half of the per-microbatch
+# reduce-scatter the accumulation scan issues; without these the
+# collective still serializes after its producer). The MaxText-style
+# staging: appended to LIBTPU_INIT_ARGS, which libtpu reads ONCE at
+# backend init — call any time before the first jax device touch.
+_ASYNC_COLLECTIVE_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+)
+
+
+def maybe_enable_async_collectives() -> bool:
+    """Stage the async-collective libtpu flags into ``LIBTPU_INIT_ARGS``.
+
+    Returns True when the flags are (now) staged. No-op — returns False —
+    on CPU platforms (libtpu never loads; the env var would be inert
+    noise in test processes) and under ``TPUFLOW_COMM_OVERLAP=0`` (the
+    same knob that turns off the per-microbatch reduce-scatter in
+    ``train.step.make_train_step``, so one switch governs the whole
+    overlap story). Flags already present — e.g. an operator's own
+    LIBTPU_INIT_ARGS — are never duplicated or overridden: an explicit
+    ``--xla_tpu_enable_async_collective_fusion=false`` wins.
+    Call sites: gang member bootstrap (flow.gang_exec) and the in-process
+    train entry (train.train_gpt), both ahead of backend init.
+    """
+    if os.environ.get("TPUFLOW_COMM_OVERLAP", "1").lower() in (
+        "0", "false", "off",
+    ):
+        return False
+    if _platform_is_cpu():
+        return False
+    current = os.environ.get("LIBTPU_INIT_ARGS", "")
+    added = []
+    for flag in _ASYNC_COLLECTIVE_FLAGS:
+        name = flag.split("=", 1)[0]
+        if name in current:
+            continue  # operator already took a position on this flag
+        added.append(flag)
+    if added:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
+            ([current] if current else []) + added
+        )
+    return True
+
+
 def seed_compile_cache(src_dir: str, cache_dir: str) -> int:
     """Rsync-style one-way seed of a prewarmed persistent compile cache
     (ISSUE 9 startup-latency satellite): copy every cache entry from

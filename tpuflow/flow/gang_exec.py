@@ -35,6 +35,14 @@ def _bootstrap_jax() -> None:
             # a 1-process member must not ask for gloo (jaxlib refuses to
             # build gloo collectives without a distributed client).
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # Comm/compute overlap (ISSUE 10): stage the async-collective libtpu
+    # scheduling flags BEFORE any backend touch, so the per-microbatch
+    # gradient reduce-scatters the FSDP accumulation scan issues can
+    # hide behind the next microbatch's backward. One knob
+    # (TPUFLOW_COMM_OVERLAP=0) turns both halves off; CPU members no-op.
+    from tpuflow.dist import maybe_enable_async_collectives
+
+    maybe_enable_async_collectives()
     # Gang members share the persistent compile cache: after one worker
     # (or a previous attempt) compiled the step, the rest load it. With
     # TPUFLOW_COMPILE_CACHE=run the cache keys under the run directory

@@ -522,7 +522,24 @@ class GPT2(nn.Module):
             import jax as _jax
 
             policy = None
-            if cfg.remat_policy:
+            if cfg.remat_policy == "dots":
+                # The ISSUE 10 selector's middle ground: save every MXU
+                # dot output PLUS the named flash-attention output, so
+                # the backward recomputes only cheap elementwise/softmax
+                # work (and, inside a flash custom_vjp, the one fwd
+                # kernel re-run jax's remat can't elide — see the
+                # checkpoint_name note in ops/flash_attention.py; the
+                # zero-recompute mode is remat OFF, selector 'none').
+                cp = _jax.checkpoint_policies
+                policy = cp.dots_with_no_batch_dims_saveable
+                try:
+                    policy = cp.save_from_both_policies(
+                        policy,
+                        cp.save_only_these_names("flash_out"),
+                    )
+                except AttributeError:
+                    pass  # old jax without name policies: dots alone
+            elif cfg.remat_policy:
                 try:
                     policy = getattr(
                         _jax.checkpoint_policies, cfg.remat_policy

@@ -79,6 +79,28 @@ CATALOG: dict[str, tuple[str, str]] = {
         "(TPUFLOW_DISPATCH_DEPTH): how many steps may be in flight "
         "before the host settles the oldest step's scalars",
     ),
+    # Raise-MFU step work (ISSUE 10): remat-selector provenance and the
+    # comm/compute roofline attribution pair.
+    "train.remat_policy": (
+        "event",
+        "resolved remat selector for the leg (none|full|dots|policy "
+        "name; TPUFLOW_REMAT_POLICY beats the config) plus whether the "
+        "comm-overlapped accumulation scan is armed — the run's "
+        "memory/recompute/overlap trade, auditable from the stream",
+    ),
+    "train.exposed_comm_s": (
+        "gauge",
+        "per-step seconds NOT at peak compute (mean epoch step wall − "
+        "6·N·tokens/peak): an UPPER bound on exposed communication — "
+        "memory stalls and bubbles charge here too, keeping the overlap "
+        "claim conservative (train.step.comm_attribution; TPU only)",
+    ),
+    "train.comm_overlap_s": (
+        "gauge",
+        "per-step seconds of FSDP collective time hidden behind compute "
+        "(comm roofline − exposed_comm_s, floored at 0): a LOWER bound "
+        "on overlapped comm (train.step.comm_attribution; TPU only)",
+    ),
     # ---------------------------------------------------------------- ckpt
     "ckpt.save": ("span", "checkpoint save, save() → commit; bytes + gbps"),
     "ckpt.restore": ("span", "checkpoint restore; bytes + gbps when known"),
@@ -226,6 +248,14 @@ CATALOG: dict[str, tuple[str, str]] = {
         "and fell back to the XLA int8 path — numerics identical, "
         "recorded once per shape so a bench can attribute perf to the "
         "impl that actually ran",
+    ),
+    # ----------------------------------------------------------------- ops
+    "ops.flash_bwd_fused": (
+        "event",
+        "a differentiated flash-attention call traced the FUSED two-"
+        "kernel backward (ISSUE 10; seq/heads/causal/blocks) — absent "
+        "when TPUFLOW_FLASH_BWD=split|blockwise selected a fallback, so "
+        "a run's backward provenance is auditable from the stream",
     ),
     # ---------------------------------------------------------------- dist
     "dist.mesh_generation": (

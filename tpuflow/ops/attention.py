@@ -54,12 +54,14 @@ _DEFAULT_FLASH_MIN_SEQ = 2048
 _DEFAULT_FLASH_MIN_SEQ_FWD = 512
 _flash_tuning_cache: dict | None = None
 _warned_malformed_env = False
+_warned_malformed_tuning = False
 
 
 def flash_tuning_path() -> str:
     """Where ``bench.py`` persists the measured flash/XLA crossovers on
     this host: ``$TPUFLOW_HOME/flash_tuning.json`` with
-    ``{"flash_min_seq": T_fwdbwd, "flash_min_seq_fwd": T_fwd}``."""
+    ``{"flash_min_seq": T_fwdbwd, "flash_min_seq_fwd": T_fwd,
+    "flash_min_seq_bwd": T_bwdonly}``."""
     import os
 
     home = os.environ.get(
@@ -93,7 +95,18 @@ def _flash_min_seq(*, needs_bwd: bool = True) -> int:
     by nothing — i.e. only its own sources; the two paths never borrow
     each other's thresholds (BENCH_r05: at T=512 fwd wins 2.73x while
     fwd+bwd loses at 0.2x). The file read is cached per process (this
-    runs at trace time)."""
+    runs at trace time).
+
+    The training path additionally consults the fitted BWD-ONLY
+    crossover (ISSUE 10 satellite; bench's T512/T2048 ``jax.vjp`` timing
+    split, persisted as ``flash_min_seq_bwd``): the effective fwd+bwd
+    threshold is the max of the valid measured entries — below the
+    measured backward-kernel crossover the bwd kernels are a MEASURED
+    loss, so fwd+bwd dispatch must pick XLA there even when the fwd+bwd
+    composition point is absent or was discarded as timing-suspect. A
+    malformed tuning entry (present but not a positive integer) is
+    ignored with a once-per-process warning; no valid entry at all falls
+    back to the shipped default."""
     import os
 
     global _warned_malformed_env
@@ -118,13 +131,46 @@ def _flash_min_seq(*, needs_bwd: bool = True) -> int:
                 )
                 obs.event("warn.flash_min_seq_malformed", value=env)
             # fall through to the measured tuning file below
-    key = "flash_min_seq" if needs_bwd else "flash_min_seq_fwd"
-    v = _flash_tuning().get(key)
-    if isinstance(v, int) and v > 0:
-        return v
+    keys = (
+        ("flash_min_seq", "flash_min_seq_bwd")
+        if needs_bwd
+        else ("flash_min_seq_fwd",)
+    )
+    fitted = [_tuning_entry(k) for k in keys]
+    fitted = [v for v in fitted if v is not None]
+    if fitted:
+        return max(fitted)
     return (
         _DEFAULT_FLASH_MIN_SEQ if needs_bwd else _DEFAULT_FLASH_MIN_SEQ_FWD
     )
+
+
+def _tuning_entry(key: str) -> int | None:
+    """One tuning-file entry, validated: a positive int passes through,
+    an absent key is None, and a MALFORMED value (bench never writes one,
+    but a hand-edited file might) is ignored with the same
+    once-per-process warning discipline as the env path — a typo'd
+    tuning file must degrade to the shipped defaults, never crash a
+    trace or silently dispatch off a garbage threshold."""
+    global _warned_malformed_tuning
+    v = _flash_tuning().get(key)
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, int) or v <= 0:
+        if not _warned_malformed_tuning:
+            _warned_malformed_tuning = True
+            import warnings
+
+            from tpuflow import obs
+
+            warnings.warn(
+                f"flash tuning entry {key}={v!r} is not a positive "
+                "integer; ignoring it",
+                stacklevel=3,
+            )
+            obs.event("warn.flash_min_seq_malformed", value=repr(v))
+        return None
+    return v
 
 
 def resolve_attention_impl(

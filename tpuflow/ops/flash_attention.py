@@ -7,9 +7,12 @@ Two tiers with identical numerics:
   any backend; the building block of ring attention.
 - ``flash_attention`` — Pallas TPU kernels (MXU matmuls in the q/k blocks,
   float32 online-softmax state in VMEM scratch). Forward saves only
-  (O, logsumexp); backward recomputes P inside two Pallas kernels
-  (dq; dk/dv) — the flash-style compute-for-memory trade.
-  ``TPUFLOW_FLASH_BWD=blockwise`` selects the pure-JAX recompute VJP.
+  (O, logsumexp); backward recomputes P inside two FUSED Pallas kernels
+  (dq + row-delta; dk/dv merged) — the flash-style compute-for-memory
+  trade. ``TPUFLOW_FLASH_BWD`` selects the backward: ``fused`` (default;
+  the ISSUE 10 two-kernel design), ``split`` (the previous per-visit
+  row-delta kernels, kept one release as the on-chip regression
+  reference), ``blockwise`` (the pure-JAX recompute VJP).
 
 The reference has no attention anywhere (its model is an image MLP,
 my_ray_module.py:94-112); these exist for the GPT-2 acceptance config and
@@ -241,14 +244,33 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
 
 # -------------------------------------------------- pallas backward kernels
 # FlashAttention-2-style backward: P is recomputed inside the kernels from
-# (q, k, lse) — the compute-for-memory trade — and split into two kernels so
-# each accumulates over its own sequential axis without atomics:
+# (q, k, lse) — the compute-for-memory trade — in two kernels so each
+# accumulates over its own sequential axis without atomics:
 #   dq kernel : grid (BH, nq, nk), k innermost — dq_i += dS_ij K_j
 #   dkv kernel: grid (BH, nk, nq), q innermost — dK_j += dS_ij^T Q_i,
 #                                                dV_j += P_ij^T dO_i
-# with dS = P ∘ (dP − D), dP = dO V^T, D = rowsum(dO ∘ O) computed per q
-# block inside the kernels (cheap VPU reduce; avoids a second row-shaped
-# operand that Mosaic's (8,128) tiling can't express).
+# with dS = P ∘ (dP − D), dP = dO V^T, D = rowsum(dO ∘ O).
+#
+# Two shapes of the pair exist (ISSUE 10):
+#
+# - FUSED (default): the dq kernel computes D once per q block at its
+#   FIRST kv-block visit (f32 scratch, not per visit) and packs the two
+#   per-row softmax residuals into ONE lane-addressed (BH, Tq, 128) f32
+#   tensor — lane 0 = lse (bit-copied from the forward residual), lane 1
+#   = D. The merged dk/dv kernel then reads that single residual instead
+#   of (lse + o): its q-innermost walk re-streams each q row's operands
+#   nk times, so dropping the o stream and the per-visit rowsum removes
+#   one full HBM pass and nk-1 VPU reduces per row — the short-T regime
+#   where BENCH_r05 measured the backward losing 5x to XLA is exactly
+#   where that per-visit residual traffic rivals the useful q/k/v bytes.
+# - SPLIT (TPUFLOW_FLASH_BWD=split, one release as the regression
+#   reference): the previous kernels — D recomputed from (o, do) inside
+#   EVERY block visit of both kernels.
+#
+# The two are bit-identical by construction (same op order; D is the
+# same f32 value whether recomputed or round-tripped through f32 HBM) —
+# pinned in interpret mode by tests/test_attention.py, and raced on chip
+# by the bench flash leg's fused-vs-split column.
 
 
 def _row_delta(o_ref, do_ref):
@@ -343,7 +365,217 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+# Lane indices of the packed per-row residual tensor the fused backward
+# kernels share: lane 0 carries lse (bit-copied from the forward
+# residual), lane 1 carries D = rowsum(dO ∘ O). The kernels only ever
+# read one column of a lane-broadcast residual anyway, so the 128-lane
+# minor dim Mosaic requires is free real estate — packing both residuals
+# into one tensor halves the dkv kernel's residual streams.
+_RES_LSE_LANE = 0
+_RES_DELTA_LANE = 1
+
+
+def _bwd_dq_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                         dq_ref, res_ref, dq_scr, delta_scr, *, scale,
+                         causal, block_q, block_k):
+    """dq + row-delta in one pass (ISSUE 10 fused design).
+
+    Identical math to ``_bwd_dq_kernel`` except D is computed ONCE per q
+    block — at the first kv-block visit, into f32 scratch — instead of
+    per visit, and the (lse, D) pair is written out as the lane-packed
+    residual the fused dkv kernel consumes. o/do/lse block fetches are
+    hoisted by Mosaic (their index maps ignore the kv grid axis), so the
+    saving here is the nk-1 redundant VPU reduces; the HBM saving lands
+    in the dkv kernel, which stops streaming o entirely.
+    """
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        # D_i once per q block. Under the causal block skip ik == 0 is
+        # never skipped (the diagonal block's kv start is 0), so the
+        # scratch and the residual are always populated.
+        delta = _row_delta(o_ref, do_ref)  # (block_q, 1) f32
+        delta_scr[:] = jax.lax.broadcast_in_dim(
+            delta[:, 0], delta_scr.shape, (0,)
+        )
+        lane = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, res_ref.shape[-1]), 1
+        )
+        # Lane 0 keeps the forward's lse bits exactly (bit-parity with
+        # the split kernels, which read lse straight from the forward).
+        res_ref[0] = jnp.where(
+            lane == _RES_DELTA_LANE, delta_scr[:], lse_ref[0]
+        )
+
+    def _compute():
+        s = _masked_scores(
+            q_ref, k_ref, iq, ik,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_scr[:, :1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _maybe():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, res_ref, dk_ref,
+                          dv_ref, dk_scr, dv_scr, *, scale, causal,
+                          block_q, block_k):
+    """Merged dk/dv over one KV-grid walk, consuming the packed residual.
+
+    vs ``_bwd_dkv_kernel``: o is not an input and D is not recomputed —
+    lse and D both come out of the single lane-packed residual the fused
+    dq kernel wrote. The q-innermost walk re-streams every q-indexed
+    operand nk times, so this drops one full (BH, Tq, D) HBM stream per
+    outer kv block plus the per-visit rowsum.
+    """
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        s = _masked_scores(
+            q_ref, k_ref, iq, ik,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        lse = res_ref[0][:, _RES_LSE_LANE:_RES_LSE_LANE + 1]
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = res_ref[0][:, _RES_DELTA_LANE:_RES_DELTA_LANE + 1]
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # A q block entirely before this k block contributes nothing.
+        @pl.when(iq * block_q + block_q - 1 >= ik * block_k)
+        def _maybe():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, o, lse, g, causal, block_q, block_k,
+                     interpret):
+    """The fused two-kernel backward (default; see the section comment)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    BH = B * H
+
+    def flat(x, T):
+        return x.transpose(0, 2, 1, 3).reshape(BH, T, D)
+
+    qf, kf, vf = flat(q, Tq), flat(k, Tk), flat(v, Tk)
+    of, gf = flat(o, Tq), flat(g, Tq)
+    if lse.ndim == 2:  # TPUFLOW_FLASH_LSE=compact residual — reinflate
+        lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    dq, res = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_fused_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(BH, Tq // block_q, Tk // block_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            q_spec,
+            q_spec,
+            lse_spec,
+        ],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            # The packed (lse, D) residual for the dkv kernel.
+            jax.ShapeDtypeStruct((BH, Tq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, of, gf, lse)
+
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    qi_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    resi_spec = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_fused_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(BH, Tk // block_k, Tq // block_q),
+        in_specs=[qi_spec, k_spec, k_spec, qi_spec, resi_spec],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, gf, res)
+
+    def unflat(x, T):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return unflat(dq, Tq), unflat(dk, Tk), unflat(dv, Tk)
+
+
+def _flash_bwd_split(q, k, v, o, lse, g, causal, block_q, block_k,
+                     interpret):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
@@ -447,7 +679,8 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
     q, k, v, o, lse = res
-    if os.environ.get("TPUFLOW_FLASH_BWD") == "blockwise":
+    mode = os.environ.get("TPUFLOW_FLASH_BWD", "fused")
+    if mode == "blockwise":
         # Fallback: recompute through the O(T)-memory blockwise path.
         _, vjp = jax.vjp(
             lambda q, k, v: blockwise_attention(q, k, v, causal=causal),
@@ -455,7 +688,22 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, g):
         )
         return vjp(g)
     interpret = jax.default_backend() != "tpu"
-    return _flash_bwd(
+    if mode == "split":
+        # The pre-ISSUE-10 two-pass kernels, kept one release as the
+        # on-chip regression reference (the bench flash leg races them
+        # against the fused pair and fails on a fused loss at T2048).
+        return _flash_bwd_split(
+            q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        )
+    # Trace-time marker: which compiled programs took the fused backward
+    # (each jit trace of a differentiated flash call lands here once).
+    from tpuflow import obs
+
+    obs.event(
+        "ops.flash_bwd_fused", seq=int(q.shape[1]), heads=int(q.shape[2]),
+        causal=bool(causal), block_q=block_q, block_k=block_k,
+    )
+    return _flash_bwd_fused(
         q, k, v, o, lse, g, causal, block_q, block_k, interpret
     )
 
@@ -477,4 +725,20 @@ def flash_attention(
     block_k = min(block_k, Tk)
     if Tq % block_q or Tk % block_k or D % 8:
         return blockwise_attention(q, k, v, causal=causal)
-    return _flash(q, k, v, causal, block_q, block_k)
+    out = _flash(q, k, v, causal, block_q, block_k)
+    try:
+        from jax.ad_checkpoint import checkpoint_name
+
+        # Named for selective-remat policies (ISSUE 10): the 'dots'
+        # policy saves this output alongside the MXU dot outputs. The
+        # lse softmax residual lives INSIDE the custom_vjp, which jax's
+        # remat treats atomically — a remat'd block re-runs the flash
+        # forward for it regardless of policy (measured: one extra fwd
+        # pallas_call in the remat'd backward jaxpr). Truly saving
+        # "flash outputs + lse" therefore means NOT remat'ing — the
+        # TPUFLOW_REMAT_POLICY=none mode, where the vjp residuals
+        # (q, k, v, o, lse) are held from the forward and the backward
+        # runs zero recompute.
+        return checkpoint_name(out, "flash_out")
+    except ImportError:  # very old jax: the name is advisory anyway
+        return out

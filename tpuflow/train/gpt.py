@@ -59,6 +59,45 @@ def _resume_cursor(
     return min(step // steps_per_epoch, epochs), 0
 
 
+def _apply_remat_selector(model_cfg, selector: str):
+    """Map a remat selector onto a GPT2Config (ISSUE 10).
+
+    ``none``  — remat OFF: every activation saved, including the flash
+                custom_vjp residuals (outputs + lse) — the backward runs
+                ZERO recompute. The fastest step when HBM admits it.
+    ``dots``  — remat ON with the 'dots' policy: MXU dot outputs and the
+                named flash output saved, cheap elementwise recomputed
+                (the TPU-standard middle ground).
+    ``full``  — remat ON, nothing saved beyond block inputs: minimum
+                memory, maximum recompute (the old full-size default).
+    Any other value: treated as a literal jax.checkpoint_policies name
+    (validated by the caller), remat ON.
+    """
+    if selector == "none":
+        return dataclasses.replace(
+            model_cfg, remat=False, remat_policy=None
+        )
+    if selector == "full":
+        return dataclasses.replace(
+            model_cfg, remat=True, remat_policy=None
+        )
+    # 'dots' or an explicit checkpoint_policies name: a policy only
+    # means anything under remat — asking for one turns remat on
+    # (otherwise the knob is silently inert on presets that default
+    # remat off, like 'test').
+    return dataclasses.replace(
+        model_cfg, remat=True, remat_policy=selector
+    )
+
+
+def active_remat_policy(model_cfg) -> str:
+    """The resolved selector string for telemetry: 'none' when remat is
+    off, 'full' for policy-less remat, else the policy name."""
+    if not model_cfg.remat:
+        return "none"
+    return model_cfg.remat_policy or "full"
+
+
 @dataclasses.dataclass
 class GptTrainConfig:
     """Everything the GPT training recipes need; flows bind CLI parameters
@@ -125,20 +164,33 @@ class GptTrainConfig:
         if self.remat_policy:
             import jax
 
-            if not hasattr(jax.checkpoint_policies, self.remat_policy):
+            if self.remat_policy not in (
+                "full", "dots", "none"
+            ) and not hasattr(jax.checkpoint_policies, self.remat_policy):
                 # Fail at config time, not at first jit trace inside an
                 # already-provisioned training job.
                 raise ValueError(
                     f"unknown remat_policy {self.remat_policy!r}; valid "
-                    "names are the jax.checkpoint_policies attributes "
+                    "names are full|dots|none or the "
+                    "jax.checkpoint_policies attributes "
                     "(e.g. dots_with_no_batch_dims_saveable)"
                 )
-            # A policy only means anything under remat — asking for one
-            # turns remat on (otherwise the knob is silently inert on
-            # presets that default remat off, like 'test').
-            cfg = dataclasses.replace(
-                cfg, remat=True, remat_policy=self.remat_policy
-            )
+            cfg = _apply_remat_selector(cfg, self.remat_policy)
+        # The env selector (ISSUE 10) beats the config: a provisioned
+        # run flips its memory/recompute trade per launch without a
+        # config edit — the MFU-push knob for remat-off training, where
+        # the flash custom_vjp residuals (outputs + lse) are SAVED from
+        # the forward instead of re-running every block's kernels.
+        env_sel = os.environ.get("TPUFLOW_REMAT_POLICY", "").strip()
+        if env_sel:
+            if env_sel not in ("full", "dots", "none"):
+                # Config-time failure, same contract as a bad
+                # remat_policy — never a mid-provisioning trace crash.
+                raise ValueError(
+                    f"TPUFLOW_REMAT_POLICY={env_sel!r}; valid selectors "
+                    "are full|dots|none"
+                )
+            cfg = _apply_remat_selector(cfg, env_sel)
         return cfg
 
     def optimizer(self):
@@ -229,6 +281,11 @@ def train_gpt(
     _dist.maybe_enable_compile_cache(
         run_dir=os.path.dirname(os.path.abspath(ckpt_dir))
     )
+    # Async-collective scheduling flags for the comm-overlap path
+    # (ISSUE 10) — a best-effort staging for in-process runs: only
+    # effective when no jax backend is up yet (gang members stage them
+    # in gang_exec before ANY backend touch; libtpu reads the env once).
+    _dist.maybe_enable_async_collectives()
     # Live metrics endpoint (ISSUE 6, opt-in TPUFLOW_OBS_HTTP_PORT): gang
     # member 0 — or an in-process run, which is its own member 0 — serves
     # /metrics + /status for the duration of the leg. Idempotent; one
@@ -413,9 +470,21 @@ def _run_fsdp_generation(
             from tpuflow.train import with_ema
 
             state = with_ema(state)
+        # Comm/compute overlap (ISSUE 10): hand the accumulation scan
+        # the param shardings so each microbatch's gradients reduce-
+        # scatter inside the scan body (hidden behind the next
+        # microbatch's backward) instead of one exposed reduction after
+        # it. Loss-bit-identical to the sequential scan (pinned by
+        # tests/test_train_step.py); TPUFLOW_COMM_OVERLAP=0 recovers the
+        # old program.
+        from tpuflow.train.step import comm_overlap_enabled
+
+        overlap = comm_overlap_enabled() and cfg.accum_steps > 1
         train_step = make_train_step(
             accum_steps=cfg.accum_steps,
             ema_decay=cfg.ema_decay or None,
+            grad_shardings=shardings.params if overlap else None,
+            comm_overlap=overlap,
         )
         eval_step = make_eval_step()
         rng = jax.random.PRNGKey(1)
@@ -488,6 +557,21 @@ def _run_fsdp_generation(
         # boundary, just observed up to depth-1 steps late.
         window = DispatchWindow(dispatch_depth())
         obs.gauge("train.dispatch_depth", float(window.depth))
+        # Remat-selector + overlap provenance (ISSUE 10): one event per
+        # leg so a run's memory/recompute trade and comm scheduling are
+        # auditable from the stream alone.
+        obs.event(
+            "train.remat_policy",
+            policy=active_remat_policy(model_cfg),
+            comm_overlap=bool(overlap),
+            accum_steps=cfg.accum_steps,
+        )
+        # FSDP world for the comm roofline: the axes grads actually
+        # reduce over (same rule as parallel.make_shardings).
+        fsdp_world = 1
+        for _ax in ("fsdp", "data"):
+            if mesh.shape.get(_ax, 1) > 1:
+                fsdp_world *= int(mesh.shape[_ax])
 
         def settle(entry) -> None:
             """Fence one matured step and run its host-side accounting
@@ -607,13 +691,10 @@ def _run_fsdp_generation(
         # transformer 6·N FLOP/token estimate (set AFTER the clock reset
         # the ledger). state.params is materialized by now on both the
         # fresh and the restored path.
-        goodput_mod.live().set_model_flops_per_token(
-            6.0
-            * sum(
-                int(l.size)
-                for l in jax.tree_util.tree_leaves(state.params)
-            )
+        n_params = sum(
+            int(l.size) for l in jax.tree_util.tree_leaves(state.params)
         )
+        goodput_mod.live().set_model_flops_per_token(6.0 * n_params)
         cold = True
         # Loader cursor for deterministic mid-epoch resume: epoch + batches
         # consumed, persisted as checkpoint data_state and replayed by
@@ -713,6 +794,28 @@ def _run_fsdp_generation(
                             tokens_per_s=round(tok_s, 1) if tok_s else None,
                         )
                     clock.goodput_mark()
+                    if n_tokens:
+                        # Comm attribution at the epoch fence (ISSUE 10):
+                        # mean step wall vs the compute/comm rooflines →
+                        # train.exposed_comm_s / train.comm_overlap_s.
+                        # No-op off-TPU (no invented attribution).
+                        from tpuflow.train.step import (
+                            comm_attribution,
+                            emit_comm_gauges,
+                        )
+
+                        per_step_tokens = cfg.batch_size * cfg.seq_len
+                        n_steps = max(n_tokens // per_step_tokens, 1)
+                        emit_comm_gauges(
+                            comm_attribution(
+                                epoch_s / n_steps,
+                                tokens=per_step_tokens,
+                                n_params=n_params,
+                                accum_steps=cfg.accum_steps,
+                                fsdp_world=fsdp_world,
+                                overlapped=bool(overlap),
+                            )
+                        )
                     # Held-out validation: token-level loss -> perplexity
                     # over EVERY test window (padded tail masked out). The
                     # best/retention policy keys on real val loss, matching
@@ -1092,6 +1195,12 @@ def _train_pipeline(
         # steps; every drain point below settles to a step boundary.
         window = DispatchWindow(dispatch_depth())
         obs.gauge("train.dispatch_depth", float(window.depth))
+        obs.event(
+            "train.remat_policy",
+            policy=active_remat_policy(model_cfg),
+            comm_overlap=False,  # the pipeline schedule microbatches itself
+            accum_steps=1,
+        )
 
         def settle(entry) -> None:
             step_no, loss, hstats, tokens, timed = entry

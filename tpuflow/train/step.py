@@ -302,12 +302,29 @@ def with_ema(state: TrainState) -> TrainState:
     )
 
 
+def comm_overlap_enabled(default: bool = True) -> bool:
+    """Resolve the comm/compute-overlap knob (ISSUE 10).
+
+    ``TPUFLOW_COMM_OVERLAP=0`` disables the per-microbatch gradient
+    reduce-scatter inside the accumulation scan (and the async-collective
+    XLA flags ``dist.maybe_enable_async_collectives`` would stage);
+    anything else — including unset — leaves it on. The knob only
+    changes programs where it can matter: ``make_train_step`` applies it
+    when ``accum_steps > 1`` AND the caller passed ``grad_shardings``.
+    """
+    return os.environ.get("TPUFLOW_COMM_OVERLAP", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
 def make_train_step(
     loss_fn: Callable = cross_entropy_loss,
     *,
     donate: bool = True,
     accum_steps: int = 1,
     ema_decay: float | None = None,
+    grad_shardings: Any = None,
+    comm_overlap: bool | None = None,
 ) -> Callable:
     """Build the jitted SPMD train step.
 
@@ -323,6 +340,24 @@ def make_train_step(
     identical to the full-batch step for mean losses (pinned by
     tests/test_train_step.py). The scan is a compiler-friendly loop: one
     trace, static shapes, grads carried in place.
+
+    Comm/compute overlap (ISSUE 10): with ``grad_shardings`` (the
+    per-leaf param shardings of the FSDP leg) and ``accum_steps > 1``,
+    each microbatch's gradient is pinned to those shardings INSIDE the
+    scan body (``with_sharding_constraint``), which makes GSPMD emit the
+    gradient reduce-scatter per microbatch — right behind that
+    microbatch's backward — instead of one deferred reduction after the
+    whole scan. With the async-collective XLA flags staged
+    (``dist.maybe_enable_async_collectives``), the TPU scheduler then
+    hides each bucket's DCN/ICI time behind the NEXT microbatch's
+    backward compute; the accumulator also stays SHARDED, cutting its
+    HBM footprint by the fsdp world size. The bucketing is the gradient
+    tree itself: each leaf is one collective, issued the moment its
+    microbatch produces it. ``comm_overlap=None`` resolves
+    ``TPUFLOW_COMM_OVERLAP`` (default on); the sequential scan is
+    recovered with ``TPUFLOW_COMM_OVERLAP=0``, and tests pin the two
+    programs' losses against each other
+    (tests/test_train_step.py::test_comm_overlap_scan_matches_sequential).
 
     Donation audit (ISSUE 4, dispatch-ahead): argument 0 (the state) is
     donated — XLA reuses its buffers for the new state, so the OLD state
@@ -341,6 +376,18 @@ def make_train_step(
         raise ValueError(
             f"ema_decay must be in (0, 1), got {ema_decay} (>= 1 freezes or "
             "diverges the average)"
+        )
+    if comm_overlap is None:
+        comm_overlap = comm_overlap_enabled()
+    overlap_active = (
+        comm_overlap and grad_shardings is not None and accum_steps > 1
+    )
+
+    def _pin_grads(tree):
+        # One with_sharding_constraint per gradient leaf: the per-
+        # microbatch reduce-scatter "bucket" issue points (overlap path).
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, tree, grad_shardings
         )
 
     def train_step(state: TrainState, batch, rng):
@@ -394,6 +441,14 @@ def make_train_step(
                 (l, (logits, updates)), g = grad_fn(
                     state.params, stats, mb, jax.random.fold_in(base_rng, idx)
                 )
+                if overlap_active:
+                    # Pin THIS microbatch's gradients to the param
+                    # shardings: GSPMD reduce-scatters them here, inside
+                    # the scan body, where the async scheduler can slide
+                    # the collective behind the next microbatch's
+                    # backward — instead of one exposed reduction after
+                    # the scan. The carried sum is then sharded too.
+                    g = _pin_grads(g)
                 carry = (
                     jax.tree_util.tree_map(jnp.add, gsum, g),
                     lsum + l,
@@ -405,6 +460,8 @@ def make_train_step(
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
+            if overlap_active:
+                zeros = _pin_grads(zeros)
             (gsum, lsum, asum, new_stats), _ = jax.lax.scan(
                 body,
                 (zeros, 0.0, 0.0, state.batch_stats),
@@ -518,3 +575,111 @@ def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
         }
 
     return jax.jit(eval_step)
+
+
+# ---------------------------------------------- comm/compute attribution
+# Aggregate ICI bandwidth per chip (GB/s, approximate public figures),
+# matched against jax.devices()[0].device_kind like the goodput ledger's
+# bf16-peak table. The denominator of the comm roofline below — an
+# ATTRIBUTION model, not a measurement, so round numbers are fine.
+_ICI_GBPS = (
+    ("v6 lite", 800.0),
+    ("v6lite", 800.0),
+    ("v6e", 800.0),
+    ("v5 lite", 400.0),
+    ("v5lite", 400.0),
+    ("v5e", 400.0),
+    ("v5p", 1200.0),
+    ("v5", 1200.0),
+    ("v4", 300.0),
+)
+
+
+def _ici_gbps() -> float | None:
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return None
+        kind = dev.device_kind.lower()
+        return next((v for k, v in _ICI_GBPS if k in kind), 400.0)
+    except Exception:
+        return None
+
+
+def comm_attribution(
+    step_s: float,
+    *,
+    tokens: int,
+    n_params: int,
+    accum_steps: int = 1,
+    fsdp_world: int = 1,
+    overlapped: bool = True,
+) -> dict | None:
+    """Roofline attribution of one step's wall time into compute vs
+    exposed communication (ISSUE 10): the numbers behind the
+    ``train.exposed_comm_s`` / ``train.comm_overlap_s`` gauges and the
+    bench train leg's ``exposed_comm_s`` record.
+
+    This is a MODEL, stated as bounds, not a device measurement (XLA
+    fuses the collectives into the step program; the host cannot time
+    them separately without a profiler capture):
+
+    - ``ideal_compute_s`` = 6·N FLOPs/token × tokens ÷ (bf16 peak ×
+      devices) — the same estimate the rolling-MFU gauge uses.
+    - ``exposed_comm_s`` = max(0, step_s − ideal_compute_s): every
+      second the step spent NOT at peak compute. An UPPER bound on
+      exposed communication (memory stalls and pipeline bubbles charge
+      here too — attributing them to comm keeps the overlap claim
+      conservative).
+    - ``ideal_comm_s``: the FSDP step's collective volume at aggregate
+      ICI bandwidth — per microbatch a param all-gather for fwd and one
+      for the (remat) bwd, plus a gradient reduce-scatter per microbatch
+      when overlapped (once per step when sequential: overlap trades
+      (accum−1) extra grad reductions for hideability), each moving
+      4 bytes × N × (w−1)/w per device.
+    - ``comm_overlap_s`` = max(0, ideal_comm_s − exposed_comm_s): a
+      LOWER bound on the comm time hidden behind compute.
+
+    Returns None off-TPU (no peak table — an invented attribution would
+    be noise) and with ``fsdp_world <= 1`` sets the comm terms to 0
+    (single-shard: nothing to gather or scatter).
+    """
+    from tpuflow.obs import goodput as _gp
+
+    peak = _gp._peak_flops_per_device()
+    if peak is None or step_s <= 0.0 or n_params <= 0:
+        return None
+    import jax
+
+    ndev = max(jax.device_count(), 1)
+    ideal_compute_s = 6.0 * n_params * tokens / (peak * ndev)
+    exposed = max(0.0, step_s - ideal_compute_s)
+    ideal_comm_s = 0.0
+    ici = _ici_gbps()
+    if fsdp_world > 1 and ici:
+        frac = (fsdp_world - 1) / fsdp_world
+        bytes_per_pass = 4.0 * n_params * frac
+        ag_passes = 2 * max(accum_steps, 1)
+        rs_passes = max(accum_steps, 1) if overlapped else 1
+        ideal_comm_s = (ag_passes + rs_passes) * bytes_per_pass / (
+            ici * 1e9
+        )
+    return {
+        "ideal_compute_s": ideal_compute_s,
+        "ideal_comm_s": ideal_comm_s,
+        "exposed_comm_s": exposed,
+        "comm_overlap_s": max(0.0, ideal_comm_s - exposed),
+        "overlapped": bool(overlapped),
+    }
+
+
+def emit_comm_gauges(att: dict | None) -> None:
+    """Publish a step's comm attribution onto the telemetry stream.
+    No-op when the attribution is unavailable (off-TPU) or telemetry is
+    disabled — the gauges only ever carry chip-grounded values."""
+    if att is None or not obs.enabled():
+        return
+    obs.gauge("train.exposed_comm_s", round(att["exposed_comm_s"], 6))
+    obs.gauge("train.comm_overlap_s", round(att["comm_overlap_s"], 6))
