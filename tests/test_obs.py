@@ -314,6 +314,12 @@ def test_obs_catalog_lint():
         ("span", "serve.warmup"),
         ("span", "serve.prefill"),
         ("span", "serve.decode"),
+        # Paged KV serving (ISSUE 11) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
+        ("gauge", "serve.pages_free"),
+        ("gauge", "serve.prefix_hits"),
+        ("gauge", "serve.spec_accept_rate"),
+        ("event", "serve.page_evict"),
         # Native int8 decode (ISSUE 9) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
         ("span", "serve.quant_decode"),

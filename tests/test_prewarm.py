@@ -41,10 +41,12 @@ def test_seed_compile_cache_copies_missing_only(tmp_path):
 @pytest.mark.slow
 def test_prewarm_tool_populates_cache_end_to_end(tmp_path):
     """The tool AOT-compiles the train-step + serving signatures (fp AND
-    the int8 twin) into a chosen cache dir WITHOUT executing a step —
-    run in a subprocess because force-enabling the persistent cache on
-    CPU must not leak into this test process (the XLA:CPU AOT reloader
-    is the documented SIGABRT risk maybe_enable_compile_cache guards)."""
+    the int8 twin, the paged decode block + page insert, and the
+    speculative verify pair) into a chosen cache dir WITHOUT executing a
+    step — run in a subprocess because force-enabling the persistent
+    cache on CPU must not leak into this test process (the XLA:CPU AOT
+    reloader is the documented SIGABRT risk maybe_enable_compile_cache
+    guards)."""
     cache = tmp_path / "prewarm"
     cache.mkdir()
     out = subprocess.run(
@@ -53,6 +55,7 @@ def test_prewarm_tool_populates_cache_end_to_end(tmp_path):
             "--preset", "test", "--batch", "2", "--seq-len", "32",
             "--cache-dir", str(cache), "--buckets", "8", "--slots", "2",
             "--decode-block", "2", "--max-new", "8", "--quant",
+            "--spec", "2", "--page-size", "8",
             "--allow-cpu",
         ],
         capture_output=True, text=True, timeout=420,
@@ -62,11 +65,12 @@ def test_prewarm_tool_populates_cache_end_to_end(tmp_path):
     entries = [p for p in cache.iterdir() if p.is_file()]
     assert entries, "prewarm wrote no cache entries"
     # fp + int8 serving programs and the train step all lowered:
-    # 1 train step + 2 decodes + 2 prefills (one bucket) + 1 insert.
+    # 1 train step + 2 decodes + 2 verify blocks + 2 prefills (one
+    # bucket) + 1 page insert (ServeEngine.aot_lower owns the list).
     import json
 
     rec = json.loads(out.stdout.splitlines()[0])
-    assert rec["programs_compiled"] == 6
+    assert rec["programs_compiled"] == 8
     assert rec["cache_entries"] == len(entries)
     # A gang member pointed at the prewarmed dir seeds its own cache.
     member_cache = tmp_path / "member"

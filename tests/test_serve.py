@@ -1,16 +1,24 @@
-"""Continuous-batching serving engine (tpuflow.infer.serve, ISSUE 8).
+"""Continuous-batching serving engine (tpuflow.infer.serve, ISSUE 8;
+paged KV + shared-prefix reuse + per-request speculative decode,
+ISSUE 11).
 
 The load-bearing contracts:
 
-- **Token exactness.** Every request decoded through the slot-based
-  engine — admitted into a reused slot, left-padded to a bucket width,
-  batched beside unrelated sequences — produces exactly the greedy
-  tokens of a solo ``generate()`` of its prompt (decode_precision
-  pinning from PR 4 makes batched decode width-independent).
-- **Never recompiles after warmup.** One persistent decode program, one
-  insert pair, a bounded prefill-bucket set: the jit cache sizes after
-  ``warmup()`` never grow across admissions, evictions, eos exits, and
-  slot reuse.
+- **Token exactness.** Every request decoded through the engine —
+  admitted into a reused slot, left-padded to a bucket width, scattered
+  across pool pages, batched beside unrelated sequences, drafted-and-
+  verified speculatively — produces exactly the greedy tokens of a solo
+  ``generate()`` of its prompt (decode_precision pinning from PR 4
+  makes batched decode width-independent; int8 contractions are
+  integer-exact).
+- **Never recompiles after warmup.** One persistent decode program (+
+  verify block when spec-armed), one insert pair, a bounded
+  prefill-bucket set: the jit cache sizes after ``warmup()`` never grow
+  across admissions, evictions, eos exits, slot reuse, page allocation,
+  and prefix sharing — page tables are DATA.
+- **Page accounting is host-pure.** PagePool (allocation, refcounts,
+  prefix matching, LRU eviction, backpressure) is plain python/numpy —
+  its edge cases are pinned with zero compiles.
 - **Chunked-prefill admission boundaries.** Prompt lengths exactly on /
   one off a chunk boundary, pad_lens interaction, and bucket reuse all
   decode token-exactly with zero fresh compiles per admission.
@@ -46,12 +54,17 @@ def model_params():
 
 @pytest.fixture(scope="module")
 def engine(model_params):
-    """One warmed 2-slot engine shared by the fast tests (the engine is
-    long-lived by design; sharing it across tests IS the contract)."""
+    """One warmed 2-slot PAGED engine shared by the fast tests (the
+    engine is long-lived by design; sharing it across tests IS the
+    contract). page_size=8 puts page boundaries inside the fast tests'
+    prompt lengths, so the shared programs double as the page-boundary
+    exactness coverage."""
     model, params = model_params
     eng = ServeEngine(
-        model, params, max_slots=2, buckets=[8, 16], decode_block=4
+        model, params, max_slots=2, buckets=[8, 16], decode_block=4,
+        page_size=8,
     )
+    assert eng.paged  # ISSUE 11: paged is the default engine
     eng.warmup()
     return eng
 
@@ -66,6 +79,104 @@ def _solo(model, params, prompt, n_new, **kw):
 
 
 # ------------------------------------------------------------ pure units
+def test_page_pool_accounting():
+    """PagePool host-side edges with zero compiles: trash-page reserve,
+    allocation, backpressure, prefix chain matching + self-registration,
+    refcounts across sharers, idle retention, and LRU eviction."""
+    from tpuflow.infer.serve import PagePool
+
+    pool = PagePool(n_pages=6, page_size=4)  # pages 1..5 usable
+    assert pool.usable_pages == 5 and pool.free_pages == 5
+    prompt = np.arange(10, dtype=np.int32)  # 2 full pages + 2 tokens
+    digests = pool.prefix_digests(prompt)
+    assert len(digests) == 2  # only FULLY prompt-covered pages hash
+    assert pool.match_len(digests) == 0
+    ids, matched = pool.acquire(prompt, 3)
+    assert matched == 0 and len(ids) == 3 and 0 not in ids
+    assert pool.free_pages == 2 and pool.allocated_pages == 3
+    # Second request, same prefix: the 2 full prompt pages are shared.
+    ids2, matched2 = pool.acquire(prompt, 3)
+    assert matched2 == 2 and ids2[:2] == ids[:2] and ids2[2] != ids[2]
+    assert pool.free_pages == 1  # one fresh page for the second request
+    assert pool.prefix_hits == 2
+    # Backpressure: a request needing 2 fresh pages cannot fit.
+    other = np.arange(100, 112, dtype=np.int32)
+    assert pool.acquire(other, 2) is None
+    # Release the first request: shared pages stay (the second request
+    # still holds them, refcount 1), its private page frees.
+    pool.release(ids)
+    assert pool.free_pages == 2 and pool.allocated_pages == 3
+    # Release the second: the prefix pages go IDLE (still matchable).
+    pool.release(ids2)
+    assert pool.allocated_pages == 0 and pool.free_pages == 5
+    assert pool.match_len(digests) == 2
+    # A matching request reactivates the idle pages without eviction.
+    ids3, matched3 = pool.acquire(prompt, 2)
+    assert matched3 == 2 and ids3 == ids[:2] and pool.evictions == 0
+    # Pool pressure evicts idle cached pages LRU-first.
+    pool.release(ids3)
+    ids4, m4 = pool.acquire(other, 5)
+    assert m4 == 0 and len(ids4) == 5
+    assert pool.evictions == 2  # both idle prefix pages reclaimed
+    assert pool.match_len(digests) == 0
+    # prefix_cache=False: nothing hashes, nothing shares.
+    flat = PagePool(n_pages=4, page_size=2, prefix_cache=False)
+    assert flat.prefix_digests(prompt) == []
+    a, m = flat.acquire(prompt, 2)
+    b, m2 = flat.acquire(prompt, 1)
+    assert m == m2 == 0 and not set(a) & set(b)
+    with pytest.raises(ValueError, match="n_pages"):
+        PagePool(n_pages=1, page_size=4)
+
+
+def test_ngram_draft_host():
+    from tpuflow.infer.speculative import ngram_draft
+
+    # Repetitive history: the 2-gram (8, 9) recurs — draft continues it.
+    h = np.array([7, 8, 9, 7, 8, 9, 7, 8, 9], np.int32)
+    np.testing.assert_array_equal(ngram_draft(h, 3), [7, 8, 9])
+    # Most RECENT occurrence wins.
+    h2 = np.array([1, 2, 3, 1, 2, 4, 1, 2], np.int32)
+    np.testing.assert_array_equal(ngram_draft(h2, 2), [4, 1])
+    # Ladder falls to 1-gram when the full gram never recurs.
+    h3 = np.array([5, 6, 9, 1, 9], np.int32)
+    np.testing.assert_array_equal(ngram_draft(h3, 2), [1, 9])
+    # No repetition at all: repeat-last-token fallback.
+    h4 = np.array([1, 2, 3], np.int32)
+    np.testing.assert_array_equal(ngram_draft(h4, 3), [3, 3, 3])
+    # Draft shorter than the tail pads with the last history token.
+    h5 = np.array([4, 5, 4, 5], np.int32)
+    out = ngram_draft(h5, 4)
+    assert out.shape == (4,)
+    with pytest.raises(ValueError, match="non-empty"):
+        ngram_draft(np.array([], np.int32), 2)
+
+
+def test_resolve_paged_knobs(monkeypatch):
+    from tpuflow.infer.serve import resolve_page_size, resolve_spec_draft
+
+    monkeypatch.delenv("TPUFLOW_SERVE_PAGE_SIZE", raising=False)
+    monkeypatch.delenv("TPUFLOW_SERVE_SPEC", raising=False)
+    assert resolve_page_size(1024) == 16
+    assert resolve_page_size(64, 8) == 8
+    with pytest.raises(ValueError, match="divide"):
+        resolve_page_size(64, 7)  # explicit bad arg raises
+    monkeypatch.setenv("TPUFLOW_SERVE_PAGE_SIZE", "7")
+    assert resolve_page_size(64) == 4  # env degrades to a divisor
+    monkeypatch.setenv("TPUFLOW_SERVE_PAGE_SIZE", "banana")
+    assert resolve_page_size(64) == 16
+    assert resolve_spec_draft() == 0
+    assert resolve_spec_draft(True) == 4
+    assert resolve_spec_draft(3) == 3
+    assert resolve_spec_draft(False) == 0
+    with pytest.raises(ValueError, match=">= 0"):
+        resolve_spec_draft(-1)
+    monkeypatch.setenv("TPUFLOW_SERVE_SPEC", "5")
+    assert resolve_spec_draft() == 5
+    monkeypatch.setenv("TPUFLOW_SERVE_SPEC", "yes-please")
+    assert resolve_spec_draft() == 0  # malformed env: off, loudly
+
+
 def test_bucket_ladders_and_env(monkeypatch):
     # The n_ctx bucket is never admittable (capacity is checked on the
     # PADDED width and max_new_tokens >= 1), so ladders top at n_ctx - 1.
@@ -115,6 +226,12 @@ def test_serve_ledger_feeds_metrics_export():
     led.note_serve_ttft(0.05)
     led.note_serve_complete()
     snap = led.snapshot()
+    assert "serve_pages_free" not in snap  # non-paged engine: no keys
+    assert "serve_spec_accept_rate" not in snap
+    led.note_serve_pages(free=12, total=16)
+    led.note_serve_prefix(hits=3, lookups=4)
+    led.note_serve_spec(committed=21, forwards=10)
+    snap = led.snapshot()
     assert snap["serve_queue_depth"] == 3
     assert snap["serve_slot_occupancy"] == 0.5
     assert snap["serve_requests"] == 1
@@ -122,10 +239,16 @@ def test_serve_ledger_feeds_metrics_export():
     assert snap["serve_tokens_per_s"] > 0
     assert snap["serve_ttft_p50_s"] == pytest.approx(0.25)
     assert snap["serve_ttft_p99_s"] == pytest.approx(0.25)
+    assert snap["serve_pages_free"] == 12
+    assert snap["serve_prefix_hit_rate"] == 0.75
+    assert snap["serve_spec_accept_rate"] == 2.1
     text = prometheus_text(snap)
     assert "tpuflow_serve_tokens_total 40" in text
     assert "tpuflow_serve_queue_depth 3" in text
     assert "tpuflow_serve_ttft_p50_seconds 0.25" in text
+    assert "tpuflow_serve_pages_free 12" in text
+    assert "tpuflow_serve_prefix_hit_rate 0.75" in text
+    assert "tpuflow_serve_spec_accept_rate 2.1" in text
 
 
 # ------------------------------------------------- engine decode contracts
@@ -190,6 +313,265 @@ def test_interleaved_submission_mid_decode(engine, model_params):
             r.result(), _solo(model, params, p, n)
         )
     assert engine.compile_stats() == base
+
+
+def test_page_boundary_lengths_exact(engine, model_params):
+    """Page-boundary edges through the SHARED fixture engine (page_size
+    8 — zero fresh compiles): prompt length one under / on / one over a
+    page boundary, with budgets landing the final frontier on and
+    around page multiples, all token-exact vs solo generate()."""
+    model, params = model_params
+    base = engine.compile_stats()
+    rng = np.random.default_rng(21)
+    for L, n in ((7, 7), (8, 7), (9, 7), (8, 8)):
+        p = rng.integers(0, 512, size=L).astype(np.int32)
+        r = engine.submit(p, max_new_tokens=n)
+        engine.run_until_idle(max_iters=100)
+        np.testing.assert_array_equal(
+            r.result(), _solo(model, params, p, n)
+        )
+        assert r.finish_reason == "budget"
+    assert engine.compile_stats() == base, "page edges recompiled"
+    # Pages held by finished requests are all released.
+    assert engine.pool.allocated_pages == 0
+
+
+# ------------------------------------------- paged engine (ISSUE 11, slow)
+@pytest.mark.slow
+def test_prefix_cache_reuse_eviction_and_residency(model_params):
+    """Shared-prefix page reuse end to end: two requests whose prompts
+    share a 2-page system prefix decode bit-equal to solo generate()
+    while the second SHARES the first's prefix pages (refcounted, hit-
+    counted); after release the pages idle in the cache, a matching
+    third request reactivates them, and pool pressure evicts them
+    LRU-first with a serve.page_evict trail. Residency efficiency beats
+    the contiguous engine's on the same traffic."""
+    model, params = model_params
+    eng = ServeEngine(
+        model, params, max_slots=2, buckets=[8, 16, 32], decode_block=4,
+        page_size=8, n_pages=9,  # 8 usable pages: tight enough to evict
+    )
+    base = eng.warmup()
+    rng = np.random.default_rng(22)
+    pre = rng.integers(0, 512, size=16).astype(np.int32)  # 2 full pages
+    pa = np.concatenate([pre, rng.integers(0, 512, size=3).astype(np.int32)])
+    pb = np.concatenate([pre, rng.integers(0, 512, size=5).astype(np.int32)])
+    ra = eng.submit(pa, max_new_tokens=5)
+    eng.step()
+    # Mid-flight admission shares the LIVE request's prefix pages.
+    rb = eng.submit(pb, max_new_tokens=5)
+    eng.run_until_idle(max_iters=200)
+    np.testing.assert_array_equal(ra.result(), _solo(model, params, pa, 5))
+    np.testing.assert_array_equal(rb.result(), _solo(model, params, pb, 5))
+    assert eng.pool.prefix_hits == 2  # rb reused both prefix pages
+    assert eng.pool.evictions == 0
+    # All request pages released; the 2 prefix pages idle in the cache.
+    assert eng.pool.allocated_pages == 0
+    assert eng.pool.free_pages == 8
+    # Reactivation: a third sharer allocates only its private tail.
+    rc = eng.submit(pa, max_new_tokens=4)
+    eng.run_until_idle(max_iters=200)
+    np.testing.assert_array_equal(rc.result(), _solo(model, params, pa, 4))
+    assert eng.pool.prefix_hits == 4 and eng.pool.evictions == 0
+    # Pressure: a fat unrelated request needs every free page -> the
+    # idle prefix pages are evicted (LRU), never the trash page.
+    fat = rng.integers(0, 512, size=30).astype(np.int32)
+    rf = eng.submit(fat, max_new_tokens=30)  # ceil(60/8) = 8 pages
+    eng.run_until_idle(max_iters=300)
+    np.testing.assert_array_equal(
+        rf.result(), _solo(model, params, fat, 30)
+    )
+    assert eng.pool.evictions == 2
+    assert eng.compile_stats() == base, "paged engine recompiled"
+    # Residency: short requests on the paged engine keep most allocated
+    # tokens resident, while a contiguous engine strands the n_ctx row.
+    # max_new outlives one decode block so the sample sees a live slot.
+    r1 = eng.submit(pa, max_new_tokens=6)
+    eng.step()
+    paged_res = eng.residency_efficiency()
+    eng.run_until_idle(max_iters=200)
+    flat = ServeEngine(
+        model, params, max_slots=2, buckets=[8, 16, 32], decode_block=4,
+        paged=False,
+    )
+    flat.warmup()
+    r2 = flat.submit(pa, max_new_tokens=6)
+    flat.step()
+    flat_res = flat.residency_efficiency()
+    flat.run_until_idle(max_iters=200)
+    np.testing.assert_array_equal(r1.result(), r2.result())
+    assert paged_res is not None and flat_res is not None
+    assert paged_res > flat_res, (paged_res, flat_res)
+
+
+@pytest.mark.slow
+def test_page_pool_exhaustion_backpressure(model_params):
+    """Pool exhaustion = admission BACKPRESSURE: the head-of-queue
+    request waits (queued, never dropped) while a free slot exists but
+    pages don't, admits as soon as a finishing request releases pages,
+    and decodes exactly."""
+    model, params = model_params
+    eng = ServeEngine(
+        model, params, max_slots=2, buckets=[8], decode_block=4,
+        page_size=8, n_pages=3, prefix_cache=False,  # 2 usable pages
+    )
+    eng.warmup()
+    rng = np.random.default_rng(23)
+    p = rng.integers(0, 512, size=4).astype(np.int32)
+    q1 = eng.submit(p, max_new_tokens=8)  # needs ceil(12/8) = 2 pages
+    q2 = eng.submit(p, max_new_tokens=8)  # needs 2 more: must wait
+    eng.step()
+    assert q1.state == "running"
+    assert q2.state == "queued" and eng.queue_depth == 1
+    assert eng._free_slot() is not None  # a slot IS free; pages are not
+    eng.run_until_idle(max_iters=300)
+    assert q1.done and q2.done
+    np.testing.assert_array_equal(q2.result(), _solo(model, params, p, 8))
+    # A request that could NEVER fit the pool fails eagerly at submit.
+    with pytest.raises(ValueError, match="pool"):
+        eng.submit(rng.integers(0, 512, size=8).astype(np.int32),
+                   max_new_tokens=20)
+
+
+@pytest.mark.slow
+def test_speculative_engine_token_exact(model_params):
+    """Per-request speculative decode inside the batched block: a
+    repetitive prompt (high n-gram acceptance) and a random prompt (low
+    acceptance) decode BIT-equal to solo generate() side by side; eos
+    inside a verify window truncates at its first occurrence; the
+    capacity edge (prompt + budget == n_ctx) stays exact with the
+    rejected-tail overshoot routed to the trash page; a speculative=False
+    request opts out mid-traffic; zero recompiles after warmup and a
+    spec_accept_rate above the 1.0 no-win floor on the repetitive leg."""
+    model, params = model_params
+    eng = ServeEngine(
+        model, params, max_slots=2, buckets=[8, 16], decode_block=4,
+        page_size=8, speculative=3,
+    )
+    base = eng.warmup()
+    assert {"verify"} <= set(base)
+    rng = np.random.default_rng(24)
+    prep = np.array([7, 8, 9, 7, 8, 9, 7, 8], np.int32)
+    prand = rng.integers(0, 512, size=11).astype(np.int32)
+    r1 = eng.submit(prep, max_new_tokens=10)
+    r2 = eng.submit(prand, max_new_tokens=7)
+    eng.run_until_idle(max_iters=200)
+    np.testing.assert_array_equal(r1.result(), _solo(model, params, prep, 10))
+    np.testing.assert_array_equal(r2.result(), _solo(model, params, prand, 7))
+    assert eng.spec_accept_rate is not None and eng.spec_accept_rate >= 1.0
+    # eos truncation inside the verify window.
+    want = _solo(model, params, prep, 10)
+    eos = int(want[4])
+    first = int(np.argmax(want == eos))
+    r3 = eng.submit(prep, max_new_tokens=10, eos_id=eos)
+    eng.run_until_idle(max_iters=200)
+    assert r3.finish_reason == "eos" and r3.tokens == list(want[:first + 1])
+    # Capacity edge: the verify window overshoots n_ctx near the end.
+    p_edge = rng.integers(0, 512, size=10).astype(np.int32)
+    r4 = eng.submit(p_edge, max_new_tokens=54)  # 10 + 54 == n_ctx
+    eng.run_until_idle(max_iters=400)
+    np.testing.assert_array_equal(
+        r4.result(), _solo(model, params, p_edge, 54)
+    )
+    # Opt-out rides the plain block beside a speculative neighbor.
+    r5 = eng.submit(prep, max_new_tokens=10, speculative=False)
+    r6 = eng.submit(prand, max_new_tokens=5)
+    eng.run_until_idle(max_iters=200)
+    np.testing.assert_array_equal(r5.result(), _solo(model, params, prep, 10))
+    np.testing.assert_array_equal(r6.result(), _solo(model, params, prand, 5))
+    assert eng.compile_stats() == base, "speculative engine recompiled"
+    # speculative=True on an unarmed engine fails eagerly.
+    plain = ServeEngine(
+        model, params, max_slots=1, buckets=[8], decode_block=2,
+        page_size=8,
+    )
+    with pytest.raises(ValueError, match="spec-armed"):
+        plain.submit(prep, max_new_tokens=4, speculative=True)
+    with pytest.raises(ValueError, match="paged"):
+        ServeEngine(model, params, paged=False, speculative=2)
+
+
+@pytest.mark.slow
+def test_mixed_spec_int8_prefix_slot_reuse(model_params, monkeypatch):
+    """ISSUE 11 acceptance: all four traffic groups — (fp, int8) x
+    (speculative, plain) — INTERLEAVED through one 2-slot paged engine
+    with a shared prefix in the mix: every request bit-equal to the solo
+    generate() of its numeric path's model, slots and pages reused
+    across groups, zero fresh compiles after warmup (compile_stats
+    carries verify/verify_q), env arming included."""
+    from tpuflow.infer.quant import quantize_model
+
+    model, params = model_params
+    qm, qp = quantize_model(model, params, mode="fused_native")
+    monkeypatch.setenv("TPUFLOW_SERVE_QUANT", "1")
+    monkeypatch.setenv("TPUFLOW_SERVE_SPEC", "3")
+    monkeypatch.setenv("TPUFLOW_SERVE_PAGE_SIZE", "8")
+    eng = ServeEngine(model, params, max_slots=2, buckets=[8, 16],
+                      decode_block=4)
+    assert eng.quant_mode == "mxu" and eng.spec_draft == 3 and eng.paged
+    base = eng.warmup()
+    assert {"verify", "verify_q", "prefill_q", "decode_q"} <= set(base)
+    rng = np.random.default_rng(25)
+    prep = np.array([7, 8, 9, 7, 8, 9, 7], np.int32)
+    pa = rng.integers(0, 512, size=5).astype(np.int32)
+    pb = rng.integers(0, 512, size=3).astype(np.int32)
+    r_fp_spec = eng.submit(prep, max_new_tokens=8)
+    r_q_spec = eng.submit(prep, max_new_tokens=8, quantize=True)
+    eng.step()
+    r_fp_plain = eng.submit(pa, max_new_tokens=6, speculative=False)
+    r_q_plain = eng.submit(
+        pb, max_new_tokens=6, quantize=True, speculative=False
+    )
+    eng.run_until_idle(max_iters=300)
+    np.testing.assert_array_equal(
+        r_fp_spec.result(), _solo(model, params, prep, 8)
+    )
+    np.testing.assert_array_equal(r_q_spec.result(), _solo(qm, qp, prep, 8))
+    np.testing.assert_array_equal(
+        r_fp_plain.result(), _solo(model, params, pa, 6)
+    )
+    np.testing.assert_array_equal(r_q_plain.result(), _solo(qm, qp, pb, 6))
+    # Slot + page reuse ACROSS groups: the slots that served fp-spec now
+    # serve int8-plain and vice versa; a shared prefix rides along.
+    pre = rng.integers(0, 512, size=8).astype(np.int32)  # one full page
+    pc = np.concatenate([pre, rng.integers(0, 512, size=2).astype(np.int32)])
+    pd = np.concatenate([pre, rng.integers(0, 512, size=4).astype(np.int32)])
+    h0 = eng.pool.prefix_hits
+    r1 = eng.submit(pc, max_new_tokens=5, quantize=True)
+    r2 = eng.submit(pd, max_new_tokens=5, speculative=False)
+    eng.run_until_idle(max_iters=300)
+    np.testing.assert_array_equal(r1.result(), _solo(qm, qp, pc, 5))
+    np.testing.assert_array_equal(r2.result(), _solo(model, params, pd, 5))
+    assert eng.pool.prefix_hits > h0  # pd reused pc's prefix page
+    assert eng.compile_stats() == base, "mixed-traffic engine recompiled"
+    assert eng.live_slots == 0 and eng.pool.allocated_pages == 0
+
+
+@pytest.mark.slow
+def test_nonpaged_regression_reference(model_params, monkeypatch):
+    """TPUFLOW_SERVE_PAGED=0 keeps the PR 8 contiguous slot rows (the
+    one-release regression reference): exactness + never-recompile hold
+    on the legacy path, and the paged knobs stay inert on it."""
+    model, params = model_params
+    monkeypatch.setenv("TPUFLOW_SERVE_PAGED", "0")
+    eng = ServeEngine(
+        model, params, max_slots=2, buckets=[8, 16], decode_block=4
+    )
+    assert not eng.paged and eng.pool is None
+    base = eng.warmup()
+    rng = np.random.default_rng(26)
+    prompts = [rng.integers(0, 512, size=L).astype(np.int32)
+               for L in (3, 8, 11)]
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle(max_iters=200)
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(
+            r.result(), _solo(model, params, p, 6)
+        )
+    assert eng.compile_stats() == base
+    # Contiguous capacity semantics: the PADDED width eats cache columns.
+    with pytest.raises(ValueError, match="no prefill bucket"):
+        eng.bucket_for(9, 50)  # bucket 16 + 50 > n_ctx=64
 
 
 # ------------------------------------ chunked prefill admission boundaries

@@ -95,6 +95,13 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("span", "serve.warmup"),
     ("span", "serve.prefill"),
     ("span", "serve.decode"),
+    # Paged KV serving (ISSUE 11): the page-pool / prefix-cache /
+    # speculative-acceptance surface the Serving runbook's paged section
+    # and the /metrics tpuflow_serve_* names read.
+    ("gauge", "serve.pages_free"),
+    ("gauge", "serve.prefix_hits"),
+    ("gauge", "serve.spec_accept_rate"),
+    ("event", "serve.page_evict"),
     # Native int8 decode (ISSUE 9): the per-request int8 serving trail
     # and the quantization-decision evidence the Quantization runbook
     # reads — deleting these emitters would orphan it.

@@ -24,7 +24,8 @@ Usage::
 
     python tools/prewarm_cache.py --preset gpt2 --batch 8 --seq-len 512 \
         --cache-dir /shared/prewarm [--no-train] [--no-serve] \
-        [--quant] [--slots 8] [--buckets 16,32,64] [--max-new 128]
+        [--quant] [--spec K] [--slots 8] [--buckets 16,32,64] \
+        [--page-size 16] [--pages N] [--max-new 128]
 
 Then launch the gang with ``TPUFLOW_PREWARM_CACHE=/shared/prewarm``.
 
@@ -74,6 +75,20 @@ def _parse(argv):
                    help="skip the serving decode/prefill/insert signatures")
     p.add_argument("--quant", action="store_true",
                    help="also prewarm the int8 (fused-native) serving twin")
+    p.add_argument("--spec", type=int, default=None, metavar="K",
+                   help="arm per-request speculative decode at draft "
+                        "length K and prewarm the verify-block "
+                        "signature(s) (ISSUE 11: a spec-armed gang "
+                        "would otherwise pay the verify compile cold)")
+    p.add_argument("--page-size", type=int, default=None,
+                   help="paged-KV page size (default TPUFLOW_SERVE_"
+                        "PAGE_SIZE/16)")
+    p.add_argument("--pages", type=int, default=None,
+                   help="paged-KV pool size (default slots * n_ctx / "
+                        "page_size + 1)")
+    p.add_argument("--no-paged", action="store_true",
+                   help="prewarm the legacy contiguous slot-row "
+                        "signatures instead of the paged ones")
     p.add_argument("--slots", type=int, default=None,
                    help="serving slots (default TPUFLOW_SERVE_SLOTS/8)")
     p.add_argument("--buckets", default=None,
@@ -203,12 +218,6 @@ def prewarm(args) -> dict:
             del sstate
 
     if not args.no_serve:
-        import functools
-
-        from tpuflow.infer.generate import (
-            normalize_prefill_chunk,
-            prompt_lens_to_pad_lens,
-        )
         from tpuflow.infer.serve import ServeEngine
 
         buckets = (
@@ -221,42 +230,17 @@ def prewarm(args) -> dict:
             buckets=buckets,
             decode_block=args.decode_block,
             quant="fused_native" if args.quant else None,
+            paged=False if args.no_paged else None,
+            page_size=args.page_size,
+            n_pages=args.pages,
+            speculative=args.spec,
         )
-        pairs = [(engine._prefill, engine._decode, engine.params)]
-        if args.quant:
-            pairs.append(
-                (engine._prefill_q, engine._decode_q, engine._qparams)
-            )
-        row_shape = None
-        for prefill, decode, prm in pairs:
-            decode.lower(
-                prm, engine._cache, engine._tok, engine._lengths,
-                engine._pads, engine._remaining, engine._live, engine._eos,
-            ).compile()
-            programs += 1
-            for w in engine.buckets:
-                if w + args.max_new > engine.n_ctx:
-                    continue  # bucket the run could never admit into
-                chunk = normalize_prefill_chunk(engine.prefill_chunk, w)
-                pf_args = (
-                    prm,
-                    jnp.zeros((1, w), jnp.int32),
-                    prompt_lens_to_pad_lens([w], 1, w),
-                )
-                prefill.lower(*pf_args, chunk=chunk).compile()
-                programs += 1
-                row_shape = jax.eval_shape(
-                    functools.partial(prefill, chunk=chunk), *pf_args
-                )[1]
-        if row_shape is not None:
-            # The insert signature (abstract row cache from eval_shape —
-            # no prefill ever executes). The decode-committed second
-            # signature only diverges under sharded params; the
-            # engine's own warmup() covers it at server start.
-            engine._insert.lower(
-                engine._cache, row_shape, jnp.int32(0)
-            ).compile()
-            programs += 1
+        # The engine owns its AOT signature list (decode block, verify
+        # block, page/slot insert, bucket prefills, int8 twins) so this
+        # tool can never drift from the programs the scheduler replays
+        # — ISSUE 11 moved the per-signature lowering into
+        # ServeEngine.aot_lower when the paged/spec programs landed.
+        programs += engine.aot_lower(max_new_tokens=args.max_new)
 
     try:
         entries = len([

@@ -57,11 +57,51 @@ Shape of the engine:
   its state). ``compile_stats()`` still never grows after warmup — the
   never-recompile contract covers the quantized program too.
 
+- **Paged KV (ISSUE 11, default on).** The per-slot contiguous
+  ``(max_slots, n_ctx)`` cache rows become a fixed POOL of
+  ``(n_pages, page_size)`` pages plus a per-slot page table threaded
+  through the decode block as data (``Block._paged_attention``) — the
+  same "state is data, never shape" trick that made slots
+  recompile-free now covers page allocation. What paging buys:
+
+  * **Admission by token budget.** A request is admitted when its page
+    need (``ceil((len + max_new [+ draft slack]) / page_size)``) fits
+    the free pool, not when a whole ``n_ctx`` row is free — short
+    requests stop stranding HBM, and a full pool applies BACKPRESSURE
+    (the request stays queued, never dropped). Capacity checks move
+    from the padded bucket width to the REAL prompt length (bucket
+    pads no longer eat cache columns: the page insert strips them).
+  * **Shared-prefix page reuse.** Prompt pages are content-hashed at
+    page granularity (a chain over ``prompt[:(j+1)*page_size]``) into a
+    refcounted prefix cache: a request whose prompt starts with an
+    already-resident prefix (system prompt, few-shot header) maps those
+    pages into its table instead of allocating copies. Pad-invariant kv
+    (the left-pad exactness contract) is what makes the reuse sound.
+    Idle (refcount-0) prefix pages stay cached until pool pressure
+    evicts them LRU-first (``serve.page_evict``).
+  * **Per-request speculative decode.** ``TPUFLOW_SERVE_SPEC=K`` (or
+    ``speculative=K``) arms an in-program verify block: each live slot
+    drafts K tokens on the host (``tpuflow.infer.speculative.
+    ngram_draft`` — prompt-lookup, no draft model), ONE batched
+    (S, K+1) forward verifies them, and every row commits its own
+    accepted prefix + bonus token — per-row frontiers that the solo
+    ladder's shared cache index could never allow. Acceptance argmaxes
+    are width-safe by construction (``decode_precision='highest'`` from
+    PR 4; int8 contractions are integer-exact, PR 9), so engine tokens
+    stay bit-equal to solo ``generate()``. ``submit(speculative=False)``
+    opts a request out (it rides the plain single-token block).
+
 Knobs: ``TPUFLOW_SERVE_SLOTS`` (default 8), ``TPUFLOW_SERVE_PREFILL_CHUNK``
 (default off), ``TPUFLOW_SERVE_BUCKETS`` (comma widths; default a
 power-of-two ladder up to ``n_ctx``), ``TPUFLOW_SERVE_DECODE_BLOCK``
 (tokens per decode dispatch, default 8), ``TPUFLOW_SERVE_QUANT``
 (=1/fused_native/weight_only arms per-request int8; default off),
+``TPUFLOW_SERVE_PAGED`` (=0 keeps the PR 8 contiguous slot rows — the
+regression reference, kept one release), ``TPUFLOW_SERVE_PAGE_SIZE``
+(default 16 tokens), ``TPUFLOW_SERVE_PAGES`` (pool size; default
+``max_slots * n_ctx / page_size + 1`` — equal HBM to the slot rows),
+``TPUFLOW_SERVE_PREFIX_CACHE`` (=0 disables shared-prefix reuse),
+``TPUFLOW_SERVE_SPEC`` (=K arms per-request speculative decode),
 ``TPUFLOW_SERVE`` (=0 keeps ``GenerationPredictor`` on the legacy
 per-batch path).
 
@@ -77,6 +117,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import hashlib
 import os
 import time
 
@@ -90,6 +131,7 @@ from tpuflow.infer.generate import (
     normalize_prefill_chunk,
     prompt_lens_to_pad_lens,
 )
+from tpuflow.infer.speculative import ngram_draft
 
 
 def _env_int(name: str, default: int, *, minimum: int = 1) -> int:
@@ -138,6 +180,222 @@ def resolve_serve_quant(quant=None) -> str | None:
     if quant is True:
         return "mxu"
     return canonical_mode(quant)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "off")
+
+
+def resolve_page_size(n_ctx: int, page_size=None) -> int:
+    """Page width in tokens. Must divide ``n_ctx`` (the per-slot table is
+    a dense ``n_ctx / page_size`` map). An explicit bad arg raises; a
+    malformed/indivisible ENV value degrades to the largest divisor of
+    ``n_ctx`` at or below the default with a warning (the bucket-knob
+    blast-radius split)."""
+    explicit = page_size is not None
+    from_env = False
+    if page_size is None:
+        raw = os.environ.get("TPUFLOW_SERVE_PAGE_SIZE")
+        if raw:
+            try:
+                page_size = int(raw)
+                from_env = True
+            except ValueError:
+                print(
+                    f"[tpuflow] malformed TPUFLOW_SERVE_PAGE_SIZE={raw!r} "
+                    "(want an integer); using the default"
+                )
+    ps = int(page_size) if page_size is not None else 16
+    if explicit:
+        if ps < 1 or n_ctx % ps:
+            raise ValueError(
+                f"page_size must be >= 1 and divide n_ctx={n_ctx}, got {ps}"
+            )
+        return ps
+    want = ps
+    ps = max(min(ps, n_ctx), 1)
+    while n_ctx % ps:
+        ps -= 1
+    if ps != want and from_env:
+        print(
+            f"[tpuflow] TPUFLOW_SERVE_PAGE_SIZE={want} does not divide "
+            f"n_ctx={n_ctx}; using {ps}"
+        )
+    return ps
+
+
+def resolve_spec_draft(speculative=None) -> int:
+    """Per-request speculative draft length: 0 = off. ``True`` means the
+    default draft of 4; an int is the draft length itself. The ENV path
+    (``TPUFLOW_SERVE_SPEC``) accepts the same spellings, malformed
+    values falling to off with a warning."""
+    if speculative is None:
+        raw = os.environ.get("TPUFLOW_SERVE_SPEC", "").strip().lower()
+        if raw in ("", "0", "false", "off"):
+            return 0
+        if raw in ("1", "true", "on"):
+            return 4
+        try:
+            return max(int(raw), 0)
+        except ValueError:
+            print(
+                f"[tpuflow] malformed TPUFLOW_SERVE_SPEC={raw!r} (want an "
+                "integer draft length); speculative decode stays off"
+            )
+            return 0
+    if speculative is False:
+        return 0
+    if speculative is True:
+        return 4
+    k = int(speculative)
+    if k < 0:
+        raise ValueError(f"speculative draft length must be >= 0, got {k}")
+    return k
+
+
+class PagePool:
+    """Host-side accounting for the paged KV cache: free-list
+    allocation, shared-prefix refcounts, and LRU eviction of idle cached
+    prefix pages. Pure python/numpy — the DEVICE side only ever sees the
+    resulting page tables as data, so this logic is unit-testable with
+    zero compiles (tests/test_serve.py).
+
+    Page 0 is the reserved TRASH page: never allocated, never read.
+    Dead slots' zeroed tables and out-of-range writes route there inside
+    the decode program (``Block._paged_attention``), which is what makes
+    freeing + re-allocating a page safe while its old slot still sits in
+    the batch operands.
+
+    Prefix sharing: page j of a prompt is shareable when it is FULLY
+    covered by prompt tokens (``(j+1) * page_size <= len``  — decode
+    writes start at ``len``, so shared pages are never written) and is
+    keyed by the sha1 of the entire prompt prefix through that page
+    (causal attention makes page content a pure function of the
+    prefix). A matched page's refcount bumps instead of allocating; at
+    release, refcount-0 cached pages go IDLE (still matchable) and are
+    only reclaimed by LRU eviction under pool pressure
+    (``serve.page_evict``)."""
+
+    def __init__(self, n_pages: int, page_size: int,
+                 prefix_cache: bool = True):
+        if n_pages < 2:
+            raise ValueError(
+                f"n_pages must be >= 2 (page 0 is the reserved trash "
+                f"page), got {n_pages}"
+            )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.prefix_cache = bool(prefix_cache)
+        self._free: list[int] = list(range(self.n_pages - 1, 0, -1))
+        self._ref: dict[int, int] = {}
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_hash: dict[int, bytes] = {}
+        self._idle: collections.OrderedDict[int, None] = (
+            collections.OrderedDict()
+        )
+        self.prefix_hits = 0
+        self.prefix_lookups = 0
+        self.evictions = 0
+
+    @property
+    def usable_pages(self) -> int:
+        """Pages a single request could ever hold (pool minus trash)."""
+        return self.n_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        """Pages allocatable right now: truly free + idle-evictable."""
+        return len(self._free) + len(self._idle)
+
+    @property
+    def allocated_pages(self) -> int:
+        """Pages currently held by at least one live request."""
+        return len(self._ref)
+
+    def prefix_digests(self, prompt) -> list[bytes]:
+        """Chain keys for every FULLY-prompt-covered page, in order."""
+        if not self.prefix_cache:
+            return []
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        ps = self.page_size
+        return [
+            hashlib.sha1(p[: (j + 1) * ps].tobytes()).digest()
+            for j in range(p.size // ps)
+        ]
+
+    def match_len(self, digests: list[bytes]) -> int:
+        """Longest cached prefix-page chain (no side effects)."""
+        m = 0
+        for d in digests:
+            if d not in self._hash_to_page:
+                break
+            m += 1
+        return m
+
+    def can_fit(self, need: int, matched: int) -> bool:
+        return need - matched <= self.free_pages
+
+    def acquire(self, prompt, need: int) -> tuple[list[int], int] | None:
+        """Map ``need`` pages for a request whose prompt may share a
+        cached prefix. Returns ``(page_ids, matched)`` — the first
+        ``matched`` ids are shared prefix pages (refcount bumped, no
+        write), the rest freshly allocated — or None when the pool
+        cannot fit the request (backpressure: caller leaves it queued).
+        Newly-allocated full-prompt pages self-register in the prefix
+        cache so the NEXT request with this prefix reuses them."""
+        digests = self.prefix_digests(prompt)
+        matched = min(self.match_len(digests), need)
+        if not self.can_fit(need, matched):
+            return None
+        self.prefix_lookups += len(digests[:need])
+        self.prefix_hits += matched
+        ids: list[int] = []
+        for d in digests[:matched]:
+            pid = self._hash_to_page[d]
+            if self._ref.get(pid, 0) == 0:
+                self._idle.pop(pid, None)
+            self._ref[pid] = self._ref.get(pid, 0) + 1
+            ids.append(pid)
+        for j in range(matched, need):
+            pid = self._alloc_one()
+            self._ref[pid] = 1
+            ids.append(pid)
+            if j < len(digests) and digests[j] not in self._hash_to_page:
+                # A fresh full-prompt page becomes the cached copy of
+                # its prefix (skip when another page already owns the
+                # digest — e.g. the chain broke on an evicted EARLIER
+                # page while a later one survived).
+                self._hash_to_page[digests[j]] = pid
+                self._page_hash[pid] = digests[j]
+        return ids, matched
+
+    def _alloc_one(self) -> int:
+        if self._free:
+            return self._free.pop()
+        pid, _ = self._idle.popitem(last=False)  # LRU-first eviction
+        d = self._page_hash.pop(pid)
+        del self._hash_to_page[d]
+        self.evictions += 1
+        obs.event("serve.page_evict", page=pid)
+        return pid
+
+    def release(self, page_ids) -> None:
+        """Drop one ownership of each page; refcount-0 cached prefix
+        pages go idle (matchable until evicted), private pages go free."""
+        for pid in dict.fromkeys(int(p) for p in page_ids):
+            self._ref[pid] -= 1
+            if self._ref[pid] == 0:
+                del self._ref[pid]
+                if pid in self._page_hash:
+                    self._idle[pid] = None
+                    self._idle.move_to_end(pid)
+                else:
+                    self._free.append(pid)
 
 
 def default_buckets(n_ctx: int) -> list[int]:
@@ -191,6 +449,7 @@ class ServeRequest:
     eos_id: int | None
     t_submit: float
     quantize: bool = False  # int8 numeric path (engine must be armed)
+    speculative: bool = False  # rides the verify block (engine must be armed)
     bucket: int | None = None
     t_admit: float | None = None
     t_first: float | None = None
@@ -249,6 +508,12 @@ class ServeEngine:
         decode_block: int | None = None,
         pad_id: int = 0,
         quant: str | bool | None = None,
+        paged: bool | None = None,
+        page_size: int | None = None,
+        n_pages: int | None = None,
+        prefix_cache: bool | None = None,
+        speculative: int | bool | None = None,
+        spec_ngram: int = 3,
     ):
         self.model = model
         self.params = params
@@ -313,6 +578,59 @@ class ServeEngine:
         self.pad_id = int(pad_id)
 
         S = self.max_slots
+        # Paged KV (ISSUE 11): the pool geometry + the per-slot page
+        # tables. The decode model is the SAME module cloned with the
+        # pool geometry in its config (params untouched) — geometry is
+        # static by construction, tables are data.
+        self.paged = (
+            _env_flag("TPUFLOW_SERVE_PAGED", True) if paged is None
+            else bool(paged)
+        )
+        self.spec_draft = resolve_spec_draft(speculative)
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_ngram < 2:
+            raise ValueError(f"spec_ngram must be >= 2, got {spec_ngram}")
+        if self.spec_draft and not self.paged:
+            raise ValueError(
+                "per-request speculative decode needs the paged cache "
+                "(the contiguous slot rows' block write clamps at the "
+                "n_ctx edge; paging routes overshoot to the trash page) "
+                "— drop paged=False or TPUFLOW_SERVE_PAGED=0"
+            )
+        self._pmodel = self._qpmodel = None
+        self.pool = None
+        if self.paged:
+            self.page_size = resolve_page_size(self.n_ctx, page_size)
+            self.pages_per_slot = self.n_ctx // self.page_size
+            default_pages = S * self.pages_per_slot + 1
+            self.n_pages = (
+                int(n_pages) if n_pages is not None
+                else _env_int("TPUFLOW_SERVE_PAGES", default_pages,
+                              minimum=2)
+            )
+            if self.n_pages < 2:
+                raise ValueError(
+                    f"n_pages must be >= 2 (page 0 is the trash page), "
+                    f"got {self.n_pages}"
+                )
+            use_prefix = (
+                _env_flag("TPUFLOW_SERVE_PREFIX_CACHE", True)
+                if prefix_cache is None else bool(prefix_cache)
+            )
+            self.pool = PagePool(
+                self.n_pages, self.page_size, prefix_cache=use_prefix
+            )
+            self._page_table = np.zeros(
+                (S, self.pages_per_slot), np.int32
+            )
+            self._slot_pages: list[list[int]] = [[] for _ in range(S)]
+            self._pmodel = model.clone(
+                config=dataclasses.replace(
+                    model.config,
+                    kv_pages=self.n_pages,
+                    kv_page_size=self.page_size,
+                )
+            )
         self._queue: collections.deque[ServeRequest] = collections.deque()
         self._slots: list[ServeRequest | None] = [None] * S
         self._tok = np.zeros((S,), np.int32)
@@ -321,50 +639,92 @@ class ServeEngine:
         self._remaining = np.zeros((S,), np.int32)
         self._live = np.zeros((S,), bool)
         self._quant = np.zeros((S,), bool)  # slot rides the int8 path
+        self._spec = np.zeros((S,), bool)  # slot rides the verify block
         self._eos = np.full((S,), -1, np.int32)
         self._next_id = 0
         self._iters = 0
         self._completed = 0
         self._emitted_tokens = 0
-        self._last_gauges: tuple[int, int] | None = None
+        self._spec_committed = 0
+        self._spec_forwards = 0
+        self._last_gauges: tuple | None = None
         self._cache = self._init_cache()
 
+        decode_model = self._pmodel if self.paged else self.model
         self._prefill = jax.jit(
             functools.partial(self._prefill_fn, self.model),
             static_argnames=("chunk",),
         )
-        self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+        if self.paged:
+            self._insert = jax.jit(
+                self._page_insert_fn, donate_argnums=(0,)
+            )
+        else:
+            self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
         self._decode = jax.jit(
-            functools.partial(self._decode_fn, self.model),
+            functools.partial(self._decode_fn, decode_model),
             donate_argnums=(1,),
         )
-        self._prefill_q = self._decode_q = None
+        self._verify = None
+        if self.spec_draft:
+            self._verify = jax.jit(
+                functools.partial(self._verify_fn, decode_model),
+                donate_argnums=(1,),
+            )
+        self._prefill_q = self._decode_q = self._verify_q = None
         if self.quant_mode is not None:
             # The int8 twins: same program SHAPES (slot arrays, cache
             # pytree, bucket widths), different static model + params
             # pytree — so fp and int8 requests interleave through one
             # engine with zero fresh compiles after warmup.
+            qdecode_model = self._qmodel
+            if self.paged:
+                # The int8 wrapper around the PAGED clone for the decode
+                # programs (the prefill twin keeps the row-cache model).
+                self._qpmodel = dataclasses.replace(
+                    self._qmodel, model=self._pmodel
+                )
+                qdecode_model = self._qpmodel
             self._prefill_q = jax.jit(
                 functools.partial(self._prefill_fn, self._qmodel),
                 static_argnames=("chunk",),
             )
             self._decode_q = jax.jit(
-                functools.partial(self._decode_fn, self._qmodel),
+                functools.partial(self._decode_fn, qdecode_model),
                 donate_argnums=(1,),
             )
+            if self.spec_draft:
+                self._verify_q = jax.jit(
+                    functools.partial(self._verify_fn, qdecode_model),
+                    donate_argnums=(1,),
+                )
 
     # ------------------------------------------------------- jitted programs
     def _init_cache(self):
-        """Zeroed (max_slots, n_ctx) KV cache with the model's exact cache
-        pytree (eval_shape — no compile, no garbage forward)."""
+        """Zeroed KV cache with the decode model's exact cache pytree
+        (eval_shape — no compile, no garbage forward): a (n_pages,
+        page_size) pool when paged, per-slot (max_slots, n_ctx) rows
+        otherwise."""
 
         def mk(params):
-            _, variables = self.model.apply(
-                {"params": params},
-                jnp.zeros((self.max_slots, 1), jnp.int32),
-                decode=True,
-                mutable=["cache"],
-            )
+            if self.paged:
+                _, variables = self._pmodel.apply(
+                    {"params": params},
+                    jnp.zeros((self.max_slots, 1), jnp.int32),
+                    decode=True,
+                    mutable=["cache"],
+                    slot_index=jnp.zeros((self.max_slots,), jnp.int32),
+                    page_table=jnp.zeros(
+                        (self.max_slots, self.pages_per_slot), jnp.int32
+                    ),
+                )
+            else:
+                _, variables = self.model.apply(
+                    {"params": params},
+                    jnp.zeros((self.max_slots, 1), jnp.int32),
+                    decode=True,
+                    mutable=["cache"],
+                )
             return variables["cache"]
 
         shapes = jax.eval_shape(mk, self.params)
@@ -399,15 +759,119 @@ class ServeEngine:
 
         return jax.tree_util.tree_map(put, cache, row_cache)
 
+    def _page_insert_fn(self, cache, row_cache, table_row, pad, write_mask):
+        """Paged admission insert: strip the (1, n_ctx) prefill row's
+        LEFT padding (roll by ``pad`` — the real prompt kv moves to
+        logical columns [0, len), making cache content pad-invariant,
+        the property prefix sharing rests on) and scatter its logical
+        pages into the pool slots ``table_row`` names. ``write_mask``
+        guards each page: shared prefix pages and unneeded tail entries
+        are masked OFF — their writes route to the trash page — so a
+        refcounted page is never rewritten by a matching admission.
+        All three controls are DATA (no recompile per admission)."""
+        ps = self.page_size
+        pages_per_slot = self.pages_per_slot
+        idx = jnp.where(write_mask, table_row, 0)
+
+        def put(pool, row):
+            if pool.ndim < 4 or row.ndim < 4:
+                return pool  # scalar index leaves pass through
+
+            def one(pl, rw):
+                shifted = jnp.roll(rw[0], -pad, axis=0)  # (n_ctx, H, D)
+                pages = shifted.reshape(
+                    pages_per_slot, ps, *shifted.shape[1:]
+                ).astype(pl.dtype)
+                return pl.at[idx].set(
+                    jnp.where(
+                        write_mask[:, None, None, None], pages, pl[idx]
+                    )
+                )
+
+            lead = pool.ndim - 4
+            p2 = pool.reshape((-1,) + pool.shape[lead:])
+            r2 = row.reshape((-1,) + row.shape[row.ndim - 4:])
+            return jax.vmap(one)(p2, r2).reshape(pool.shape)
+
+        return jax.tree_util.tree_map(put, cache, row_cache)
+
+    def _verify_fn(self, model, params, cache, page_table, tok, draft,
+                   lengths, pads, remaining, live, eos):
+        """The speculative verify block (paged engines only): ONE
+        (S, draft_len + 1) forward over [cur, draft...] per slot, then a
+        PER-ROW commit — the accepted draft prefix plus the model's
+        bonus token at the first disagreement, truncated by each row's
+        eos / budget / capacity. Rows advance independently (the paged
+        cache has no shared index to rewind; rejected-tail kv beyond a
+        row's new frontier is masked until its own next forward
+        overwrites it — the solo ladder's rewind argument, per row).
+        Acceptance compares argmaxes of this one forward, width-safe
+        under decode_precision='highest' (and exactly under int8's
+        integer contractions), so committed tokens are bit-equal to
+        single-token greedy decode. Returns
+        (cache, emitted (S, K+1), tok, lengths, remaining, live)."""
+        K = self.spec_draft
+        n_ctx = self.n_ctx
+        pad_id = self.pad_id
+        S = tok.shape[0]
+        x = jnp.concatenate([tok[:, None], draft], axis=1)  # (S, K+1)
+        logits, variables = model.apply(
+            {"params": params, "cache": cache},
+            x,
+            decode=True,
+            mutable=["cache"],
+            pad_lens=pads,
+            slot_index=lengths,
+            page_table=page_table,
+        )
+        cache = variables["cache"]
+        am = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, K+1)
+        # am[:, j] = the model's token after (cur, d_0..d_{j-1});
+        # acceptance = leading agreement with the draft, as in the solo
+        # ladder — but applied PER ROW.
+        match = am[:, :K] == draft
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        j = jnp.arange(K + 1)
+        # Committed window w[0..a] = accepted drafts then the bonus
+        # token; entries past a are junk a masked commit never reads.
+        w = jnp.where(
+            j[None, :] < a[:, None],
+            jnp.pad(draft, ((0, 0), (0, 1))),
+            am[jnp.arange(S)[:, None], jnp.minimum(j[None, :], a[:, None])],
+        )
+        # Per-row commit count: acceptance + bonus, capped by budget and
+        # capacity (live rows hold remaining >= 1 and lengths < n_ctx,
+        # so c >= 1 — every verify makes progress, no livelock).
+        c = jnp.minimum(jnp.minimum(a + 1, remaining), n_ctx - lengths)
+        # eos truncation: commit up to and INCLUDING the first eos in
+        # the window (generate()'s eos-is-emitted contract), then die.
+        is_eos = w == eos[:, None]  # eos == -1 never matches real tokens
+        first_eos = jnp.argmax(is_eos, axis=1)  # 0 when none (guarded)
+        has_eos = jnp.any(is_eos & (j[None, :] < c[:, None]), axis=1)
+        c = jnp.where(has_eos, jnp.minimum(c, first_eos + 1), c)
+        c = jnp.where(live, c, 0)
+        emitted = jnp.where(j[None, :] < c[:, None], w, pad_id)
+        new_tok = w[jnp.arange(S), jnp.maximum(c - 1, 0)]
+        tok = jnp.where(c > 0, new_tok, tok)
+        lengths = lengths + c
+        remaining = remaining - c
+        live = live & ~has_eos & (remaining > 0) & (lengths < n_ctx)
+        # Same carry layout as the decode block: the scheduler merges and
+        # harvests both programs through one code path (tokens-per-row =
+        # the remaining-budget delta, which c already decremented).
+        return cache, emitted, tok, lengths, remaining, live
+
     def _decode_fn(self, model, params, cache, tok, lengths, pads,
-                   remaining, live, eos):
+                   remaining, live, eos, page_table=None):
         """THE persistent decode program: ``decode_block`` single-token
         steps over every slot, per-slot freezing inside the scan. One
         host sync per block. Dead slots keep rewriting one cache column
-        with pad-token k/v — masked out of every live row, overwritten by
-        the next admission's insert. ``model`` is partial-bound per
-        numeric path: the int8 twin runs the same program shape with the
-        fused-native W8A8 matmuls."""
+        with pad-token k/v — masked out of every live row (paged: routed
+        to the trash page by their zeroed tables), overwritten by the
+        next admission's insert. ``model`` is partial-bound per numeric
+        path AND cache layout: the int8 twin runs the same program shape
+        with the fused-native W8A8 matmuls; the paged twin threads
+        ``page_table`` (loop-invariant data) into every step."""
         n_ctx = self.n_ctx
         pad_id = self.pad_id
 
@@ -420,6 +884,7 @@ class ServeEngine:
                 mutable=["cache"],
                 pad_lens=pads,
                 slot_index=lengths,
+                page_table=page_table,
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             emitted = jnp.where(live, nxt, pad_id)
@@ -447,17 +912,36 @@ class ServeEngine:
 
     # ------------------------------------------------------------ scheduling
     def bucket_for(self, prompt_len: int, max_new_tokens: int) -> int:
-        """Smallest bucket width holding the prompt whose padded width
-        still fits the generation budget in the cache. Bucket pads eat
-        cache columns, so the capacity check is on the BUCKET width."""
+        """Smallest bucket width holding the prompt whose capacity check
+        passes. Paged engines check the REAL prompt length against n_ctx
+        (the page insert strips bucket pads, so pads cost prefill FLOPs
+        only, never cache columns); contiguous slot rows keep the PR 8
+        rule — bucket pads eat cache columns, so the check is on the
+        padded width."""
         for w in self.buckets:
-            if prompt_len <= w and w + max_new_tokens <= self.n_ctx:
+            if prompt_len > w:
+                continue
+            fits = (
+                prompt_len + max_new_tokens <= self.n_ctx
+                if self.paged
+                else w + max_new_tokens <= self.n_ctx
+            )
+            if fits:
                 return w
         raise ValueError(
             f"no prefill bucket fits prompt_len={prompt_len} + "
             f"max_new_tokens={max_new_tokens} within n_ctx={self.n_ctx} "
             f"(buckets: {self.buckets})"
         )
+
+    def _pages_needed(self, req: ServeRequest) -> int:
+        """Pages covering every logical column the request's programs
+        can touch: prompt + budget, plus the verify block's draft-length
+        overshoot slack for speculative requests (rejected-tail writes
+        land in-bounds; >= n_ctx routes to trash)."""
+        slack = self.spec_draft if req.speculative else 0
+        top = min(self.n_ctx, req.prompt.size + req.max_new_tokens + slack)
+        return -(-top // self.page_size)
 
     def submit(
         self,
@@ -466,12 +950,17 @@ class ServeEngine:
         max_new_tokens: int,
         eos_id: int | None = None,
         quantize: bool = False,
+        speculative: bool | None = None,
     ) -> ServeRequest:
         """Enqueue one request; returns its live handle. Validation is
         eager (a request that can never fit must fail at submit, not
         half-way through a decode block). ``quantize=True`` routes the
         request through the engine's int8 programs (requires a
-        quant-armed engine: ``quant=`` / ``TPUFLOW_SERVE_QUANT``)."""
+        quant-armed engine: ``quant=`` / ``TPUFLOW_SERVE_QUANT``).
+        ``speculative`` routes it through the verify block on a
+        spec-armed engine (None = the engine default: on when armed);
+        ``speculative=True`` on an unarmed engine raises — the verify
+        programs compile at warmup, never mid-flight."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must have at least one token")
@@ -486,6 +975,16 @@ class ServeEngine:
                 "TPUFLOW_SERVE_QUANT=1 (the int8 programs compile at "
                 "warmup, never mid-flight)"
             )
+        if speculative and not self.spec_draft:
+            raise ValueError(
+                "submit(speculative=True) needs a spec-armed engine: "
+                "pass ServeEngine(speculative=K) or set "
+                "TPUFLOW_SERVE_SPEC=K (the verify programs compile at "
+                "warmup, never mid-flight)"
+            )
+        spec = bool(self.spec_draft) if speculative is None else bool(
+            speculative
+        )
         bucket = self.bucket_for(prompt.size, max_new_tokens)
         req = ServeRequest(
             id=self._next_id,
@@ -494,8 +993,16 @@ class ServeEngine:
             eos_id=None if eos_id is None else int(eos_id),
             t_submit=time.monotonic(),
             quantize=bool(quantize),
+            speculative=spec,
             bucket=bucket,
         )
+        if self.paged and self._pages_needed(req) > self.pool.usable_pages:
+            raise ValueError(
+                f"request needs {self._pages_needed(req)} pages but the "
+                f"pool holds {self.pool.usable_pages} usable pages "
+                f"(n_pages={self.n_pages}, page_size={self.page_size}) — "
+                "it could never admit; raise TPUFLOW_SERVE_PAGES"
+            )
         self._next_id += 1
         self._queue.append(req)
         return req
@@ -510,18 +1017,42 @@ class ServeEngine:
 
     def compile_stats(self) -> dict[str, int]:
         """Jit-cache sizes of the engine's programs (including the int8
-        twins on a quant-armed engine). After ``warmup()`` these must
-        never grow — the never-recompile contract, pinned by
-        tests/test_serve.py."""
+        twins on a quant-armed engine and the speculative verify blocks
+        on a spec-armed one). After ``warmup()`` these must never grow —
+        the never-recompile contract, pinned by tests/test_serve.py."""
         stats = {
             "prefill": int(self._prefill._cache_size()),
             "insert": int(self._insert._cache_size()),
             "decode": int(self._decode._cache_size()),
         }
+        if self.spec_draft:
+            stats["verify"] = int(self._verify._cache_size())
         if self.quant_mode is not None:
             stats["prefill_q"] = int(self._prefill_q._cache_size())
             stats["decode_q"] = int(self._decode_q._cache_size())
+            if self.spec_draft:
+                stats["verify_q"] = int(self._verify_q._cache_size())
         return stats
+
+    def residency_efficiency(self) -> float | None:
+        """HBM residency: tokens resident (live slots' committed cache
+        columns) / tokens allocated (live slots' held pages x page_size;
+        contiguous engines hold a full n_ctx row per live slot). The
+        bench's paged-vs-slot headline — short requests strand most of a
+        contiguous row but only their own pages. None when idle."""
+        live = np.nonzero(self._live)[0]
+        if live.size == 0:
+            return None
+        resident = int((self._lengths[live] - self._pads[live]).sum())
+        if self.paged:
+            allocated = sum(
+                len(self._slot_pages[int(s)]) for s in live
+            ) * self.page_size
+        else:
+            allocated = int(live.size) * self.n_ctx
+        if allocated <= 0:
+            return None
+        return resident / allocated
 
     def _free_slot(self) -> int | None:
         for s, req in enumerate(self._slots):
@@ -529,7 +1060,18 @@ class ServeEngine:
                 return s
         return None
 
-    def _admit_one(self, req: ServeRequest, slot: int) -> None:
+    def _admit_one(self, req: ServeRequest, slot: int) -> bool:
+        """Admit ``req`` into ``slot``. Returns False (request untouched,
+        caller leaves it queued) when the page pool cannot fit it —
+        token-budget admission backpressure. Page acquisition precedes
+        the prefill so a blocked request costs zero device work."""
+        page_ids: list[int] | None = None
+        matched = 0
+        if self.paged:
+            got = self.pool.acquire(req.prompt, self._pages_needed(req))
+            if got is None:
+                return False
+            page_ids, matched = got
         now = time.monotonic()
         req.t_admit = now
         W = req.bucket
@@ -555,6 +1097,8 @@ class ServeEngine:
             "serve.admit", request=req.id, slot=slot, bucket=W,
             prompt_len=int(L),
             queue_wait_s=round(now - req.t_submit, 6),
+            pages=0 if page_ids is None else len(page_ids),
+            shared_pages=matched,
         )
         obs.gauge("serve.ttft_s", round(req.ttft_s, 6))
         led = obs.goodput_live()
@@ -566,21 +1110,41 @@ class ServeEngine:
         led.note_serve_tokens(1)
         obs.counter("serve.tokens", 1)
         if done:
+            if page_ids is not None:
+                self.pool.release(page_ids)
             self._finish(
                 req, "eos" if req.max_new_tokens > 1 else "budget"
             )
-            return
-        self._cache = self._insert(
-            self._cache, row_cache, np.int32(slot)
-        )
+            return True
+        if self.paged:
+            # Pad-stripped page insert: real prompt kv moves to logical
+            # [0, L); shared prefix pages are masked OFF the write.
+            table_row = np.zeros((self.pages_per_slot,), np.int32)
+            table_row[: len(page_ids)] = page_ids
+            write_mask = np.zeros((self.pages_per_slot,), bool)
+            write_mask[matched: len(page_ids)] = True
+            self._cache = self._insert(
+                self._cache, row_cache, jnp.asarray(table_row),
+                jnp.int32(W - L), jnp.asarray(write_mask),
+            )
+            self._page_table[slot] = table_row
+            self._slot_pages[slot] = list(page_ids)
+            self._lengths[slot] = L
+            self._pads[slot] = 0
+        else:
+            self._cache = self._insert(
+                self._cache, row_cache, np.int32(slot)
+            )
+            self._lengths[slot] = W
+            self._pads[slot] = W - L
         self._slots[slot] = req
         self._tok[slot] = first
-        self._lengths[slot] = W
-        self._pads[slot] = W - L
         self._remaining[slot] = req.max_new_tokens - 1
         self._live[slot] = True
         self._quant[slot] = req.quantize
+        self._spec[slot] = req.speculative and self.spec_draft > 0
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
+        return True
 
     def _finish(self, req: ServeRequest, reason: str) -> None:
         req.t_done = time.monotonic()
@@ -601,9 +1165,16 @@ class ServeEngine:
         obs.goodput_live().note_serve_complete()
 
     def _emit_state_gauges(self) -> None:
-        """Queue-depth / occupancy gauges on change (plus a periodic
-        refresh) — a long idle server must not flood the event stream."""
-        state = (len(self._queue), self.live_slots)
+        """Queue-depth / occupancy / page-pool gauges on change (plus a
+        periodic refresh) — a long idle server must not flood the event
+        stream."""
+        pool = self.pool
+        state = (
+            len(self._queue),
+            self.live_slots,
+            None if pool is None else pool.free_pages,
+            None if pool is None else pool.prefix_hits,
+        )
         if state != self._last_gauges or self._iters % 64 == 0:
             self._last_gauges = state
             obs.gauge("serve.queue_depth", state[0])
@@ -611,57 +1182,91 @@ class ServeEngine:
                 "serve.slot_occupancy",
                 round(state[1] / self.max_slots, 4),
             )
-        obs.goodput_live().note_serve_state(
-            state[0], state[1], self.max_slots
-        )
+            if pool is not None:
+                obs.gauge("serve.pages_free", state[2])
+                obs.gauge("serve.prefix_hits", state[3])
+        led = obs.goodput_live()
+        led.note_serve_state(state[0], state[1], self.max_slots)
+        if pool is not None:
+            led.note_serve_pages(pool.free_pages, pool.usable_pages)
+            led.note_serve_prefix(pool.prefix_hits, pool.prefix_lookups)
 
-    def _run_decode_block(self, quant: bool) -> int:
-        """One decode block over ONE numeric group's slots (fp or int8):
-        run that group's persistent program with the OTHER group masked
-        out of the live set, merge the per-slot state back through the
-        group mask, harvest tokens, free exited slots. Returns emitted
-        token count.
+    def _run_decode_block(self, quant: bool, spec: bool = False) -> int:
+        """One decode (or speculative verify) block over ONE group's
+        slots — the groups partition the live set by (numeric path,
+        speculative): run that group's persistent program with every
+        OTHER group masked out of the live set, merge the per-slot state
+        back through the group mask, harvest tokens, free exited slots.
+        Returns emitted token count.
 
         Why masking composes: each slot row only ever attends within its
-        own cache row, and a program only advances (and only writes real
-        k/v for) rows live in ITS set — a masked-out row's single
-        garbage k/v write lands at its frozen ``lengths`` column, which
-        is exactly where that row's OWN program writes real k/v next, so
-        it is always overwritten before anything can attend to it.
-        Mixed fp+int8 traffic therefore shares one cache and one engine
-        with zero cross-talk (pinned by tests/test_serve.py)."""
-        mask = self._live & (self._quant == quant)
+        own cache row (paged: its own pages), and a program only
+        advances (and only writes real k/v for) rows live in ITS set — a
+        masked-out row's garbage k/v writes land at its frozen
+        ``lengths`` column onward, exactly where that row's OWN program
+        writes real k/v next, so they are always overwritten before
+        anything can attend to them (a verify block's K+1 garbage
+        columns sit beyond the frozen frontier — masked out of every
+        query until overwritten, the same argument the solo ladder's
+        rewind rests on). Mixed fp+int8+speculative traffic therefore
+        shares one cache and one engine with zero cross-talk (pinned by
+        tests/test_serve.py)."""
+        mask = self._live & (self._quant == quant) & (self._spec == spec)
         if not mask.any():
             return 0
-        decode = self._decode_q if quant else self._decode
         prm = self._qparams if quant else self.params
         old_remaining = self._remaining.copy()
         # Two literal span calls (not one with a computed name): the
         # obs_lint drift guard only sees literal emitter names.
         span = (
-            obs.span("serve.quant_decode", slots=int(mask.sum()))
+            obs.span("serve.quant_decode", slots=int(mask.sum()), spec=spec)
             if quant
-            else obs.span("serve.decode", slots=int(mask.sum()))
+            else obs.span("serve.decode", slots=int(mask.sum()), spec=spec)
         )
         with span as sp:
-            (
-                self._cache, toks, tok, lengths, remaining, live
-            ) = decode(
-                prm,
-                self._cache,
-                self._tok,
-                self._lengths,
-                self._pads,
-                self._remaining,
-                mask,
-                self._eos,
-            )
+            if spec:
+                # Host-side prompt-lookup drafts per slot (a wrong draft
+                # only costs speed; the verify forward arbitrates).
+                K = self.spec_draft
+                drafts = np.zeros((self.max_slots, K), np.int32)
+                for s in np.nonzero(mask)[0]:
+                    req = self._slots[int(s)]
+                    hist = np.concatenate(
+                        [req.prompt, np.asarray(req.tokens, np.int32)]
+                    )
+                    drafts[s] = ngram_draft(hist, K, ngram=self.spec_ngram)
+                verify = self._verify_q if quant else self._verify
+                (
+                    self._cache, toks, tok, lengths, remaining, live
+                ) = verify(
+                    prm,
+                    self._cache,
+                    jnp.asarray(self._page_table),
+                    self._tok,
+                    jnp.asarray(drafts),
+                    self._lengths,
+                    self._pads,
+                    self._remaining,
+                    mask,
+                    self._eos,
+                )
+            else:
+                decode = self._decode_q if quant else self._decode
+                args = [
+                    prm, self._cache, self._tok, self._lengths,
+                    self._pads, self._remaining, mask, self._eos,
+                ]
+                if self.paged:
+                    args.append(jnp.asarray(self._page_table))
+                (
+                    self._cache, toks, tok, lengths, remaining, live
+                ) = decode(*args)
             # The host copy of the block's tokens IS the fence.
             # np.array (not asarray): the zero-copy view of a jax
             # array is read-only, and admissions write these. Merge
             # through the group mask — the program's carries hold
             # pad_id tokens for every row outside its live set,
-            # including the OTHER group's mid-flight slots.
+            # including the OTHER groups' mid-flight slots.
             toks = np.asarray(toks)
             self._tok = np.where(mask, np.array(tok), self._tok)
             self._lengths = np.where(mask, np.array(lengths), self._lengths)
@@ -671,6 +1276,14 @@ class ServeEngine:
             self._live = np.where(mask, np.array(live), self._live)
             emitted = int((old_remaining - self._remaining).sum())
             sp.set(tokens=emitted)
+            if spec:
+                self._spec_committed += emitted
+                self._spec_forwards += int(mask.sum())
+                rate = self._spec_committed / max(self._spec_forwards, 1)
+                obs.gauge("serve.spec_accept_rate", round(rate, 4))
+                obs.goodput_live().note_serve_spec(
+                    self._spec_committed, self._spec_forwards
+                )
         for s, req in enumerate(self._slots):
             if req is None or not mask[s]:
                 continue
@@ -688,26 +1301,43 @@ class ServeEngine:
                 self._finish(req, reason)
                 self._slots[s] = None
                 self._quant[s] = False
+                self._spec[s] = False
+                if self.paged:
+                    self.pool.release(self._slot_pages[s])
+                    self._slot_pages[s] = []
+                    self._page_table[s, :] = 0
         return emitted
+
+    @property
+    def spec_accept_rate(self) -> float | None:
+        """Cumulative tokens committed per speculative verify, per row
+        (1.0 = speculation bought nothing; draft_len + 1 is the max)."""
+        if not self._spec_forwards:
+            return None
+        return self._spec_committed / self._spec_forwards
 
     def step(self, admit: bool = True) -> bool:
         """One scheduler iteration: admit waiting requests into free
-        slots (chunked prefill), then run one decode block per live
-        numeric group (fp, plus int8 on a quant-armed engine). Returns
-        False when there was nothing to do (idle)."""
+        slots (chunked prefill; paged engines also need the page pool to
+        fit — a blocked head-of-queue request applies backpressure),
+        then run one decode block per live group — (fp, int8) x (plain,
+        speculative). Returns False when there was nothing to do."""
         self._iters += 1
         did = False
         while admit and self._queue:
             slot = self._free_slot()
             if slot is None:
                 break
-            self._admit_one(self._queue.popleft(), slot)
+            if not self._admit_one(self._queue[0], slot):
+                break  # page backpressure: stays queued, never dropped
+            self._queue.popleft()
             did = True
         if self._live.any():
             did = True
-            emitted = self._run_decode_block(False)
-            if self.quant_mode is not None:
-                emitted += self._run_decode_block(True)
+            emitted = 0
+            for quant in (False, True) if self.quant_mode else (False,):
+                for spec in (False, True) if self.spec_draft else (False,):
+                    emitted += self._run_decode_block(quant, spec)
             self._emitted_tokens += emitted
             obs.goodput_live().note_serve_tokens(emitted)
             if emitted:
@@ -734,13 +1364,14 @@ class ServeEngine:
         max_new_tokens: int,
         eos_id: int | None = None,
         quantize: bool = False,
+        speculative: bool | None = None,
     ) -> list[np.ndarray]:
         """Submit every prompt, run to completion, return each request's
         generated tokens in submit order (the batch-predictor adapter)."""
         reqs = [
             self.submit(
                 p, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                quantize=quantize,
+                quantize=quantize, speculative=speculative,
             )
             for p in prompts
         ]
@@ -748,21 +1379,47 @@ class ServeEngine:
         return [r.result() for r in reqs]
 
     # ---------------------------------------------------------------- warmup
+    def _insert_warm_args(self):
+        """The insert call's non-cache operands for a warmup/AOT pass:
+        paged engines write one full table of trash-routed pages (table
+        zeros + mask all-on exercises the real scatter against the
+        reserved page), contiguous engines take slot 0."""
+        if self.paged:
+            return (
+                jnp.zeros((self.pages_per_slot,), jnp.int32),
+                jnp.int32(0),
+                jnp.ones((self.pages_per_slot,), bool),
+            )
+        return (np.int32(0),)
+
+    def _decode_warm_args(self):
+        """Dead-slot operands for one decode/verify warmup execution."""
+        args = [
+            self._tok, self._lengths, self._pads, self._remaining,
+            self._live, self._eos,
+        ]
+        if self.paged:
+            args.append(jnp.asarray(self._page_table))
+        return args
+
     def warmup(self, run_dir: str | None = None) -> dict[str, int]:
         """Compile-or-load every program the engine will ever run: the
-        decode block, the insert, and one prefill per bucket — through
-        the persistent compile cache (``maybe_enable_compile_cache``), so
-        a server restart pays cache loads, not the BENCH_r05 62.9 s
-        compile / 125.1 s wall-to-first-step gap. Executes each program
-        once on dead-slot state (guaranteed jit-cache hits afterwards;
-        the garbage forwards are masked by ``live=False`` everywhere) and
-        restores a pristine cache. Returns ``compile_stats()``."""
+        decode block (and the speculative verify block when armed), the
+        insert, and one prefill per bucket — through the persistent
+        compile cache (``maybe_enable_compile_cache``), so a server
+        restart pays cache loads, not the BENCH_r05 62.9 s compile /
+        125.1 s wall-to-first-step gap. Executes each program once on
+        dead-slot state (guaranteed jit-cache hits afterwards; the
+        garbage forwards are masked by ``live=False`` everywhere — paged
+        writes land in the trash page) and restores a pristine cache.
+        Returns ``compile_stats()``."""
         from tpuflow.dist import maybe_enable_compile_cache
 
         maybe_enable_compile_cache(run_dir)
         with obs.span(
             "serve.warmup", buckets=len(self.buckets),
-            quant=self.quant_mode or "off",
+            quant=self.quant_mode or "off", paged=self.paged,
+            spec=self.spec_draft,
         ) as sp:
             row_cache = None
             for w in self.buckets:
@@ -785,21 +1442,41 @@ class ServeEngine:
             if row_cache is not None:
                 # First insert: the fresh (uncommitted) init cache.
                 self._cache = self._insert(
-                    self._cache, row_cache, np.int32(0)
+                    self._cache, row_cache, *self._insert_warm_args()
                 )
             out = self._decode(
-                self.params, self._cache, self._tok, self._lengths,
-                self._pads, self._remaining, self._live, self._eos,
+                self.params, self._cache, *self._decode_warm_args()
             )
             self._cache = out[0]
+            if self.spec_draft:
+                # The verify block (and below, its int8 twin): dead-slot
+                # drafts of zeros exercise the exact (S, K+1) signature
+                # the speculative scheduler replays.
+                zdraft = jnp.zeros(
+                    (self.max_slots, self.spec_draft), jnp.int32
+                )
+                out = self._verify(
+                    self.params, self._cache,
+                    jnp.asarray(self._page_table), self._tok, zdraft,
+                    self._lengths, self._pads, self._remaining,
+                    self._live, self._eos,
+                )
+                self._cache = out[0]
             if self.quant_mode is not None:
                 # The int8 decode block on the decode-committed cache —
                 # the exact signature the mixed-traffic scheduler replays.
                 out = self._decode_q(
-                    self._qparams, self._cache, self._tok, self._lengths,
-                    self._pads, self._remaining, self._live, self._eos,
+                    self._qparams, self._cache, *self._decode_warm_args()
                 )
                 self._cache = out[0]
+                if self.spec_draft:
+                    out = self._verify_q(
+                        self._qparams, self._cache,
+                        jnp.asarray(self._page_table), self._tok, zdraft,
+                        self._lengths, self._pads, self._remaining,
+                        self._live, self._eos,
+                    )
+                    self._cache = out[0]
             if row_cache is not None:
                 # Second insert: the steady-state signature — a cache
                 # COMMITTED by the decode program (with sharded params
@@ -807,7 +1484,7 @@ class ServeEngine:
                 # must be warm or the first post-decode admission would
                 # recompile, breaking the never-recompile contract).
                 self._cache = self._insert(
-                    self._cache, row_cache, np.int32(0)
+                    self._cache, row_cache, *self._insert_warm_args()
                 )
             # Warmup wrote garbage k/v into slot 0's columns; every query
             # of a future occupant is masked to its own [pad, length]
@@ -823,6 +1500,68 @@ class ServeEngine:
             stats = self.compile_stats()
             sp.set(**stats)
         return stats
+
+    def aot_lower(self, max_new_tokens: int = 128) -> int:
+        """AOT-lower (``jit(...).lower(...).compile()``) every program
+        signature this engine replays — decode block, speculative verify,
+        page/slot insert, and each admittable bucket's prefill, plus the
+        int8 twins on a quant-armed engine — WITHOUT executing anything
+        (row caches come from ``eval_shape``). With the persistent
+        compile cache enabled the executables land on disk, which is
+        ``tools/prewarm_cache.py``'s whole job; the engine owns the
+        signature list so the tool can't drift from the programs the
+        scheduler actually runs. ``max_new_tokens`` prunes buckets the
+        run could never admit into. Returns the program count."""
+        pairs = [(self._prefill, self._decode, self._verify, self.params)]
+        if self.quant_mode is not None:
+            pairs.append(
+                (self._prefill_q, self._decode_q, self._verify_q,
+                 self._qparams)
+            )
+        programs = 0
+        row_shape = None
+        for prefill, decode, verify, prm in pairs:
+            decode.lower(
+                prm, self._cache, *self._decode_warm_args()
+            ).compile()
+            programs += 1
+            if verify is not None:
+                verify.lower(
+                    prm, self._cache, jnp.asarray(self._page_table),
+                    self._tok,
+                    jnp.zeros((self.max_slots, self.spec_draft), jnp.int32),
+                    self._lengths, self._pads, self._remaining,
+                    self._live, self._eos,
+                ).compile()
+                programs += 1
+            for w in self.buckets:
+                # Contiguous rows admit on the PADDED width, so buckets
+                # the budget can never fit are dead signatures; paged
+                # capacity is the real length — every bucket can host a
+                # short-enough prompt.
+                if not self.paged and w + max_new_tokens > self.n_ctx:
+                    continue
+                chunk = normalize_prefill_chunk(self.prefill_chunk, w)
+                pf_args = (
+                    prm,
+                    jnp.zeros((1, w), jnp.int32),
+                    prompt_lens_to_pad_lens([w], 1, w),
+                )
+                prefill.lower(*pf_args, chunk=chunk).compile()
+                programs += 1
+                row_shape = jax.eval_shape(
+                    functools.partial(prefill, chunk=chunk), *pf_args
+                )[1]
+        if row_shape is not None:
+            # The insert signature (abstract row cache from eval_shape —
+            # no prefill ever executes). The decode-committed second
+            # signature only diverges under sharded params; the engine's
+            # own warmup() covers it at server start.
+            self._insert.lower(
+                self._cache, row_shape, *self._insert_warm_args()
+            ).compile()
+            programs += 1
+        return programs
 
 
 def serve_forever(

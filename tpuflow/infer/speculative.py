@@ -22,6 +22,15 @@ acceptance across live rows (the cache index is shared), so speedup is
 the batch's worst-case agreement — batch 1 gets the full win. Greedy
 only (sampling would need stochastic acceptance-rejection); dense
 prompts only.
+
+The serving engine (tpuflow.infer.serve, ISSUE 11) lifts the
+batch-minimum restriction: its paged KV cache gives every slot an
+independent frontier, so the batched decode block verifies each slot's
+host-drafted tokens (``ngram_draft`` below — the numpy twin of the
+in-program ladder) and commits PER ROW. The acceptance comparison is
+width-safe by the same two pins this module documents
+(``decode_precision='highest'`` + integer-exact int8), so speculation
+composes with continuous batching instead of being solo-only.
 """
 
 from __future__ import annotations
@@ -52,6 +61,40 @@ def _reset_index(cache, value):
         else:
             out.append(leaf)
     return jax.tree_util.tree_unflatten(flat[1], out)
+
+
+def ngram_draft(history, K: int, *, ngram: int = 3):
+    """Host-side (numpy) twin of ``_draft_ladder`` for ONE sequence: the
+    K tokens that followed the most recent earlier occurrence of the
+    trailing (ngram-1)-gram, laddering down to shorter grams, falling
+    back to repeat-last-token. The serving engine drafts per slot with
+    this between decode blocks (tpuflow.infer.serve: each request's
+    history lives on the host anyway, and a wrong draft only costs
+    speed — the in-program verify forward arbitrates), so the drafting
+    policy stays one implementation away from the solo ladder above.
+    Returns a (K,) int32 draft; ``history`` must be non-empty."""
+    import numpy as np
+
+    h = np.asarray(history, np.int32).reshape(-1)
+    n = h.size
+    if n == 0:
+        raise ValueError("ngram_draft needs a non-empty history")
+    G = max(int(ngram) - 1, 1)
+    for g in range(min(G, n - 1), 0, -1):
+        key = h[n - g:]
+        # Windows over h[:n-1]: starts 0..n-g-1, so the trailing gram
+        # itself (start n-g) is never its own match.
+        win = np.lib.stride_tricks.sliding_window_view(h[: n - 1], g)
+        hits = np.nonzero((win == key).all(axis=1))[0]
+        if hits.size:
+            s = int(hits[-1])
+            cand = h[s + g : s + g + K]
+            if cand.size < K:
+                cand = np.concatenate(
+                    [cand, np.full(K - cand.size, h[-1], np.int32)]
+                )
+            return cand.astype(np.int32)
+    return np.full(K, h[-1], np.int32)
 
 
 def _draft_ladder(hist, n_hist, *, K: int, G: int):

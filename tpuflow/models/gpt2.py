@@ -87,6 +87,20 @@ class GPT2Config:
     # every decode strategy, so it cannot introduce width-dependent
     # rounding.
     decode_precision: str | None = "highest"
+    # Paged KV cache (the serving engine's block-granular layout,
+    # ISSUE 11). kv_pages > 0 switches slot-mode decode calls that pass
+    # a ``page_table`` to a POOLED cache: instead of one contiguous
+    # (B, n_ctx, H, D) row per slot, the cache is a fixed
+    # (kv_pages, kv_page_size, H, D) pool and each slot's logical row is
+    # scattered across the pages its (B, n_ctx/kv_page_size) table
+    # names. kv_page_size must divide n_ctx. Page 0 is the engine's
+    # TRASH page: out-of-range writes and dead slots (zeroed tables)
+    # land there and nothing ever reads it, so a freed page can be
+    # re-allocated to a new request without the old slot's frozen
+    # garbage write chasing it. Training/scoring/solo-generate forwards
+    # never consult these fields.
+    kv_pages: int = 0
+    kv_page_size: int = 0
 
     def compute_dtype(self, decode: bool):
         """Activation/compute dtype for this forward: ``decode_dtype``
@@ -231,7 +245,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False, pad_lens=None,
-                 prefill: bool = False, slot_index=None):
+                 prefill: bool = False, slot_index=None, page_table=None):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -255,7 +269,9 @@ class Block(nn.Module):
         k = k.reshape(B, T, cfg.n_head, head_dim)
         v = v.reshape(B, T, cfg.n_head, head_dim)
         if decode:
-            a = self._cached_attention(q, k, v, pad_lens, prec, slot_index)
+            a = self._cached_attention(
+                q, k, v, pad_lens, prec, slot_index, page_table
+            )
         elif pad_lens is not None:
             # Ragged (LEFT-padded) batch without a cache — the scoring path:
             # pad columns are masked out of every key set and real positions
@@ -293,8 +309,82 @@ class Block(nn.Module):
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
 
+    def _paged_attention(self, q, k, v, pad_lens, precision, slot_index,
+                         page_table):
+        """Paged (block-pooled) KV-cache attention — the serving engine's
+        slot mode over a page pool (ISSUE 11).
+
+        The cache is ONE (kv_pages, kv_page_size, H, D) pool shared by
+        every slot; ``page_table`` (B, n_ctx/page_size) int32 maps each
+        row's logical cache columns onto pool pages, and is threaded
+        through the decode program as DATA — admissions, evictions and
+        prefix-page sharing never change a shape, so the engine's
+        never-recompile contract extends to page management.
+
+        Writes: row b's T new k/v land at logical columns
+        ``slot_index[b] + t``, each routed to
+        ``table[b, col // ps] * ps + col % ps`` of the flattened pool.
+        Out-of-range columns (>= n_ctx: a dying row's overshoot) and
+        dead slots (tables zeroed by the engine) route to page 0 — the
+        reserved TRASH page nothing ever reads — so a page freed and
+        re-allocated to a new request can never be corrupted by its old
+        slot's frozen garbage write (the paged analogue of the slot
+        engine's overwritten-at-own-column argument).
+
+        Reads: each row gathers its logical (n_ctx, H, D) view through
+        its table and runs the SAME masked attention as the contiguous
+        slot path — columns ``[pad_lens[b], slot_index[b] + t]`` only.
+        Masked columns may be backed by the trash page or a stale page:
+        their scores are the -1e30 constant either way, so the gathered
+        garbage never reaches a real query (and the gathered bytes equal
+        the contiguous row read — paging moves capacity accounting, not
+        the attention's HBM traffic).
+        """
+        cfg = self.config
+        B, T, H, D = q.shape
+        ps = cfg.kv_page_size
+        n_pages = cfg.kv_pages
+        pages_per_row = cfg.n_ctx // ps
+        cdt = cfg.kv_cache_dtype()
+        ck = self.variable(
+            "cache", "cached_key", jnp.zeros, (n_pages, ps, H, D), cdt
+        )
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros, (n_pages, ps, H, D), cdt
+        )
+        # Created (never read/advanced) so the paged cache pytree keeps
+        # the structure of a row cache — the engine's page-insert
+        # tree_maps the two together.
+        self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        pos = slot_index[:, None] + jnp.arange(T)[None, :]  # (B, T) logical
+        page = jnp.take_along_axis(
+            page_table, jnp.clip(pos // ps, 0, pages_per_row - 1), axis=1
+        )
+        flat = jnp.where(pos < cfg.n_ctx, page * ps + pos % ps, 0)
+
+        def scatter(pool, new):
+            body = pool.reshape(n_pages * ps, H, D)
+            body = body.at[flat.reshape(-1)].set(
+                new.astype(cdt).reshape(B * T, H, D)
+            )
+            return body.reshape(n_pages, ps, H, D)
+
+        ck.value = scatter(ck.value, k)
+        cv.value = scatter(cv.value, v)
+        k_all = ck.value[page_table].reshape(B, cfg.n_ctx, H, D)
+        v_all = cv.value[page_table].reshape(B, cfg.n_ctx, H, D)
+        k_pos = jnp.arange(cfg.n_ctx)
+        valid = k_pos[None, None, None, :] <= pos[:, None, :, None]
+        if pad_lens is not None:
+            valid = valid & (
+                k_pos[None, None, None, :] >= pad_lens[:, None, None, None]
+            )
+        return _masked_attention(q, k_all, v_all, valid, precision=precision)
+
     def _cached_attention(self, q, k, v, pad_lens=None, precision=None,
-                          slot_index=None):
+                          slot_index=None, page_table=None):
         """Fixed-size KV-cache attention (decode mode).
 
         Writes the new k/v at ``cache_index`` and attends q over the whole
@@ -328,6 +418,16 @@ class Block(nn.Module):
 
         cfg = self.config
         B, T, H, D = q.shape
+        if slot_index is not None and page_table is not None:
+            if cfg.kv_pages <= 0:
+                raise ValueError(
+                    "page_table passed but the config declares no page "
+                    "pool — set kv_pages/kv_page_size (the serving "
+                    "engine clones its decode model with them)"
+                )
+            return self._paged_attention(
+                q, k, v, pad_lens, precision, slot_index, page_table
+            )
         cdt = cfg.kv_cache_dtype()
         ck = self.variable(
             "cache",
@@ -423,10 +523,10 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False, pad_lens=None,
-                 prefill: bool = False, slot_index=None):
+                 prefill: bool = False, slot_index=None, page_table=None):
         return (
             Block(self.config, name="block")(
-                x, train, decode, pad_lens, prefill, slot_index
+                x, train, decode, pad_lens, prefill, slot_index, page_table
             ),
             None,
         )
@@ -441,6 +541,7 @@ class GPT2(nn.Module):
     def __call__(
         self, tokens, *, train: bool = False, decode: bool = False,
         pad_lens=None, prefill: bool = False, slot_index=None,
+        page_table=None,
     ):
         """``pad_lens`` (B,) int32 marks LEFT-padded rows: row b's first
         ``pad_lens[b]`` columns are padding — their positions clamp to 0,
@@ -454,13 +555,20 @@ class GPT2(nn.Module):
         to PER-ROW cache positions (the serving engine's slot-based KV
         cache): row b writes/reads at its own column, positions come
         from ``slot_index - pad_lens``, and the model-level ``pos_index``
-        is neither consulted nor advanced."""
+        is neither consulted nor advanced. ``page_table``
+        (B, n_ctx/kv_page_size) int32 further switches slot mode to the
+        PAGED cache pool (``kv_pages``/``kv_page_size`` config fields):
+        logical columns route through the table onto shared pool pages
+        (Block._paged_attention) — positions and masking are identical
+        to contiguous slot mode."""
         cfg = self.config
         B, T = tokens.shape
         if pad_lens is not None:
             pad_lens = jnp.asarray(pad_lens, jnp.int32)
         if slot_index is not None:
             slot_index = jnp.asarray(slot_index, jnp.int32)
+        if page_table is not None:
+            page_table = jnp.asarray(page_table, jnp.int32)
         wte = self.param(
             "wte",
             nn.initializers.normal(0.02),
@@ -550,12 +658,12 @@ class GPT2(nn.Module):
                         "names are the jax.checkpoint_policies attributes"
                     ) from None
             # Args (with the module at 0): x=1, train=2, decode=3,
-            # pad_lens=4, prefill=5, slot_index=6. train/decode/prefill
-            # are Python bools that steer tracing — static. pad_lens and
-            # slot_index are DATA arrays (tracers during ragged/slot
-            # decode): marking pad_lens static, as (2, 3, 4) once did,
-            # crashed every remat=True decode-mode call with
-            # TracerBoolConversionError.
+            # pad_lens=4, prefill=5, slot_index=6, page_table=7.
+            # train/decode/prefill are Python bools that steer tracing —
+            # static. pad_lens, slot_index, and page_table are DATA
+            # arrays (tracers during ragged/slot/paged decode): marking
+            # pad_lens static, as (2, 3, 4) once did, crashed every
+            # remat=True decode-mode call with TracerBoolConversionError.
             return nn.remat(mod, static_argnums=(2, 3, 5), policy=policy)
 
         if cfg.scan_layers:
@@ -570,13 +678,14 @@ class GPT2(nn.Module):
                 in_axes=nn.broadcast,
             )
             x, _ = blocks(cfg, name="h")(
-                x, train, decode, pad_lens, prefill, slot_index
+                x, train, decode, pad_lens, prefill, slot_index, page_table
             )
         else:
             block_cls = remat_wrap(Block) if cfg.remat else Block
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h{i}")(
-                    x, train, decode, pad_lens, prefill, slot_index
+                    x, train, decode, pad_lens, prefill, slot_index,
+                    page_table,
                 )
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=dt, name="ln_f")(x)
         if self.has_variable("quant", "wte_q"):

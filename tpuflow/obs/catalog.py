@@ -220,6 +220,32 @@ CATALOG: dict[str, tuple[str, str]] = {
     ),
     "serve.tokens": ("counter", "generated tokens served by the engine"),
     "serve.requests": ("counter", "requests completed by the engine"),
+    # Paged KV serving (ISSUE 11): page-pool headroom, shared-prefix
+    # reuse, speculative acceptance, and the eviction evidence trail —
+    # the gauges the Serving runbook's paged section reads, mirrored as
+    # tpuflow_serve_* names on /metrics.
+    "serve.pages_free": (
+        "gauge",
+        "KV pages allocatable right now (truly free + idle prefix-cache "
+        "pages reclaimable by eviction); admission blocks — queues, "
+        "never drops — when a request's page need exceeds this",
+    ),
+    "serve.prefix_hits": (
+        "gauge",
+        "cumulative prompt pages served from the shared-prefix cache "
+        "instead of being allocated + recomputed into a private copy "
+        "(refcounted page reuse across requests)",
+    ),
+    "serve.spec_accept_rate": (
+        "gauge",
+        "cumulative speculative tokens committed per per-row verify "
+        "(1.0 = speculation buys nothing; draft_len + 1 is the ceiling)",
+    ),
+    "serve.page_evict": (
+        "event",
+        "pool pressure reclaimed an idle (refcount-0) prefix-cache page "
+        "LRU-first; its cached prefix must be recomputed on next use",
+    ),
     # Per-request int8 serving (ISSUE 9): the quantized twin of the
     # persistent decode program, plus the completion trail that lets an
     # operator split throughput by numeric path.

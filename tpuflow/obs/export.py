@@ -58,6 +58,12 @@ _PROM_SPEC = (
     ("tpuflow_serve_tokens_per_s", "serve_tokens_per_s", "gauge"),
     ("tpuflow_serve_ttft_p50_seconds", "serve_ttft_p50_s", "gauge"),
     ("tpuflow_serve_ttft_p99_seconds", "serve_ttft_p99_s", "gauge"),
+    # Paged KV (ISSUE 11): pool headroom, shared-prefix reuse, and
+    # per-request speculative acceptance; keys only present on paged /
+    # spec-armed engines.
+    ("tpuflow_serve_pages_free", "serve_pages_free", "gauge"),
+    ("tpuflow_serve_prefix_hit_rate", "serve_prefix_hit_rate", "gauge"),
+    ("tpuflow_serve_spec_accept_rate", "serve_spec_accept_rate", "gauge"),
 )
 
 

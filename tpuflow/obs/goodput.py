@@ -311,6 +311,15 @@ class ProcessLedger:
         self.serve_queue_depth = 0
         self.serve_live_slots = 0
         self.serve_max_slots = 0
+        # Paged-KV view (ISSUE 11): page-pool headroom, prefix-cache
+        # reuse, and speculative acceptance — zero serve_pages_total =
+        # a contiguous (non-paged) engine, keys omitted.
+        self.serve_pages_free = 0
+        self.serve_pages_total = 0
+        self.serve_prefix_hits = 0
+        self.serve_prefix_lookups = 0
+        self.serve_spec_committed = 0
+        self.serve_spec_forwards = 0
         self._serve_ttfts: collections.deque = collections.deque(maxlen=512)
         self._serve_recent: collections.deque = collections.deque(maxlen=128)
         # (monotonic, cumulative steps+reports, cumulative tokens) marks
@@ -386,6 +395,21 @@ class ProcessLedger:
     def note_serve_complete(self) -> None:
         self.serve_requests += 1
 
+    def note_serve_pages(self, free: int, total: int) -> None:
+        """Paged-KV pool headroom (free includes idle-evictable pages)."""
+        self.serve_pages_free = int(free)
+        self.serve_pages_total = max(int(total), self.serve_pages_total)
+
+    def note_serve_prefix(self, hits: int, lookups: int) -> None:
+        """Cumulative shared-prefix page cache hits / lookups."""
+        self.serve_prefix_hits = int(hits)
+        self.serve_prefix_lookups = int(lookups)
+
+    def note_serve_spec(self, committed: int, forwards: int) -> None:
+        """Cumulative speculative tokens committed / per-row verifies."""
+        self.serve_spec_committed = int(committed)
+        self.serve_spec_forwards = int(forwards)
+
     def snapshot(self) -> dict[str, Any]:
         """Point-in-time view for the export endpoint. Rolling rates come
         from the recent-fence window; MFU only when both the model FLOP
@@ -443,6 +467,17 @@ class ProcessLedger:
                 )
                 out["serve_ttft_p99_s"] = round(
                     ts[min(len(ts) - 1, int(len(ts) * 0.99))], 6
+                )
+            if self.serve_pages_total:
+                out["serve_pages_free"] = self.serve_pages_free
+                if self.serve_prefix_lookups:
+                    out["serve_prefix_hit_rate"] = round(
+                        self.serve_prefix_hits / self.serve_prefix_lookups,
+                        4,
+                    )
+            if self.serve_spec_forwards:
+                out["serve_spec_accept_rate"] = round(
+                    self.serve_spec_committed / self.serve_spec_forwards, 4
                 )
         if step_rate is not None:
             out["step_rate"] = round(step_rate, 4)
