@@ -46,6 +46,7 @@ import os
 import shutil
 import sys
 import time
+from tpuflow.utils import knobs
 
 
 def _log(msg: str) -> None:
@@ -509,7 +510,7 @@ def bench_train() -> dict | None:
 
     tiny = dict(vocab_size=2048, n_ctx=128, n_embd=128, n_layer=2, n_head=4,
                 dropout=0.0)
-    if on_tpu and os.environ.get("TPUFLOW_TRAIN_SMOKE") != "0":
+    if on_tpu and knobs.raw("TPUFLOW_TRAIN_SMOKE") != "0":
         # First-contact insurance for brief tunnel windows (r4: a 20-min
         # healthy window closed mid-compile of the 124M leg and left
         # NOTHING). A 2-layer model compiles in a fraction of the time;
@@ -564,7 +565,7 @@ def bench_train() -> dict | None:
         rec["decode"] = {"error": repr(e)[:300]}
     if on_tpu:
         _evidence_merge({"train": rec})
-    if os.environ.get("TPUFLOW_BENCH_SERVE") != "0":
+    if knobs.raw("TPUFLOW_BENCH_SERVE") != "0":
         try:
             rec["serving"] = bench_serving(model, state.params, cfg, on_tpu)
         except Exception as e:  # serving issues must not erase the train rec
@@ -905,7 +906,7 @@ def bench_decode(model, params, cfg, on_tpu: bool) -> dict:
         # quant_decision's weight-mode gate verdict rides the record
         # either way. (Pre-ISSUE-9 this was gated OFF by default: the
         # only int8 path then was weight-only at a measured 0.76x.)
-        if os.environ.get("TPUFLOW_BENCH_INT8") != "0":
+        if knobs.raw("TPUFLOW_BENCH_INT8") != "0":
             try:
                 rec["int8"] = _bench_int8_decode(model, params, prompt, n_new)
             except Exception as e:  # never erase the decode record
@@ -1309,7 +1310,7 @@ def bench_flash() -> dict:
         def with_bwd_mode(mode, fn, *args):
             # TPUFLOW_FLASH_BWD resolves at trace time inside the timed
             # closure's jit — pin it around the whole measurement.
-            prev = os.environ.get("TPUFLOW_FLASH_BWD")
+            prev = knobs.raw("TPUFLOW_FLASH_BWD")
             os.environ["TPUFLOW_FLASH_BWD"] = mode
             try:
                 return fn(*args)
@@ -1541,13 +1542,13 @@ def run_train_bench() -> dict | None:
     TTL-cached, so repeated bench invocations against a dead tunnel don't
     re-pay the probe stall).
     """
-    if os.environ.get("TPUFLOW_BENCH_TRAIN") == "0":
+    if knobs.raw("TPUFLOW_BENCH_TRAIN") == "0":
         return None
     import subprocess
 
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
-    healthy = os.environ.get("TPUFLOW_PLATFORM_PROBED") == "default"
-    backend = os.environ.get("TPUFLOW_PLATFORM_BACKEND", "")
+    healthy = knobs.raw("TPUFLOW_PLATFORM_PROBED") == "default"
+    backend = knobs.raw("TPUFLOW_PLATFORM_BACKEND", "")
     modes = ["tpu", "cpu"] if healthy and backend == "tpu" else ["cpu"]
     # Staged fallback: a tunneled TPU can pass backend init yet hang at the
     # first real compute (observed on the dev proxy) — bound the TPU attempt
@@ -1560,7 +1561,7 @@ def run_train_bench() -> dict | None:
                 [sys.executable, os.path.abspath(__file__), "--train-child"],
                 env=env,
                 timeout=float(
-                    os.environ.get("TPUFLOW_BENCH_TRAIN_TIMEOUT", "480")
+                    knobs.raw("TPUFLOW_BENCH_TRAIN_TIMEOUT", "480")
                 )
                 if mode == "tpu"
                 else 420,
@@ -1803,7 +1804,7 @@ def bench_overlap() -> dict | None:
     for memory bandwidth; on this 1-core dev box both contend for the core,
     making this a conservative lower bound.
     """
-    if os.environ.get("TPUFLOW_BENCH_OVERLAP") == "0":
+    if knobs.raw("TPUFLOW_BENCH_OVERLAP") == "0":
         return None
     import jax
     import jax.numpy as jnp
@@ -1811,7 +1812,7 @@ def bench_overlap() -> dict | None:
 
     from tpuflow.ckpt import CheckpointManager
 
-    gib = float(os.environ.get("TPUFLOW_BENCH_OVERLAP_GB", "3.4"))
+    gib = float(knobs.raw("TPUFLOW_BENCH_OVERLAP_GB", "3.4"))
     base = (
         "/dev/shm/tpuflow_overlap"
         if os.path.isdir("/dev/shm")
@@ -1959,9 +1960,9 @@ def measure_device_staging(state, nbytes: int) -> dict:
 
 
 def main() -> None:
-    use_device = os.environ.get("TPUFLOW_BENCH_DEVICE") == "1"
-    n_shards = int(os.environ.get("TPUFLOW_BENCH_DEVICES", "8"))
-    payload_gib = float(os.environ.get("TPUFLOW_BENCH_GB", "1.0"))
+    use_device = knobs.raw("TPUFLOW_BENCH_DEVICE") == "1"
+    n_shards = int(knobs.raw("TPUFLOW_BENCH_DEVICES", "8"))
+    payload_gib = float(knobs.raw("TPUFLOW_BENCH_GB", "1.0"))
 
     from tpuflow.dist import (
         ensure_healthy_platform,
@@ -1985,7 +1986,7 @@ def main() -> None:
     mesh = dist.make_mesh({"data": ndev})
     _log(f"[bench] devices: {jax.devices()[:2]}... ({ndev}), mesh {dict(mesh.shape)}")
 
-    bench_dir = os.environ.get("TPUFLOW_BENCH_DIR")
+    bench_dir = knobs.raw("TPUFLOW_BENCH_DIR")
     if bench_dir is None:
         bench_dir = (
             "/dev/shm/tpuflow_bench"
@@ -2023,9 +2024,9 @@ def main() -> None:
     # documents device saturation, not the 2 GB/s target — the tmpfs tier
     # models a TPU-VM's local NVMe class of storage.
     disk = None
-    if os.environ.get("TPUFLOW_BENCH_DISK") != "0":
+    if knobs.raw("TPUFLOW_BENCH_DISK") != "0":
         try:
-            disk_dir = os.environ.get(
+            disk_dir = knobs.raw(
                 "TPUFLOW_BENCH_DISK_DIR",
                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_disk"),
@@ -2352,7 +2353,7 @@ def _compact_summary(record: dict, train) -> dict:
 
 if __name__ == "__main__":
     if "--mfu-sweep" in sys.argv:
-        if os.environ.get("TPUFLOW_TRAIN_MODE") != "tpu":
+        if knobs.raw("TPUFLOW_TRAIN_MODE") != "tpu":
             # Same guard as --train-child: without an explicit TPU ask,
             # never let a dead tunnel hang backend init.
             from tpuflow.dist import force_cpu_platform
@@ -2363,7 +2364,7 @@ if __name__ == "__main__":
         maybe_enable_compile_cache()
         print(json.dumps(bench_mfu_sweep()))
     elif "--train-child" in sys.argv:
-        if os.environ.get("TPUFLOW_TRAIN_MODE") != "tpu":
+        if knobs.raw("TPUFLOW_TRAIN_MODE") != "tpu":
             from tpuflow.dist import force_cpu_platform
 
             force_cpu_platform(8)

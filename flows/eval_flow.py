@@ -18,6 +18,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from tpuflow.utils import knobs  # noqa: E402
+
 from tpuflow.flow import (  # noqa: E402
     FlowSpec,
     Image,
@@ -90,7 +92,7 @@ class TpuEval(FlowSpec):
             "or pass --checkpoint-run-pathspec / --checkpoint-task-pathspec"
         )
 
-    @kubernetes(topology=os.environ.get("TPUFLOW_TOPOLOGY", "v5e-8"))
+    @kubernetes(topology=knobs.raw("TPUFLOW_TOPOLOGY", "v5e-8"))
     @device_profile(interval=1)  # ↔ eval_flow.py:57
     @card(type="blank")  # ↔ eval_flow.py:56
     @step

@@ -27,6 +27,8 @@ import optax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpuflow.utils import knobs  # noqa: E402
+
 from tpuflow import dist  # noqa: E402
 from tpuflow.ckpt import Checkpoint, restore_from_handle  # noqa: E402
 from tpuflow.data import (  # noqa: E402
@@ -329,7 +331,7 @@ def train_model(
     # so the DP world and the loss math are unchanged vs the flat mesh.
     # An EXPLICIT num_workers argument always wins over the env knob —
     # a lingering env var must not silently discard a caller's ask.
-    dcn_data = int(os.environ.get("TPUFLOW_DCN_DATA", "0") or 0)
+    dcn_data = int(knobs.raw("TPUFLOW_DCN_DATA", "0") or 0)
     if dcn_data > 1 and (num_workers is None or num_workers <= 0):
         _log(f"hybrid mesh: TPUFLOW_DCN_DATA={dcn_data} (data over "
              "DCN x fsdp over ICI)")
@@ -403,7 +405,7 @@ if __name__ == "__main__":
     # any flow, all local devices.
     res = train_fashion_mnist(
         num_workers=None,
-        checkpoint_storage_path=os.environ.get("TPUFLOW_STORAGE", "/tmp/tpuflow_run"),
+        checkpoint_storage_path=knobs.raw("TPUFLOW_STORAGE", "/tmp/tpuflow_run"),
         epochs=int(os.environ.get("EPOCHS", "3")),
     )
     print(res.to_json())

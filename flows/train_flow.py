@@ -19,6 +19,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from tpuflow.utils import knobs  # noqa: E402
+
 from tpuflow.flow import (  # noqa: E402
     FlowSpec,
     Parameter,
@@ -33,7 +35,7 @@ from tpuflow.flow import (  # noqa: E402
     tpu,
 )
 
-N_PARALLEL = int(os.environ.get("TPUFLOW_N_PARALLEL", "2"))  # ↔ train_flow.py:17
+N_PARALLEL = int(knobs.raw("TPUFLOW_N_PARALLEL", "2"))  # ↔ train_flow.py:17
 
 
 @schedule(cron="*/5 * * * *")  # ↔ train_flow.py:20
@@ -73,7 +75,7 @@ class TpuTrain(FlowSpec):
 
     @retry(times=3)  # ↔ train_flow.py:41
     @tpu(all_hosts_started_timeout=60 * 5)  # ↔ train_flow.py:42 @metaflow_ray
-    @kubernetes(topology=os.environ.get("TPUFLOW_TOPOLOGY", "v5e-8"))
+    @kubernetes(topology=knobs.raw("TPUFLOW_TOPOLOGY", "v5e-8"))
     @device_profile(interval=1)  # ↔ train_flow.py:51 @gpu_profile
     @step
     def train(self):

@@ -31,12 +31,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tpu_watch import _clean_env as _watch_clean_env  # noqa: E402
 from tpu_watch import _drop_probe_cache  # noqa: E402
 
+from tpuflow.utils import knobs  # noqa: E402
+
 # Rehearsal mode: exercise the whole leg (three CLIs, run-id parsing,
 # profile/card extraction) on the CPU simulation WITHOUT claiming TPU
 # evidence — the record is printed but never merged into the ledger. An
 # untested leg discovering its bugs inside a brief healthy tunnel window
 # is exactly what this tool exists to prevent.
-ALLOW_CPU = os.environ.get("TPUFLOW_E2E_ALLOW_CPU") == "1"
+ALLOW_CPU = knobs.raw("TPUFLOW_E2E_ALLOW_CPU") == "1"
 
 
 def _clean_env() -> dict[str, str]:
@@ -82,7 +84,7 @@ def _run_id(out: str, flow: str) -> str:
 
 
 def _home() -> str:
-    return os.environ.get(
+    return knobs.raw(
         "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
     )
 
@@ -104,12 +106,12 @@ def _gpt_leg() -> dict | None:
     gpt = os.path.join(REPO, "flows", "gpt_flow.py")
     # Overridable so the CPU rehearsal can use the tiny preset (124M at
     # T=512 is a multi-minute-per-step proposition on the 1-core host).
-    preset = os.environ.get("TPUFLOW_E2E_GPT_PRESET", "gpt2")
-    seq = os.environ.get("TPUFLOW_E2E_GPT_SEQ", "512")
+    preset = knobs.raw("TPUFLOW_E2E_GPT_PRESET", "gpt2")
+    seq = knobs.raw("TPUFLOW_E2E_GPT_SEQ", "512")
     # Mesh axes must multiply to the child's device count: 1 on the real
     # single-chip TPU (the default), 8 on the CPU-rehearsal platform.
-    data_axis = os.environ.get("TPUFLOW_E2E_GPT_DATA_AXIS", "1")
-    fsdp_axis = os.environ.get("TPUFLOW_E2E_GPT_FSDP_AXIS", "1")
+    data_axis = knobs.raw("TPUFLOW_E2E_GPT_DATA_AXIS", "1")
+    fsdp_axis = knobs.raw("TPUFLOW_E2E_GPT_FSDP_AXIS", "1")
     steps = 8
     try:
         wall, out = run_cli(

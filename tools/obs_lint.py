@@ -1,242 +1,65 @@
 #!/usr/bin/env python
-"""Telemetry-name lint: every literal span/counter/gauge/histogram/event
-name emitted anywhere under ``tpuflow/`` must be registered — with the
-same kind — in the canonical catalog (``tpuflow.obs.catalog.CATALOG``).
+"""Telemetry-name lint — now a shim over ``tpuflow.lint.obs_pass``.
 
-This is the drift guard between emitters and consumers (the timeline
-card, ``obs.summarize``, downstream flows): rename a metric at the
-emitter without updating the catalog and this fails; record a span under
-a name registered as a counter and this fails. Unemitted catalog entries
-are reported as warnings (a name may be staged ahead of its emitter) but
-do not fail the lint.
+ISSUE 12 folded this tool into the shared AST-lint infrastructure as
+pass 4 of ``tools/tpulint.py``; the CLI and the pytest-twin surface
+(``lint``, ``emitted_names``, ``dynamic_name_calls``,
+``tier1_duration_guard``, ``REQUIRED_EMITTERS``, the tier-1 constants)
+keep working unchanged from here. One behavior change rode the move:
+an unemitted catalog entry is now an ERROR (see
+``tpuflow.lint.obs_pass.UNEMITTED_GRANDFATHER`` — explicit and empty).
 
-Run standalone (``python tools/obs_lint.py``, exit 1 on failure) or via
-its pytest twin (tests/test_obs.py::test_obs_catalog_lint).
+Run standalone (``python tools/obs_lint.py``, exit 1 on failure), via
+the pytest twin (tests/test_obs.py::test_obs_catalog_lint), or as part
+of ``python tools/tpulint.py``.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-# obs.span("name", ...) / obs.counter("name") / ... (the module-level API;
-# `_rec.` covers tpuflow.obs.health, which imports the recorder module
-# under that alias to avoid a circular package import)
-_API_RE = re.compile(
-    r"\b(?:obs|_rec)\.(span|counter|gauge|histogram|event)"
-    r"\(\s*[\"']([a-z0-9_.]+)[\"']"
+from tpuflow.lint import core as _core  # noqa: E402
+from tpuflow.lint import obs_pass as _obs  # noqa: E402
+from tpuflow.lint.obs_pass import (  # noqa: E402,F401
+    REQUIRED_EMITTERS,
+    TIER1_BUDGET_S,
+    TIER1_DURATION_FILE,
+    TIER1_GUARD_S,
+    UNEMITTED_GRANDFATHER,
+    _DYNAMIC_RE,
 )
-# obs.timed_iter(loader, "name") — records histogram observations
-_TIMED_ITER_RE = re.compile(
-    r"\bobs\.timed_iter\([^)]*?,\s*[\"']([a-z0-9_.]+)[\"']", re.S
-)
-# rec.record("span", "name", ...) — the low-level recorder API (used where
-# the duration is measured manually, e.g. the ckpt save commit thread)
-_RECORD_RE = re.compile(
-    r"\.record\(\s*[\"'](span|counter|gauge|histogram|event)[\"']\s*,"
-    r"\s*[\"']([a-z0-9_.]+)[\"']",
-    re.S,
-)
-# An emitter whose NAME is not a string literal (f-string, variable,
-# concatenation) is invisible to this lint: its name could drift from the
-# catalog — or never be registered at all — without failing anything.
-# Flag it as an error; emit literal names (one call per name) instead.
-_DYNAMIC_RE = re.compile(
-    r"\b(?:obs|_rec)\.(span|counter|gauge|histogram|event)\(\s*(?![\"'])\S"
-)
-# self._rec.record(kind, self._name, ...) etc. carry no literal name —
-# those are the recorder's own internals, exempted by path below.
-_EXEMPT_FILES = {os.path.join("tpuflow", "obs", "recorder.py")}
-
-# (kind, name) pairs the tree is REQUIRED to emit somewhere: registration
-# drift is one failure mode, silently deleting the telemetry a runbook
-# depends on is another. The durable-checkpointing evidence trail (ISSUE
-# 5) lives here; the pytest twin (tests/test_obs.py) checks these plus
-# its own per-subsystem list.
-REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
-    ("event", "ckpt.io_retry"),
-    ("event", "ckpt.io_error"),
-    ("event", "ckpt.save_failed"),
-    ("event", "ckpt.gc"),
-    ("span", "ckpt.upload"),
-    ("event", "ckpt.restore_tier"),
-    ("event", "ckpt.emergency_save"),
-    ("event", "ckpt.verify"),
-    ("event", "ckpt.corrupt"),
-    # Run observatory (ISSUE 6): the goodput-so-far gauges and the
-    # flight/export markers are runbook surfaces — deleting their
-    # emitters silently would orphan the goodput & live-monitoring
-    # runbook.
-    ("gauge", "goodput.productive_s"),
-    ("gauge", "goodput.lost_s"),
-    ("gauge", "goodput.fraction"),
-    ("event", "obs.flight"),
-    ("event", "obs.export"),
-    # Elastic gang (ISSUE 7): the resize evidence trail — the Elastic
-    # gang runbook and the goodput `resize` bucket both consume these.
-    ("span", "flow.gang_resize"),
-    ("event", "flow.member_lost"),
-    ("gauge", "dist.mesh_generation"),
-    # Serving engine (ISSUE 8): the Serving runbook's operator surface —
-    # queue depth, occupancy, TTFT, per-request decode rate, plus the
-    # admission/completion evidence trail and the AOT warm marker.
-    ("gauge", "serve.queue_depth"),
-    ("gauge", "serve.slot_occupancy"),
-    ("gauge", "serve.ttft_s"),
-    ("gauge", "serve.tokens_per_s"),
-    ("counter", "serve.tokens"),
-    ("counter", "serve.requests"),
-    ("event", "serve.admit"),
-    ("event", "serve.complete"),
-    ("span", "serve.warmup"),
-    ("span", "serve.prefill"),
-    ("span", "serve.decode"),
-    # Paged KV serving (ISSUE 11): the page-pool / prefix-cache /
-    # speculative-acceptance surface the Serving runbook's paged section
-    # and the /metrics tpuflow_serve_* names read.
-    ("gauge", "serve.pages_free"),
-    ("gauge", "serve.prefix_hits"),
-    ("gauge", "serve.spec_accept_rate"),
-    ("event", "serve.page_evict"),
-    # Native int8 decode (ISSUE 9): the per-request int8 serving trail
-    # and the quantization-decision evidence the Quantization runbook
-    # reads — deleting these emitters would orphan it.
-    ("span", "serve.quant_decode"),
-    ("counter", "serve.quant_requests"),
-    ("event", "quant.decision"),
-    ("event", "quant.kernel_fallback"),
-    # Raise-MFU step work (ISSUE 10): backward-kernel provenance, the
-    # remat selector, and the comm-overlap attribution pair the step
-    # pipeline runbook's "reading exposed comm" section consumes.
-    ("event", "ops.flash_bwd_fused"),
-    ("event", "train.remat_policy"),
-    ("gauge", "train.exposed_comm_s"),
-    ("gauge", "train.comm_overlap_s"),
-)
-
-# Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records
-# every full 'not slow' session's wall time here; exceeding the guard
-# threshold fails this lint BEFORE the suite exceeds the hard CI budget
-# and starts getting killed by the timeout — the 50 s margin is the
-# early warning.
-TIER1_BUDGET_S = 870.0
-TIER1_GUARD_S = 820.0
-TIER1_DURATION_FILE = ".tier1_duration.json"
-# Records from partial runs (a handful of tests) say nothing about the
-# full suite; only judge sessions that collected most of it.
-_TIER1_MIN_TESTS = 100
 
 
 def tier1_duration_guard(root: str = REPO) -> str | None:
-    """Error string when the last recorded full tier-1 session exceeded
-    the duration guard, else None. Only full 'not slow' sessions are
-    judged; no record (fresh clone, CI cache wipe) passes vacuously."""
-    try:
-        with open(os.path.join(root, TIER1_DURATION_FILE)) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        return None
-    if rec.get("markexpr") != "not slow":
-        return None
-    try:
-        if int(rec.get("testscollected", 0)) < _TIER1_MIN_TESTS:
-            return None
-        dur = float(rec.get("duration_s", 0.0))
-    except (TypeError, ValueError):
-        return None
-    if dur > TIER1_GUARD_S:
-        return (
-            f"tier-1 suite recorded {dur:.0f}s, over the {TIER1_GUARD_S:.0f}s "
-            f"guard of the {TIER1_BUDGET_S:.0f}s budget — slow-mark the "
-            "newest long tests or speed the suite up before CI starts "
-            "timing out"
-        )
-    return None
+    return _obs.tier1_duration_guard(root)
 
 
 def dynamic_name_calls(src: str) -> list[str]:
     """Emitter calls in ``src`` whose name argument is not a string
-    literal (unlintable — see _DYNAMIC_RE). Returns the matched heads."""
+    literal (unlintable). Returns the matched heads."""
     return [m.group(0) for m in _DYNAMIC_RE.finditer(src)]
 
 
 def emitted_names(root: str = REPO) -> list[tuple[str, str, str]]:
-    """(relpath, kind, name) for every literal emitter call in tpuflow/."""
-    out = []
-    pkg = os.path.join(root, "tpuflow")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            if rel in _EXEMPT_FILES:
-                continue
-            with open(path) as f:
-                src = f.read()
-            for m in _API_RE.finditer(src):
-                out.append((rel, m.group(1), m.group(2)))
-            for m in _TIMED_ITER_RE.finditer(src):
-                out.append((rel, "histogram", m.group(1)))
-            for m in _RECORD_RE.finditer(src):
-                out.append((rel, m.group(1), m.group(2)))
-    return out
+    """(relpath, kind, name) for every literal emitter call in
+    tpuflow/."""
+    tree = _core.Tree(root)
+    return [
+        (rel, kind, name)
+        for rel, kind, name, _line in _obs.emitted_names(tree)
+    ]
 
 
 def lint(root: str = REPO) -> tuple[list[str], list[str]]:
-    """Returns (errors, warnings)."""
-    sys.path.insert(0, root)
-    from tpuflow.obs.catalog import CATALOG
-
-    errors, used = [], set()
-    for rel, kind, name in emitted_names(root):
-        used.add(name)
-        if name not in CATALOG:
-            errors.append(
-                f"{rel}: emits {kind} {name!r} not registered in "
-                "tpuflow.obs.catalog.CATALOG"
-            )
-        elif CATALOG[name][0] != kind:
-            errors.append(
-                f"{rel}: emits {name!r} as {kind} but the catalog "
-                f"registers it as {CATALOG[name][0]}"
-            )
-    pkg = os.path.join(root, "tpuflow")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            if rel in _EXEMPT_FILES:
-                continue
-            with open(path) as f:
-                src = f.read()
-            for head in dynamic_name_calls(src):
-                errors.append(
-                    f"{rel}: emitter with a non-literal name "
-                    f"({head!r}...) is invisible to this lint — emit "
-                    "literal catalog names instead"
-                )
-    kinds = {(k, n) for _, k, n in emitted_names(root)}
-    for required in REQUIRED_EMITTERS:
-        if required not in kinds:
-            errors.append(
-                f"required emitter missing from tpuflow/: "
-                f"{required[1]!r} ({required[0]})"
-            )
-    duration_err = tier1_duration_guard(root)
-    if duration_err:
-        errors.append(duration_err)
-    warnings = [
-        f"catalog name {name!r} has no literal emitter in tpuflow/"
-        for name in sorted(set(CATALOG) - used)
-    ]
-    return errors, warnings
+    """Returns (errors, warnings). Warnings are always empty since the
+    unemitted-entry promotion; the shared pass appends
+    tier1_duration_guard(root) to its errors."""
+    findings = _obs.run(_core.Tree(root))
+    return [str(f) for f in findings], []
 
 
 def main() -> int:

@@ -44,6 +44,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Runnable from anywhere, like the other standalone tools: the knob
+# registry lives in the package.
+sys.path.insert(0, REPO)
+from tpuflow.utils import knobs  # noqa: E402
 EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE.json")
 
 
@@ -61,7 +66,7 @@ def _clean_env(extra: dict[str, str] | None = None) -> dict[str, str]:
 
 
 def _drop_probe_cache() -> None:
-    home = os.environ.get(
+    home = knobs.raw(
         "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
     )
     try:
@@ -332,7 +337,7 @@ if __name__ == "__main__":
         else:
             follow_url = (
                 "http://127.0.0.1:"
-                f"{os.environ.get('TPUFLOW_OBS_HTTP_PORT', '8080')}"
+                f"{knobs.raw('TPUFLOW_OBS_HTTP_PORT', '8080')}"
             )
         sys.exit(
             follow(

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""tpulint — AST invariant checker for the hand-maintained contracts.
+
+Eleven PRs of perf and robustness work rest on invariants that, until
+now, lived only in comments and runtime tests: the serving engine's
+never-recompile contract, the PR-4 donation discipline, trace-time
+constant hygiene inside jit bodies, and a ~100-knob ``TPUFLOW_*`` env
+surface whose README tables were hand-kept. This tool checks them
+statically, on every tree, in seconds:
+
+- **pass 1, knobs** (``tpuflow/lint/knob_pass.py``): every TPUFLOW_*
+  read goes through the registry (``tpuflow/utils/knobs.py``), every
+  literal names a declared knob, and the README knob tables match the
+  generated region byte-for-byte.
+- **pass 2, jit** (``tpuflow/lint/jit_pass.py``): no env/knob reads,
+  ``time.*``, or host RNG inside traced bodies; no host syncs on traced
+  values; donation restricted to step/engine state and never reused
+  after the call.
+- **pass 3, recompile** (``tpuflow/lint/recompile_pass.py``): the
+  ServeEngine jit program inventory, ``compile_stats()``, ``warmup()``,
+  ``aot_lower()``, and ``tools/prewarm_cache.py`` coverage agree.
+- **pass 4, obs** (``tpuflow/lint/obs_pass.py``): the telemetry-name
+  catalog lint, with unemitted catalog entries promoted to errors
+  (``tools/obs_lint.py`` remains as a working shim).
+
+Silence a finding with an inline pragma **with a justification**::
+
+    # tpulint: disable=<rule> -- <why this is safe>
+
+Run standalone (exit 1 on violation) or via the pytest twin
+(tests/test_tpulint.py). See README "Static analysis runbook".
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuflow.lint import core  # noqa: E402
+from tpuflow.lint import (  # noqa: E402
+    jit_pass,
+    knob_pass,
+    obs_pass,
+    recompile_pass,
+)
+
+PASSES = {
+    "knobs": knob_pass.run,
+    "jit": jit_pass.run,
+    "recompile": recompile_pass.run,
+    "obs": obs_pass.run,
+}
+
+
+def lint(root: str = REPO, passes=None):
+    """All findings for ``root`` (shared parsed-source cache across
+    passes). ``passes`` is an iterable of pass names, default all."""
+    tree = core.Tree(root)
+    findings = []
+    for name in passes or PASSES:
+        findings.extend(PASSES[name](tree))
+    findings.extend(tree.parse_errors)
+    return findings
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument(
+        "--pass", dest="passes", action="append", choices=sorted(PASSES),
+        help="run only this pass (repeatable; default: all four)",
+    )
+    p.add_argument("--root", default=REPO)
+    args = p.parse_args(argv)
+    findings = lint(args.root, args.passes)
+    for f in findings:
+        print(f"[tpulint] ERROR: {f}")
+    if findings:
+        print(f"[tpulint] {len(findings)} finding(s)")
+        return 1
+    ran = ",".join(args.passes or sorted(PASSES))
+    print(f"[tpulint] ok (passes: {ran})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

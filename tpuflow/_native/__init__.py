@@ -14,6 +14,7 @@ import os
 import subprocess
 
 import numpy as np
+from tpuflow.utils import knobs
 
 logger = logging.getLogger("tpuflow.native")
 
@@ -126,7 +127,7 @@ def _bind(L: ctypes.CDLL) -> None:
 
 def default_threads() -> int:
     return int(
-        os.environ.get("TPUFLOW_IO_THREADS", min(os.cpu_count() or 1, 16))
+        knobs.raw("TPUFLOW_IO_THREADS", min(os.cpu_count() or 1, 16))
     )
 
 

@@ -41,6 +41,7 @@ import orbax.checkpoint as ocp
 
 from tpuflow import obs
 from tpuflow.ckpt.handle import Checkpoint
+from tpuflow.utils import knobs
 
 _STATE_DIR = "state"
 _META_FILE = "metadata.json"
@@ -59,7 +60,7 @@ def _local_tier_root(persistent_dir: str) -> str | None:
     requeued attempt of the SAME run on the same node finds its local
     copies again — that is the whole point of the tier (restore in
     seconds after a preemption instead of re-reading the run dir)."""
-    root = os.environ.get("TPUFLOW_CKPT_LOCAL_DIR")
+    root = knobs.raw("TPUFLOW_CKPT_LOCAL_DIR")
     if not root:
         return None
     key = hashlib.sha1(os.path.abspath(persistent_dir).encode()).hexdigest()[:16]
@@ -71,7 +72,7 @@ def _local_keep(default: int = 2) -> int:
     steps survive, oldest evicted first — requeue loops must not fill node
     disk. Clamped to >= 1 (a tier that keeps nothing is the tier being
     off); malformed falls back to ``default``."""
-    env = os.environ.get("TPUFLOW_CKPT_LOCAL_KEEP")
+    env = knobs.raw("TPUFLOW_CKPT_LOCAL_KEEP")
     if env:
         try:
             return max(1, int(env))
@@ -168,7 +169,7 @@ class CheckpointManager:
         # 'raw' = native striped-IO per-leaf files (fast path; needs fully
         # addressable leaves, i.e. single-host); 'orbax' = tensorstore OCDBT
         # (multi-host sharded writes). 'auto' picks raw when possible.
-        format = os.environ.get("TPUFLOW_CKPT_FORMAT", format)
+        format = knobs.raw("TPUFLOW_CKPT_FORMAT", format)
         if format == "auto":
             # The native raw format handles both single- and multi-host
             # states (each host writes its own shards); Orbax/ocdbt stays
@@ -607,7 +608,7 @@ class CheckpointManager:
             if jax.process_index() == 0:
                 if merge:
                     raw_fmt.merge_manifests(state_dir)
-                if os.environ.get("TPUFLOW_FAULT"):
+                if knobs.raw("TPUFLOW_FAULT"):
                     from tpuflow.testing import faults
 
                     if faults.partial_commit():
@@ -691,7 +692,7 @@ class CheckpointManager:
         tmp = dst + _STAGE_SUFFIX
 
         def _copy() -> None:
-            if os.environ.get("TPUFLOW_FAULT"):
+            if knobs.raw("TPUFLOW_FAULT"):
                 from tpuflow.testing import faults
 
                 faults.maybe_upload_stall()

@@ -41,6 +41,7 @@ import jax
 import numpy as np
 
 from tpuflow import _native
+from tpuflow.utils import knobs
 
 MANIFEST = "manifest.json"
 FORMAT_NAME = "tpuflow-raw-v2"
@@ -89,7 +90,7 @@ def io_retries(default: int = 4) -> int:
     (``TPUFLOW_CKPT_IO_RETRIES``). 0 disables retrying; a malformed value
     falls back to ``default`` (checkpointing must never die on a typo'd
     env var mid-provisioning)."""
-    env = os.environ.get("TPUFLOW_CKPT_IO_RETRIES")
+    env = knobs.raw("TPUFLOW_CKPT_IO_RETRIES")
     if env:
         try:
             return max(0, int(env))
@@ -102,7 +103,7 @@ def io_backoff_s(default: float = 0.05) -> float:
     """Base backoff before the first retry (``TPUFLOW_CKPT_IO_BACKOFF_S``);
     doubles per attempt with 50-100% jitter so a gang's writers don't
     hammer a recovering filesystem in lockstep."""
-    env = os.environ.get("TPUFLOW_CKPT_IO_BACKOFF_S")
+    env = knobs.raw("TPUFLOW_CKPT_IO_BACKOFF_S")
     if env:
         try:
             return max(0.0, float(env))
@@ -148,7 +149,7 @@ def retry_io(
     while True:
         attempt += 1
         try:
-            if os.environ.get("TPUFLOW_FAULT"):
+            if knobs.raw("TPUFLOW_FAULT"):
                 from tpuflow.testing import faults
 
                 faults.ckpt_io_fault(op, path)
@@ -191,7 +192,7 @@ def _verify_enabled() -> bool:
     the manifest at save). On by default; ``TPUFLOW_CKPT_VERIFY=0`` opts
     out (e.g. to reclaim the checksum pass on trusted local storage or to
     keep zero-copy restores from touching every page)."""
-    return os.environ.get("TPUFLOW_CKPT_VERIFY", "1") not in ("0", "false")
+    return knobs.raw("TPUFLOW_CKPT_VERIFY", "1") not in ("0", "false")
 
 
 def _crc32(arr: np.ndarray) -> int:
@@ -267,7 +268,7 @@ def _mmap_enabled() -> bool:
     runs (e.g. batch eval); while enabled, this process's managers unlink
     retired files instead of recycling them (see RecyclePool.adopt_dir).
     """
-    return os.environ.get("TPUFLOW_CKPT_MMAP", "0") == "1"
+    return knobs.raw("TPUFLOW_CKPT_MMAP", "0") == "1"
 
 
 def _spare_cores() -> int:
@@ -282,7 +283,7 @@ def _spare_cores() -> int:
     restore pays exactly what it would have paid with no prewarm at all.
     Override: TPUFLOW_PREWARM_THREADS (0 parks, >=1 forces background).
     """
-    env = os.environ.get("TPUFLOW_PREWARM_THREADS")
+    env = knobs.raw("TPUFLOW_PREWARM_THREADS")
     if env is not None:
         try:
             return max(int(env), 0)
@@ -820,7 +821,7 @@ def _write_one(directory: str, fname: str, arr, pool: RecyclePool | None) -> Non
         _native.write_bytes(dst, arr)
 
     retry_io(attempt, op="write_shard", path=dst)
-    if os.environ.get("TPUFLOW_FAULT"):
+    if knobs.raw("TPUFLOW_FAULT"):
         from tpuflow.testing import faults
 
         faults.corrupt_after_write(dst)
@@ -889,7 +890,7 @@ def _write_entries(
                 }
             )
         manifest["leaves"].append(entry)
-    width = int(os.environ.get("TPUFLOW_WRITE_CONCURRENCY", "0")) or (
+    width = int(knobs.raw("TPUFLOW_WRITE_CONCURRENCY", "0")) or (
         1 if _fs_is_memory_backed(directory) else 4
     )
     if width <= 1 or len(jobs) <= 1:
@@ -1428,7 +1429,7 @@ def _restore_raw_inner(
         # TPUFLOW_IO_THREADS is a user cap on inflight IO (e.g. to stay
         # polite on shared storage) — it wins over the floor.
         budget = _native.default_threads()
-        if "TPUFLOW_IO_THREADS" not in os.environ:
+        if not knobs.is_set("TPUFLOW_IO_THREADS"):
             budget = max(budget, 4)
         workers = min(n_tasks, budget) or 1
         # Each pooled task gets its slice of the FLOORED budget (not the

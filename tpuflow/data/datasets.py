@@ -28,6 +28,7 @@ import sys
 import numpy as np
 
 from tpuflow.utils import FileLock
+from tpuflow.utils import knobs
 
 def _default_dir() -> str:
     """Resolve TPUFLOW_DATA_DIR at CALL time, not import time: a frozen
@@ -37,7 +38,7 @@ def _default_dir() -> str:
     storage) silently reads/writes someone else's dataset cache — the
     readme-contract test evaluated a 10k-row cache left in the login
     user's default dir by an unrelated manual run."""
-    return os.environ.get(
+    return knobs.raw(
         "TPUFLOW_DATA_DIR", os.path.expanduser("~/tpuflow_data")
     )
 
@@ -223,7 +224,7 @@ def resolve_text_path(
     possibly different environment."""
     import glob as _glob
 
-    explicit = text_path or os.environ.get("TPUFLOW_TEXT_FILE")
+    explicit = text_path or knobs.raw("TPUFLOW_TEXT_FILE")
     if explicit:
         if not os.path.exists(explicit):
             # An explicitly requested file must never silently degrade to
@@ -343,8 +344,8 @@ def _load_fashion_mnist(data_dir: str, name: str) -> Dataset:
             _read_idx(files["test_labels"]).astype(np.int32),
         )
         return Dataset(name, train, test, 10, synthetic=False)
-    n_train = int(os.environ.get("TPUFLOW_SYNTH_TRAIN_N", 60_000))
-    n_test = int(os.environ.get("TPUFLOW_SYNTH_TEST_N", 10_000))
+    n_train = int(knobs.raw("TPUFLOW_SYNTH_TRAIN_N", 60_000))
+    n_test = int(knobs.raw("TPUFLOW_SYNTH_TEST_N", 10_000))
     train, test = _synth_classification(
         seed=20, n_train=n_train, n_test=n_test, shape=(28, 28), num_classes=10
     )
@@ -371,8 +372,8 @@ def _load_cifar10(data_dir: str) -> Dataset:
             10,
             synthetic=False,
         )
-    n_train = int(os.environ.get("TPUFLOW_SYNTH_TRAIN_N", 50_000))
-    n_test = int(os.environ.get("TPUFLOW_SYNTH_TEST_N", 10_000))
+    n_train = int(knobs.raw("TPUFLOW_SYNTH_TRAIN_N", 50_000))
+    n_test = int(knobs.raw("TPUFLOW_SYNTH_TEST_N", 10_000))
     spec = _DATASET_SPECS["cifar10"]
     train, test = _synth_classification(
         seed=30, n_train=n_train, n_test=n_test, shape=spec["shape"],
@@ -389,9 +390,9 @@ def _load_synthetic_imagenet(size: int) -> Dataset:
     spec = _DATASET_SPECS["imagenet_synth"]
     train, test = _synth_classification(
         seed=40,
-        n_train=int(os.environ.get("TPUFLOW_SYNTH_TRAIN_N", size)),
+        n_train=int(knobs.raw("TPUFLOW_SYNTH_TRAIN_N", size)),
         n_test=int(
-            os.environ.get("TPUFLOW_SYNTH_TEST_N", max(size // 10, 100))
+            knobs.raw("TPUFLOW_SYNTH_TEST_N", max(size // 10, 100))
         ),
         shape=spec["shape"],
         num_classes=spec["num_classes"],

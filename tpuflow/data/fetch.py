@@ -26,6 +26,7 @@ import urllib.error
 import urllib.request
 
 from tpuflow.utils.locking import FileLock
+from tpuflow.utils import knobs
 
 # Fashion-MNIST registry: gz filename -> (default source, digest). The
 # digests are the published torchvision ones (md5 — what upstream
@@ -40,7 +41,7 @@ FASHION_MNIST_FILES: dict[str, str] = {
 
 
 def fetch_enabled() -> bool:
-    return os.environ.get("TPUFLOW_FETCH") == "1"
+    return knobs.raw("TPUFLOW_FETCH") == "1"
 
 
 def _digest(path: str, spec: str) -> bool:
@@ -97,7 +98,7 @@ def fetch_idx_files(
     unreachable — the caller falls back exactly as if fetching were
     disabled."""
     os.makedirs(data_dir, exist_ok=True)
-    base = os.environ.get("TPUFLOW_FETCH_BASE_URL", base_url)
+    base = knobs.raw("TPUFLOW_FETCH_BASE_URL", base_url)
     if not base.endswith("/"):
         base += "/"
     with FileLock(os.path.join(data_dir, ".fetch.lock")):

@@ -17,6 +17,7 @@ from typing import Iterator
 import numpy as np
 
 from tpuflow.data.datasets import Split
+from tpuflow.utils import knobs
 
 
 def _take(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
@@ -186,7 +187,7 @@ def prefetch_depth(default: int = 2) -> int:
     per call). A malformed value falls back to ``default``."""
     import os
 
-    env = os.environ.get("TPUFLOW_PREFETCH_DEPTH")
+    env = knobs.raw("TPUFLOW_PREFETCH_DEPTH")
     if env:
         try:
             return int(env)

@@ -68,6 +68,7 @@ import json
 import os
 import time
 from typing import Any
+from tpuflow.utils import knobs
 
 __all__ = [
     "Generation",
@@ -187,7 +188,7 @@ def reset() -> None:
 
 
 def membership_dir() -> str | None:
-    return os.environ.get("TPUFLOW_MEMBERSHIP_DIR") or None
+    return knobs.raw("TPUFLOW_MEMBERSHIP_DIR") or None
 
 
 def enabled() -> bool:
@@ -198,7 +199,7 @@ def enabled() -> bool:
 def member_id() -> int:
     """This process's ORIGINAL gang rank (stable across generations)."""
     try:
-        return int(os.environ.get("TPUFLOW_PROCESS_ID", "0"))
+        return int(knobs.raw("TPUFLOW_PROCESS_ID", "0"))
     except ValueError:
         return 0
 
@@ -278,7 +279,7 @@ def reform_after_failure(
     if not enabled():
         return None
     if timeout_s is None:
-        timeout_s = float(os.environ.get("TPUFLOW_REFORM_WAIT_S", "10"))
+        timeout_s = float(knobs.raw("TPUFLOW_REFORM_WAIT_S", "10"))
     deadline = time.monotonic() + max(timeout_s, 0.0)
     while True:
         plan = pending_reform()

@@ -28,6 +28,7 @@ from typing import Mapping, Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from tpuflow.utils import knobs
 
 logger = logging.getLogger("tpuflow.dist")
 
@@ -72,7 +73,7 @@ def _platform_is_cpu() -> bool:
     # (it records the probed backend name for exactly this kind of
     # pre-init consumer). Unset means no probe ran — an accelerator-
     # targeting entry point — and reports False.
-    return os.environ.get("TPUFLOW_PLATFORM_BACKEND", "") == "cpu"
+    return knobs.raw("TPUFLOW_PLATFORM_BACKEND", "") == "cpu"
 
 
 def maybe_enable_compile_cache(run_dir: str | None = None) -> str | None:
@@ -108,12 +109,12 @@ def maybe_enable_compile_cache(run_dir: str | None = None) -> str | None:
     cache). CPU compiles are seconds, so the cache buys nothing there;
     ``TPUFLOW_COMPILE_CACHE_CPU=1`` force-enables for experiments.
     """
-    knob = os.environ.get("TPUFLOW_COMPILE_CACHE", "")
+    knob = knobs.raw("TPUFLOW_COMPILE_CACHE", "")
     if knob.lower() in ("0", "false", "off"):
         return None
     if (
         _platform_is_cpu()
-        and os.environ.get("TPUFLOW_COMPILE_CACHE_CPU") != "1"
+        and knobs.raw("TPUFLOW_COMPILE_CACHE_CPU") != "1"
     ):
         return None
     if knob.lower() == "run":
@@ -130,7 +131,7 @@ def maybe_enable_compile_cache(run_dir: str | None = None) -> str | None:
         # process a disjoint cache).
         knob = ""
     cache_dir = knob or os.path.join(
-        os.environ.get(
+        knobs.raw(
             "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
         ),
         "compile_cache",
@@ -173,7 +174,7 @@ def maybe_enable_async_collectives() -> bool:
     Call sites: gang member bootstrap (flow.gang_exec) and the in-process
     train entry (train.train_gpt), both ahead of backend init.
     """
-    if os.environ.get("TPUFLOW_COMM_OVERLAP", "1").lower() in (
+    if knobs.raw("TPUFLOW_COMM_OVERLAP", "1").lower() in (
         "0", "false", "off",
     ):
         return False
@@ -292,7 +293,7 @@ def ensure_healthy_platform(
     import subprocess
     import sys
 
-    if os.environ.get("TPUFLOW_FORCE_CPU") == "1":
+    if knobs.raw("TPUFLOW_FORCE_CPU") == "1":
         force_cpu_platform(n_cpu_devices)
         return "cpu"
     if _platform_is_cpu():
@@ -307,7 +308,7 @@ def ensure_healthy_platform(
         # already initialized).
         force_cpu_platform(n_cpu_devices)
         return "cpu"
-    cached = os.environ.get("TPUFLOW_PLATFORM_PROBED") or _probe_cache_read()
+    cached = knobs.raw("TPUFLOW_PLATFORM_PROBED") or _probe_cache_read()
     if cached == "cpu":
         force_cpu_platform(n_cpu_devices)
         return "cpu"
@@ -351,7 +352,7 @@ _PROBE_CACHE_TTL_S = 600.0
 
 
 def _probe_cache_path() -> str:
-    home = os.environ.get(
+    home = knobs.raw(
         "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
     )
     return os.path.join(home, "platform_probe.json")
@@ -417,21 +418,21 @@ def initialize(
     global _initialized_multihost
     if _initialized_multihost:
         return
-    env_world = os.environ.get("TPUFLOW_NUM_PROCESSES")
+    env_world = knobs.raw("TPUFLOW_NUM_PROCESSES")
     if num_processes is None and env_world is not None:
         num_processes = int(env_world)
-        coordinator_address = coordinator_address or os.environ.get(
+        coordinator_address = coordinator_address or knobs.raw(
             "TPUFLOW_COORDINATOR", "127.0.0.1:42042"
         )
         process_id = (
             process_id
             if process_id is not None
-            else int(os.environ.get("TPUFLOW_PROCESS_ID", "0"))
+            else int(knobs.raw("TPUFLOW_PROCESS_ID", "0"))
         )
     if (
         num_processes is not None
         and num_processes > 1
-        and os.environ.get("TPUFLOW_MEMBERSHIP_DIR")
+        and knobs.raw("TPUFLOW_MEMBERSHIP_DIR")
     ):
         # Elastic gang (ISSUE 7): generation 0 comes up through the
         # membership runtime — a teardown-capable client/service pair —

@@ -11,6 +11,7 @@ import os
 from typing import Any
 
 from tpuflow.flow import store
+from tpuflow.utils import knobs
 
 # Sentinel distinguishing "never set" (default user namespace) from an
 # explicit namespace(None) (global — resolve everything), matching the
@@ -24,7 +25,7 @@ def default_namespace() -> str:
     """The namespace runs are produced under when none is set explicitly:
     ``TPUFLOW_NAMESPACE`` env, else ``user:<login>`` (the Metaflow
     convention)."""
-    ns = os.environ.get("TPUFLOW_NAMESPACE")
+    ns = knobs.raw("TPUFLOW_NAMESPACE")
     if ns:
         return ns
     import getpass

@@ -30,6 +30,7 @@ import os
 from typing import Any
 
 from tpuflow.utils.preempt import REQUEUE_EXIT_CODE
+from tpuflow.utils import knobs
 
 
 def _requeue_pod_failure_policy() -> dict:
@@ -202,12 +203,12 @@ def _gang_jobset(
     import os as _os
 
     min_members = gang.get("min_members") or int(
-        _os.environ.get("TPUFLOW_GANG_MIN_MEMBERS", "2")
+        knobs.raw("TPUFLOW_GANG_MIN_MEMBERS", "2")
     )
     annotations = {
         "tpuflow.dev/min-gang-members": str(min(min_members, topo["hosts"])),
         "tpuflow.dev/max-gang-members": str(topo["hosts"]),
-        "tpuflow.dev/elastic": _os.environ.get("TPUFLOW_ELASTIC", "0"),
+        "tpuflow.dev/elastic": knobs.raw("TPUFLOW_ELASTIC", "0"),
     }
     return {
         "apiVersion": "jobset.x-k8s.io/v1alpha2",

@@ -20,17 +20,18 @@ import importlib.util
 import os
 import pickle
 import sys
+from tpuflow.utils import knobs
 
 
 def _bootstrap_jax() -> None:
     import jax
 
-    if os.environ.get("TPUFLOW_FORCE_CPU") == "1":
+    if knobs.raw("TPUFLOW_FORCE_CPU") == "1":
         from tpuflow.dist import force_cpu_platform
 
-        local = int(os.environ.get("TPUFLOW_GANG_LOCAL_DEVICES", "1"))
+        local = int(knobs.raw("TPUFLOW_GANG_LOCAL_DEVICES", "1"))
         force_cpu_platform(local, exact=True)
-        if int(os.environ.get("TPUFLOW_NUM_PROCESSES", "1")) > 1:
+        if int(knobs.raw("TPUFLOW_NUM_PROCESSES", "1")) > 1:
             # Cross-process CPU collectives only exist for real gangs —
             # a 1-process member must not ask for gloo (jaxlib refuses to
             # build gloo collectives without a distributed client).
@@ -51,7 +52,7 @@ def _bootstrap_jax() -> None:
     # attempt on a fresh pod still reloads the compiled step.
     from tpuflow.dist import maybe_enable_compile_cache, seed_compile_cache
 
-    obs_dir = os.environ.get("TPUFLOW_OBS_DIR")
+    obs_dir = knobs.raw("TPUFLOW_OBS_DIR")
     cache_dir = maybe_enable_compile_cache(
         run_dir=os.path.dirname(obs_dir) if obs_dir else None
     )
@@ -62,7 +63,7 @@ def _bootstrap_jax() -> None:
     # of paying the measured 62.9 s compile inside wall-to-first-step.
     # Rsync-style: only entries absent here are copied, existing ones
     # never touched, and an unreadable source is a silent no-op.
-    prewarm = os.environ.get("TPUFLOW_PREWARM_CACHE")
+    prewarm = knobs.raw("TPUFLOW_PREWARM_CACHE")
     if prewarm and cache_dir and prewarm != cache_dir:
         copied = seed_compile_cache(prewarm, cache_dir)
         if copied:
@@ -166,10 +167,10 @@ def main(argv: list[str]) -> None:
     from tpuflow.flow import store
     from tpuflow.flow.spec import current
 
-    timeout = float(os.environ.get("TPUFLOW_GANG_TIMEOUT", "300"))
+    timeout = float(knobs.raw("TPUFLOW_GANG_TIMEOUT", "300"))
     if (
         membership.enabled()
-        and os.environ.get("TPUFLOW_GANG_REJOIN") == "1"
+        and knobs.raw("TPUFLOW_GANG_REJOIN") == "1"
     ):
         # Requeued capacity rejoining an elastic gang (ISSUE 7): skip the
         # gen-0 rendezvous entirely — request inclusion, wait for the
@@ -289,7 +290,7 @@ def main(argv: list[str]) -> None:
             others = set(plan.roster if plan else ()) - {me}
             membership.await_done(
                 others,
-                timeout_s=float(os.environ.get("TPUFLOW_KILL_GRACE_S", "5")),
+                timeout_s=float(knobs.raw("TPUFLOW_KILL_GRACE_S", "5")),
             )
             import time as _time
 

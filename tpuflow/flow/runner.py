@@ -33,6 +33,7 @@ from tpuflow.flow.cards import CardBuffer
 from tpuflow.flow.client import Run
 from tpuflow.flow.spec import FlowSpec, current
 from tpuflow.utils.preempt import REQUEUE_EXIT_CODE
+from tpuflow.utils import knobs
 
 
 class StepFailed(Exception):
@@ -242,7 +243,7 @@ class FlowRunner:
         # each), and the end-of-run merge produces <rdir>/events.jsonl.
         # TPUFLOW_OBS=0 disables recording entirely (README Observability).
         self._obs_dir = None
-        if os.environ.get("TPUFLOW_OBS", "1") not in ("0", "false"):
+        if knobs.raw("TPUFLOW_OBS", "1") not in ("0", "false"):
             self._obs_dir = os.path.join(rdir, "obs")
             obs.configure(self._obs_dir, proc=0)
         run_span = obs.span("flow.run", flow=self.flow_name, run=str(run_id))
@@ -267,7 +268,7 @@ class FlowRunner:
                 attempt = 0
                 requeues = 0
                 max_requeues = int(
-                    os.environ.get("TPUFLOW_MAX_REQUEUES", "8")
+                    knobs.raw("TPUFLOW_MAX_REQUEUES", "8")
                 )
                 while True:
                     try:
@@ -517,7 +518,7 @@ class FlowRunner:
         # re-form through this shared membership dir (cleared per launch:
         # a previous attempt's plan must not leak into this world).
         elastic = (
-            os.environ.get("TPUFLOW_ELASTIC") == "1" and num_parallel > 1
+            knobs.raw("TPUFLOW_ELASTIC") == "1" and num_parallel > 1
         )
         membership_dir = None
         if elastic:
@@ -569,7 +570,7 @@ class FlowRunner:
                 # the budget that actually applies here. Deployed,
                 # the pod spec sets TPUFLOW_PREEMPT_GRACE_S from
                 # terminationGracePeriodSeconds instead.
-                env["TPUFLOW_PREEMPT_GRACE_S"] = os.environ.get(
+                env["TPUFLOW_PREEMPT_GRACE_S"] = knobs.raw(
                     "TPUFLOW_KILL_GRACE_S", "5"
                 )
             if getattr(self, "_obs_dir", None):
@@ -711,7 +712,7 @@ class FlowRunner:
         """
         if stall_timeout is None:
             stall_timeout = float(
-                os.environ.get("TPUFLOW_STALL_TIMEOUT_S", "600")
+                knobs.raw("TPUFLOW_STALL_TIMEOUT_S", "600")
             )
         deadline = time.monotonic() + timeout + 600.0
         n = len(procs)
@@ -732,12 +733,12 @@ class FlowRunner:
             floor = (
                 int(min_members)
                 if min_members
-                else int(os.environ.get("TPUFLOW_GANG_MIN_MEMBERS", "2"))
+                else int(knobs.raw("TPUFLOW_GANG_MIN_MEMBERS", "2"))
             )
             reform_timeout = float(
-                os.environ.get("TPUFLOW_REFORM_TIMEOUT_S", "120")
+                knobs.raw("TPUFLOW_REFORM_TIMEOUT_S", "120")
             )
-            max_resizes = int(os.environ.get("TPUFLOW_MAX_RESIZES", "8"))
+            max_resizes = int(knobs.raw("TPUFLOW_MAX_RESIZES", "8"))
             try:
                 # ``member_lost`` faults model PERMANENT capacity loss:
                 # their requeue is suppressed so shrink is exercised
@@ -947,7 +948,7 @@ class FlowRunner:
                         )
                         or time.monotonic() - formed_at
                         > float(
-                            os.environ.get("TPUFLOW_REJOIN_HOLD_S", "10")
+                            knobs.raw("TPUFLOW_REJOIN_HOLD_S", "10")
                         )
                     ):
                         m = pending_rejoin.pop(0)
@@ -1081,7 +1082,7 @@ class FlowRunner:
     def _kill_survivors(procs: list, rcs: list) -> None:
         """SIGTERM surviving members (their preemption handler drains a
         final checkpoint), escalate to SIGKILL after the grace window."""
-        grace = float(os.environ.get("TPUFLOW_KILL_GRACE_S", "5"))
+        grace = float(knobs.raw("TPUFLOW_KILL_GRACE_S", "5"))
         live = [i for i, rc in enumerate(rcs) if rc is None]
         for i in live:
             try:
@@ -1129,7 +1130,7 @@ def _jsonable(v):
 def env_force_cpu() -> str:
     """Gang subprocesses run on CPU when explicitly requested
     (TPUFLOW_FORCE_CPU=1) or when the parent itself runs on CPU."""
-    explicit = os.environ.get("TPUFLOW_FORCE_CPU")
+    explicit = knobs.raw("TPUFLOW_FORCE_CPU")
     if explicit is not None:
         return explicit
     import jax

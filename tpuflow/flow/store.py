@@ -30,11 +30,12 @@ import numpy as np
 
 from tpuflow.ckpt import Checkpoint
 from tpuflow.utils import FileLock
+from tpuflow.utils import knobs
 
 
 def home() -> str:
     return os.path.abspath(
-        os.environ.get("TPUFLOW_HOME", os.path.expanduser("~/.tpuflow"))
+        knobs.raw("TPUFLOW_HOME", os.path.expanduser("~/.tpuflow"))
     )
 
 
@@ -174,7 +175,7 @@ def save_artifacts(
     # launch attempt (TPUFLOW_ATTEMPT, stamped by the gang launcher) rides
     # along for diagnosis of which attempt produced the bytes.
     marker = {
-        "attempt": int(os.environ.get("TPUFLOW_ATTEMPT", "0") or 0),
+        "attempt": int(knobs.raw("TPUFLOW_ATTEMPT", "0") or 0),
         "ts": time.time(),
     }
     tmp = os.path.join(d, "artifacts.ok.tmp")

@@ -25,6 +25,7 @@ import numpy as np
 
 from tpuflow import dist, obs
 from tpuflow.ckpt import Checkpoint, restore_from_handle
+from tpuflow.utils import knobs
 
 
 class BatchPredictor:
@@ -382,7 +383,7 @@ class GenerationPredictor:
             and self.temperature == 0.0
             and not self.speculative
             and self.pad_to is None
-            and os.environ.get("TPUFLOW_SERVE", "1") != "0"
+            and knobs.raw("TPUFLOW_SERVE", "1") != "0"
         ):
             out = self._serve_batch(prompt, lens)
             if out is not None:

@@ -132,12 +132,16 @@ from tpuflow.infer.generate import (
     prompt_lens_to_pad_lens,
 )
 from tpuflow.infer.speculative import ngram_draft
+from tpuflow.utils import knobs
 
 
 def _env_int(name: str, default: int, *, minimum: int = 1) -> int:
     """Malformed env values fall to the default (the dispatch_depth
     idiom: a typo'd knob must not crash a server at start)."""
-    raw = os.environ.get(name)
+    # tpulint: disable=knob-dynamic -- name is forwarded verbatim from
+    # literal call sites, which the string-literal declaration rule
+    # still validates; knobs.raw refuses undeclared names at runtime.
+    raw = knobs.raw(name)
     if not raw:
         return default
     try:
@@ -162,7 +166,7 @@ def resolve_serve_quant(quant=None) -> str | None:
     from tpuflow.infer.quant import canonical_mode
 
     if quant is None:
-        raw = os.environ.get("TPUFLOW_SERVE_QUANT", "").strip().lower()
+        raw = knobs.raw("TPUFLOW_SERVE_QUANT", "").strip().lower()
         if raw in ("", "0", "false", "off"):
             return None
         if raw in ("1", "true", "on"):
@@ -183,7 +187,10 @@ def resolve_serve_quant(quant=None) -> str | None:
 
 
 def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
+    # tpulint: disable=knob-dynamic -- name is forwarded verbatim from
+    # literal call sites, which the string-literal declaration rule
+    # still validates; knobs.raw refuses undeclared names at runtime.
+    raw = knobs.raw(name)
     if raw is None or raw == "":
         return default
     return raw.strip().lower() not in ("0", "false", "off")
@@ -198,7 +205,7 @@ def resolve_page_size(n_ctx: int, page_size=None) -> int:
     explicit = page_size is not None
     from_env = False
     if page_size is None:
-        raw = os.environ.get("TPUFLOW_SERVE_PAGE_SIZE")
+        raw = knobs.raw("TPUFLOW_SERVE_PAGE_SIZE")
         if raw:
             try:
                 page_size = int(raw)
@@ -233,7 +240,7 @@ def resolve_spec_draft(speculative=None) -> int:
     (``TPUFLOW_SERVE_SPEC``) accepts the same spellings, malformed
     values falling to off with a warning."""
     if speculative is None:
-        raw = os.environ.get("TPUFLOW_SERVE_SPEC", "").strip().lower()
+        raw = knobs.raw("TPUFLOW_SERVE_SPEC", "").strip().lower()
         if raw in ("", "0", "false", "off"):
             return 0
         if raw in ("1", "true", "on"):
@@ -418,7 +425,7 @@ def resolve_buckets(n_ctx: int, buckets=None) -> list[int]:
     default ladder — validated, deduped, ascending, capped at the widest
     admittable width (``n_ctx - 1``)."""
     if buckets is None:
-        raw = os.environ.get("TPUFLOW_SERVE_BUCKETS")
+        raw = knobs.raw("TPUFLOW_SERVE_BUCKETS")
         if raw:
             try:
                 buckets = [int(x) for x in raw.split(",") if x.strip()]

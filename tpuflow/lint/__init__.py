@@ -1,0 +1,19 @@
+"""Shared AST-lint infrastructure for ``tools/tpulint.py``.
+
+Four passes ride one parsed-source cache (:class:`tpuflow.lint.core.Tree`):
+
+1. ``tpuflow.lint.knob_pass``   — the TPUFLOW_* knob registry contract.
+2. ``tpuflow.lint.jit_pass``    — jit-boundary audit (trace-time constant
+   reads, host syncs, donation discipline).
+3. ``tpuflow.lint.recompile_pass`` — the serving engine's never-recompile
+   contract, cross-checked statically.
+4. ``tpuflow.lint.obs_pass``    — the telemetry-name catalog lint
+   (formerly all of ``tools/obs_lint.py``; that file is now a shim).
+
+Each pass exposes ``run(tree, ...) -> list[Finding]`` and is
+parameterized over its inputs (registry, catalog, file paths) so the
+fixture tests in ``tests/test_tpulint.py`` can aim it at seeded-violation
+snippets instead of the real tree.
+"""
+
+from tpuflow.lint.core import Finding, Tree  # noqa: F401

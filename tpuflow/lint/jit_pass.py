@@ -1,0 +1,356 @@
+"""Pass 2 — jit-boundary audit.
+
+For every ``jax.jit`` site whose traced callable is resolvable in the
+same module (a named def, a bound method, a ``functools.partial`` over
+one, a lambda, or a decorated def), the traced BODY is audited for
+host-world leaks, and the call's donation contract is checked — the
+static form of the PR-4 donation audit comments.
+
+Rules (best-effort by design: the walk covers the direct body of the
+traced function, not its transitive callees — the seeded-fixture tests
+pin exactly what fires):
+
+- ``jit-env-read``  — ``os.environ`` / knob-accessor reads inside a
+  traced body: the value is baked into the compiled program at trace
+  time and silently ignored forever after (``decode_precision``-pinning
+  taught us these must live OUTSIDE the trace).
+- ``jit-time``      — ``time.*()`` calls inside a traced body: a
+  trace-time constant masquerading as a clock.
+- ``jit-host-rng``  — host RNG (``random.*`` / ``np.random.*``) inside
+  a traced body: baked entropy; use ``jax.random`` with a threaded key.
+- ``jit-host-sync`` — ``.tolist()`` / ``.item()`` /
+  ``block_until_ready`` / ``jax.device_get`` / ``float()`` / ``int()``
+  applied to a traced-function parameter inside the body: a host sync
+  (or a ConcretizationError at trace time) on what must remain a
+  device-side value.
+- ``jit-donate-nonstate`` — a donated argument whose parameter name
+  does not look like step/engine state (``state`` / ``cache`` /
+  ``params`` / ``carry`` / ``window`` / ``buf``): the PR-4 discipline
+  is that ONLY the state the step replaces is donated — batches and
+  resharders must stay undonated.
+- ``jit-donate-reuse`` — a call site of a known jitted program that
+  reads a donated operand again after the call without rebinding it:
+  the donated buffer is dead (``is_deleted()``) the moment the call
+  dispatches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpuflow.lint.core import Sink, Tree, dotted
+
+_STATE_RE = re.compile(r"(state|cache|carry|param|window|buf)", re.I)
+
+_TIME_FNS = {
+    "time", "monotonic", "perf_counter", "time_ns", "process_time",
+    "monotonic_ns", "perf_counter_ns",
+}
+_SYNC_ATTRS = {"tolist", "item", "block_until_ready"}
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    """node is `jax.jit` or bare `jit`."""
+    d = dotted(node)
+    return d in ("jax.jit", "jit")
+
+
+def _partial_of_jit(call: ast.Call):
+    """For `functools.partial(jax.jit, **kw)` returns the call, else
+    None."""
+    if (
+        isinstance(call, ast.Call)
+        and dotted(call.func) in ("functools.partial", "partial")
+        and call.args
+        and _is_jit_func(call.args[0])
+    ):
+        return call
+    return None
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    """Literal donate_argnums positions (IfExp takes the enabled
+    branch; unparseable forms -> empty)."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        value = kw.value
+        if isinstance(value, ast.IfExp):
+            value = value.body
+        if isinstance(value, ast.Tuple):
+            out = []
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, int
+                ):
+                    out.append(elt.value)
+            return tuple(out)
+        if isinstance(value, ast.Constant) and isinstance(
+            value.value, int
+        ):
+            return (value.value,)
+    return ()
+
+
+class _Module:
+    """Per-module def index + parent links."""
+
+    def __init__(self, mod: ast.Module):
+        self.mod = mod
+        self.defs: dict[str, ast.FunctionDef] = {}
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.defs.setdefault(node.name, node)
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            cur = self.parents.get(cur)
+        return cur
+
+    def resolve(self, node: ast.AST):
+        """(body_node, param_names, bound_positionals) for the traced
+        callable, or None. `self` is dropped for methods."""
+        bound = 0
+        while True:
+            if isinstance(node, ast.Lambda):
+                params = [a.arg for a in node.args.args]
+                return node, params[bound:], bound
+            if isinstance(node, ast.Call) and dotted(node.func) in (
+                "functools.partial", "partial"
+            ):
+                bound += len(node.args) - 1
+                node = node.args[0]
+                continue
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id == "self":
+                name = node.attr
+            if name is None:
+                return None
+            fn = self.defs.get(name)
+            if fn is None:
+                return None
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            if params and params[0] in ("self", "cls"):
+                params = params[1:]
+            return fn, params[bound:], bound
+
+
+def _audit_body(sink: Sink, rel: str, body: ast.AST, params: list[str]):
+    param_set = set(params)
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        if d.endswith("environ.get") or d.endswith("os.getenv") or (
+            d.startswith("knobs.")
+        ) or d == "getenv":
+            sink.emit(
+                rel, node.lineno, "jit-env-read",
+                f"{d}(...) inside a traced body is a trace-time "
+                "constant — resolve the knob outside the jit and pass "
+                "the value in",
+            )
+        elif d.startswith("time.") and d.split(".", 1)[1] in _TIME_FNS:
+            sink.emit(
+                rel, node.lineno, "jit-time",
+                f"{d}() inside a traced body bakes the trace-time "
+                "clock into the compiled program",
+            )
+        elif d.startswith(("random.", "np.random.", "numpy.random.")):
+            sink.emit(
+                rel, node.lineno, "jit-host-rng",
+                f"{d}() inside a traced body bakes host entropy at "
+                "trace time — use jax.random with a threaded key",
+            )
+        elif d in ("jax.device_get",):
+            sink.emit(
+                rel, node.lineno, "jit-host-sync",
+                f"{d}() inside a traced body forces a host sync",
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_ATTRS
+        ):
+            sink.emit(
+                rel, node.lineno, "jit-host-sync",
+                f".{node.func.attr}() inside a traced body forces a "
+                "host sync (or fails at trace time)",
+            )
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in param_set
+        ):
+            sink.emit(
+                rel, node.lineno, "jit-host-sync",
+                f"{node.func.id}() of traced parameter "
+                f"{node.args[0].id!r} concretizes a device value "
+                "inside the traced body",
+            )
+
+
+def _target_string(node: ast.AST) -> str | None:
+    """A stable key for the binding target / call head ('step',
+    'self._decode')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    return dotted(node)
+
+
+def _access_events(fn: ast.AST, key: str):
+    """(lineno, is_store) events for reads/writes of `key` inside fn."""
+    events = []
+    for node in ast.walk(fn):
+        k = None
+        if isinstance(node, ast.Name):
+            k = node.id
+        elif isinstance(node, ast.Attribute):
+            k = dotted(node)
+        if k != key:
+            continue
+        is_store = isinstance(
+            getattr(node, "ctx", None), (ast.Store, ast.Del)
+        )
+        events.append((node.lineno, is_store))
+    return events
+
+
+def run(tree: Tree):
+    sink = Sink(tree)
+    for rel in tree.files():
+        norm = rel.replace("\\", "/")
+        if norm.startswith("tests/"):
+            continue
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        index = _Module(mod)
+        # binding key -> donate positions (for the reuse rule)
+        bindings: dict[str, tuple[int, ...]] = {}
+
+        for node in ast.walk(mod):
+            # ---- decorated defs --------------------------------------
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    donate = ()
+                    is_jit = False
+                    if _is_jit_func(dec):
+                        is_jit = True
+                    elif isinstance(dec, ast.Call) and _is_jit_func(
+                        dec.func
+                    ):
+                        is_jit = True
+                        donate = _donate_positions(dec)
+                    elif isinstance(dec, ast.Call) and _partial_of_jit(
+                        dec
+                    ):
+                        is_jit = True
+                        donate = _donate_positions(dec)
+                    if not is_jit:
+                        continue
+                    params = [
+                        a.arg
+                        for a in node.args.posonlyargs + node.args.args
+                    ]
+                    if params and params[0] in ("self", "cls"):
+                        params = params[1:]
+                    _audit_body(sink, rel, node, params)
+                    for p in donate:
+                        if p < len(params) and not _STATE_RE.search(
+                            params[p]
+                        ):
+                            sink.emit(
+                                rel, node.lineno, "jit-donate-nonstate",
+                                f"donated arg {p} ({params[p]!r}) of "
+                                f"jitted {node.name!r} is not "
+                                "step/engine state — only the state "
+                                "the program replaces may be donated",
+                            )
+                    bindings[node.name] = donate
+            # ---- jit(...) call sites ---------------------------------
+            if not (
+                isinstance(node, ast.Call) and _is_jit_func(node.func)
+            ):
+                continue
+            if not node.args:
+                continue
+            donate = _donate_positions(node)
+            resolved = index.resolve(node.args[0])
+            if resolved is not None:
+                body, params, _bound = resolved
+                _audit_body(sink, rel, body, params)
+                for p in donate:
+                    if p < len(params) and not _STATE_RE.search(
+                        params[p]
+                    ):
+                        sink.emit(
+                            rel, node.lineno, "jit-donate-nonstate",
+                            f"donated arg {p} ({params[p]!r}) is not "
+                            "step/engine state — only the state the "
+                            "program replaces may be donated",
+                        )
+            # record the binding for reuse analysis
+            parent = index.parents.get(node)
+            if isinstance(parent, ast.Assign) and donate:
+                for target in parent.targets:
+                    key = _target_string(target)
+                    if key:
+                        bindings[key] = donate
+
+        # ---- donated-operand reuse at call sites --------------------
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.Call):
+                continue
+            key = _target_string(node.func)
+            donate = bindings.get(key or "")
+            if not donate:
+                continue
+            fn = index.enclosing_function(node)
+            if fn is None:
+                continue
+            # positions past a *unpack are not statically addressable
+            plain = len(node.args)
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred):
+                    plain = i
+                    break
+            end = getattr(node, "end_lineno", node.lineno)
+            for p in donate:
+                if p >= plain:
+                    continue
+                src = _target_string(node.args[p])
+                if src is None:
+                    continue
+                events = _access_events(fn, src)
+                stores = sorted(
+                    ln for ln, st in events if st and ln >= node.lineno
+                )
+                loads = sorted(
+                    ln for ln, st in events if not st and ln > end
+                )
+                for ln in loads:
+                    if not any(s <= ln for s in stores):
+                        sink.emit(
+                            rel, ln, "jit-donate-reuse",
+                            f"{src!r} was donated to {key!r} at line "
+                            f"{node.lineno} and is read again here "
+                            "without being rebound — the donated "
+                            "buffer is deleted at dispatch",
+                        )
+                        break
+    return sink.result()

@@ -1,0 +1,156 @@
+"""Pass 3 — the never-recompile contract, cross-checked statically.
+
+The serving engine's contract (PR 8, extended by 9 and 11) is that
+``compile_stats()`` never grows after ``warmup()``, and that
+``tools/prewarm_cache.py`` can land every program the scheduler replays
+in the persistent cache ahead of gang launch. Until now that contract
+lived only in runtime tests (``tests/test_serve.py`` pins the cache
+sizes) — a NEW jit program added to ``ServeEngine`` without a warmup
+execution, a ``compile_stats`` entry, and an ``aot_lower`` signature
+would pass review and fail in production as a stray recompile erasing
+the PR 8-11 throughput wins.
+
+This pass extracts the engine's jit program inventory statically (every
+``self.<attr> = jax.jit(...)`` in the engine class) and fails when a
+program is missing from any of the three coverage surfaces:
+
+- ``compile_stats``  (the runtime contract's observable),
+- ``warmup``         (the executed warm path),
+- ``aot_lower``      (the AOT signature list prewarm routes through),
+
+or when ``tools/prewarm_cache.py`` stops routing through
+``aot_lower()`` (the tool drifting from the engine-owned list is
+exactly the bug ISSUE 11 moved the list into the engine to kill).
+
+Rule: ``serve-aot-coverage``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpuflow.lint.core import Sink, Tree, dotted
+
+SERVE_REL = "tpuflow/infer/serve.py"
+PREWARM_REL = "tools/prewarm_cache.py"
+ENGINE_CLASS = "ServeEngine"
+COVERAGE_METHODS = ("compile_stats", "warmup", "aot_lower")
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted(node.func) in (
+        "jax.jit", "jit"
+    )
+
+
+def _self_attrs(node: ast.AST) -> set[str]:
+    out = set()
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+        ):
+            out.add(n.attr)
+    return out
+
+
+def run(
+    tree: Tree,
+    serve_rel: str = SERVE_REL,
+    prewarm_rel: str = PREWARM_REL,
+    engine_class: str = ENGINE_CLASS,
+    coverage_methods: tuple[str, ...] = COVERAGE_METHODS,
+):
+    sink = Sink(tree)
+    mod = tree.tree(serve_rel)
+    if mod is None:
+        sink.emit(
+            serve_rel, 1, "serve-aot-coverage",
+            "cannot parse the serving engine module",
+        )
+        return sink.result()
+
+    engine = None
+    for node in ast.walk(mod):
+        if isinstance(node, ast.ClassDef) and node.name == engine_class:
+            engine = node
+            break
+    if engine is None:
+        sink.emit(
+            serve_rel, 1, "serve-aot-coverage",
+            f"class {engine_class!r} not found — the never-recompile "
+            "cross-check has nothing to anchor to; update "
+            "tpuflow/lint/recompile_pass.py if the engine moved",
+        )
+        return sink.result()
+
+    # ---- the jit program inventory: self.<attr> = jax.jit(...) -------
+    programs: dict[str, int] = {}
+    for node in ast.walk(engine):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    programs.setdefault(target.attr, node.lineno)
+    if not programs:
+        sink.emit(
+            serve_rel, engine.lineno, "serve-aot-coverage",
+            f"{engine_class} declares no `self.<attr> = jax.jit(...)` "
+            "programs — the inventory extraction broke; fix the pass "
+            "before trusting it",
+        )
+
+    # ---- each program must appear in every coverage surface -----------
+    methods = {
+        n.name: n
+        for n in engine.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for meth in coverage_methods:
+        fn = methods.get(meth)
+        if fn is None:
+            sink.emit(
+                serve_rel, engine.lineno, "serve-aot-coverage",
+                f"{engine_class}.{meth}() is missing — it is one of the "
+                "three surfaces the never-recompile contract is checked "
+                "against",
+            )
+            continue
+        covered = _self_attrs(fn)
+        for attr, lineno in sorted(programs.items()):
+            if attr not in covered:
+                sink.emit(
+                    serve_rel, lineno, "serve-aot-coverage",
+                    f"jit program self.{attr} is not referenced by "
+                    f"{engine_class}.{meth}() — a program outside the "
+                    f"{meth} surface breaks the never-recompile "
+                    "contract (stray recompile / cold compile at "
+                    "serve time)",
+                )
+
+    # ---- prewarm must route through the engine-owned list -------------
+    pmod = tree.tree(prewarm_rel)
+    if pmod is None:
+        sink.emit(
+            prewarm_rel, 1, "serve-aot-coverage",
+            "cannot parse the prewarm tool",
+        )
+        return sink.result()
+    routes = any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "aot_lower"
+        for n in ast.walk(pmod)
+    )
+    if not routes:
+        sink.emit(
+            prewarm_rel, 1, "serve-aot-coverage",
+            f"does not call {engine_class}.aot_lower() — the tool has "
+            "drifted from the engine-owned AOT signature list and can "
+            "no longer guarantee prewarm coverage",
+        )
+    return sink.result()

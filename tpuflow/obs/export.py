@@ -29,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpuflow.obs import goodput as _goodput
 from tpuflow.obs import recorder as _rec
+from tpuflow.utils import knobs
 
 _SERVER: "MetricsServer | None" = None
 
@@ -145,7 +146,7 @@ def maybe_start_from_env(proc: int | None = None) -> MetricsServer | None:
     A bind failure disables export with a printed warning, never the run.
     """
     global _SERVER
-    raw = os.environ.get("TPUFLOW_OBS_HTTP_PORT")
+    raw = knobs.raw("TPUFLOW_OBS_HTTP_PORT")
     if not raw:
         return None
     if _SERVER is not None:
@@ -161,15 +162,15 @@ def maybe_start_from_env(proc: int | None = None) -> MetricsServer | None:
     if proc is None:
         try:
             proc = int(
-                os.environ.get("TPUFLOW_OBS_PROC")
-                or os.environ.get("TPUFLOW_PROCESS_ID")
+                knobs.raw("TPUFLOW_OBS_PROC")
+                or knobs.raw("TPUFLOW_PROCESS_ID")
                 or 0
             )
         except ValueError:
             proc = 0
     if proc != 0:
         return None  # one endpoint per gang: member 0 owns it
-    host = os.environ.get("TPUFLOW_OBS_HTTP_HOST", "127.0.0.1")
+    host = knobs.raw("TPUFLOW_OBS_HTTP_HOST", "127.0.0.1")
     try:
         _SERVER = MetricsServer(port, host=host)
     except OSError as e:

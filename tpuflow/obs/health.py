@@ -44,26 +44,7 @@ import statistics
 from typing import Any
 
 from tpuflow.obs import recorder as _rec
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        return default
+from tpuflow.utils import knobs
 
 
 @dataclasses.dataclass
@@ -115,17 +96,31 @@ class HealthConfig:
     @classmethod
     def from_env(cls) -> "HealthConfig":
         return cls(
-            enabled=os.environ.get("TPUFLOW_HEALTH", "1")
+            enabled=knobs.raw("TPUFLOW_HEALTH", "1")
             not in ("0", "false"),
-            rollback=os.environ.get("TPUFLOW_HEALTH_ROLLBACK", "1")
+            rollback=knobs.raw("TPUFLOW_HEALTH_ROLLBACK", "1")
             not in ("0", "false"),
-            nan_budget=max(1, _env_int("TPUFLOW_HEALTH_NAN_BUDGET", 1)),
-            window=max(4, _env_int("TPUFLOW_HEALTH_WINDOW", 64)),
-            warmup=max(2, _env_int("TPUFLOW_HEALTH_WARMUP", 16)),
-            spike_mads=_env_float("TPUFLOW_HEALTH_SPIKE_MADS", 12.0),
-            grad_norm_max=_env_float("TPUFLOW_HEALTH_GRAD_MAX", 0.0),
-            max_rollbacks=_env_int("TPUFLOW_HEALTH_MAX_ROLLBACKS", 2),
-            lr_backoff=_env_float("TPUFLOW_HEALTH_LR_BACKOFF", 1.0),
+            nan_budget=max(
+                1, knobs.get_int_lenient("TPUFLOW_HEALTH_NAN_BUDGET", 1)
+            ),
+            window=max(
+                4, knobs.get_int_lenient("TPUFLOW_HEALTH_WINDOW", 64)
+            ),
+            warmup=max(
+                2, knobs.get_int_lenient("TPUFLOW_HEALTH_WARMUP", 16)
+            ),
+            spike_mads=knobs.get_float_lenient(
+                "TPUFLOW_HEALTH_SPIKE_MADS", 12.0
+            ),
+            grad_norm_max=knobs.get_float_lenient(
+                "TPUFLOW_HEALTH_GRAD_MAX", 0.0
+            ),
+            max_rollbacks=knobs.get_int_lenient(
+                "TPUFLOW_HEALTH_MAX_ROLLBACKS", 2
+            ),
+            lr_backoff=knobs.get_float_lenient(
+                "TPUFLOW_HEALTH_LR_BACKOFF", 1.0
+            ),
         )
 
 
@@ -328,7 +323,7 @@ class ProfileWindow:
 
     @classmethod
     def from_env(cls, out_dir: str | None = None) -> "ProfileWindow | None":
-        spec = os.environ.get("TPUFLOW_PROFILE", "")
+        spec = knobs.raw("TPUFLOW_PROFILE", "")
         if not spec:
             return None
         try:
@@ -351,7 +346,7 @@ class ProfileWindow:
             if rec is not None:
                 out_dir = os.path.join(rec.directory, "profile")
             else:
-                out_dir = os.environ.get("TPUFLOW_PROFILE_DIR")
+                out_dir = knobs.raw("TPUFLOW_PROFILE_DIR")
         if not out_dir:
             print(
                 "[tpuflow] TPUFLOW_PROFILE set but telemetry is disabled "

@@ -45,6 +45,7 @@ import os
 import threading
 import time
 from typing import Any
+from tpuflow.utils import knobs
 
 _ENABLED = False
 _RECORDER: "Recorder | None" = None
@@ -134,7 +135,7 @@ class Recorder:
         if max_buffered is None:
             try:
                 max_buffered = int(
-                    os.environ.get("TPUFLOW_OBS_MAX_BUFFERED", "")
+                    knobs.raw("TPUFLOW_OBS_MAX_BUFFERED", "")
                     or _DEFAULT_MAX_BUFFERED
                 )
             except ValueError:
@@ -144,7 +145,7 @@ class Recorder:
         # Launch attempt (gang members only): stamped into every event so
         # the goodput ledger can stitch requeued attempts into one run.
         self.attempt: int | None = None
-        env_attempt = os.environ.get("TPUFLOW_ATTEMPT")
+        env_attempt = knobs.raw("TPUFLOW_ATTEMPT")
         if env_attempt:
             try:
                 self.attempt = int(env_attempt)
@@ -152,7 +153,7 @@ class Recorder:
                 pass
         try:
             ring = int(
-                os.environ.get("TPUFLOW_OBS_FLIGHT_RING", "")
+                knobs.raw("TPUFLOW_OBS_FLIGHT_RING", "")
                 or _DEFAULT_FLIGHT_RING
             )
         except ValueError:
@@ -303,8 +304,8 @@ def configure(
             return None
         if proc is None:
             proc = int(
-                os.environ.get("TPUFLOW_OBS_PROC")
-                or os.environ.get("TPUFLOW_PROCESS_ID")
+                knobs.raw("TPUFLOW_OBS_PROC")
+                or knobs.raw("TPUFLOW_PROCESS_ID")
                 or 0
             )
         _RECORDER = Recorder(directory, proc=proc)
@@ -322,7 +323,7 @@ def _maybe_init_from_env() -> None:
         if _ENV_CHECKED:
             return
         _ENV_CHECKED = True
-    d = os.environ.get("TPUFLOW_OBS_DIR")
+    d = knobs.raw("TPUFLOW_OBS_DIR")
     if d:
         configure(d)
 

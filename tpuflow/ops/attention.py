@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from tpuflow.utils import knobs
 
 
 def xla_attention(q, k, v, *, causal: bool = True):
@@ -64,7 +65,7 @@ def flash_tuning_path() -> str:
     "flash_min_seq_bwd": T_bwdonly}``."""
     import os
 
-    home = os.environ.get(
+    home = knobs.raw(
         "TPUFLOW_HOME", os.path.join(os.path.expanduser("~"), ".tpuflow")
     )
     return os.path.join(home, "flash_tuning.json")
@@ -113,7 +114,10 @@ def _flash_min_seq(*, needs_bwd: bool = True) -> int:
     env_name = (
         "TPUFLOW_FLASH_MIN_SEQ" if needs_bwd else "TPUFLOW_FLASH_MIN_SEQ_FWD"
     )
-    env = os.environ.get(env_name)
+    # tpulint: disable=knob-dynamic -- env_name is one of two literal
+    # TPUFLOW_FLASH_MIN_SEQ* names selected two lines up; both are
+    # declared and the string-literal rule validates them.
+    env = knobs.raw(env_name)
     if env is not None:
         try:
             return int(env)

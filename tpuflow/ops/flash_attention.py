@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from tpuflow.utils import knobs
 
 if not hasattr(pltpu, "CompilerParams"):
     # jax < 0.5 spells it TPUCompilerParams; alias so call sites stay on
@@ -672,14 +673,14 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
     # doubles attention's residual bytes — TPUFLOW_FLASH_LSE=compact
     # restores the small residual for memory-bound remat-off configs
     # (trading the two HBM passes back).
-    if os.environ.get("TPUFLOW_FLASH_LSE") == "compact":
+    if knobs.raw("TPUFLOW_FLASH_LSE") == "compact":
         return o, (q, k, v, o, lse[..., 0])
     return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
     q, k, v, o, lse = res
-    mode = os.environ.get("TPUFLOW_FLASH_BWD", "fused")
+    mode = knobs.raw("TPUFLOW_FLASH_BWD", "fused")
     if mode == "blockwise":
         # Fallback: recompute through the O(T)-memory blockwise path.
         _, vjp = jax.vjp(

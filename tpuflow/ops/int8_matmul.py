@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from tpuflow.utils import knobs
 
 if not hasattr(pltpu, "CompilerParams"):
     # jax < 0.5 spells it TPUCompilerParams (same alias as flash_attention).
@@ -120,7 +121,7 @@ def quantize_rows(x, scale_dtype=jnp.float32):
 
 
 def kernel_min_kn() -> int:
-    raw = os.environ.get("TPUFLOW_INT8_KERNEL_MIN_KN")
+    raw = knobs.raw("TPUFLOW_INT8_KERNEL_MIN_KN")
     if not raw:
         return _DEFAULT_KERNEL_MIN_KN
     try:
@@ -165,7 +166,7 @@ def resolve_int8_impl(
     baked into the compiled program per shape, like the flash
     thresholds."""
     env = (
-        os.environ.get("TPUFLOW_INT8_MATMUL", "auto").strip().lower()
+        knobs.raw("TPUFLOW_INT8_MATMUL", "auto").strip().lower()
         or "auto"
     )
     if env in ("xla", "pallas"):

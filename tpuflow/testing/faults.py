@@ -72,6 +72,7 @@ import errno as _errno
 import os
 import sys
 import time
+from tpuflow.utils import knobs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,7 +170,7 @@ def parse(raw: str) -> list[Fault]:
 
 def _specs() -> list[Fault]:
     global _CACHE
-    raw = os.environ.get("TPUFLOW_FAULT", "")
+    raw = knobs.raw("TPUFLOW_FAULT", "")
     if not raw:
         return []
     if _CACHE is None or _CACHE[0] != raw:
@@ -190,7 +191,7 @@ def active(kind: str) -> Fault | None:
 
 def _rank() -> int:
     try:
-        return int(os.environ.get("TPUFLOW_PROCESS_ID", "0"))
+        return int(knobs.raw("TPUFLOW_PROCESS_ID", "0"))
     except ValueError:
         return 0
 
@@ -198,7 +199,7 @@ def _rank() -> int:
 # ------------------------------------------------------------------ hooks
 def step_boundary(step: int) -> None:
     """Train-loop hook: called after step/report ``step`` committed."""
-    if not os.environ.get("TPUFLOW_FAULT"):
+    if not knobs.raw("TPUFLOW_FAULT"):
         return
     rank = _rank()
     for f in matching("preempt"):
@@ -247,7 +248,7 @@ def grad_poison(step: int) -> float | None:
     the median+MAD detector). Single-shot per spec: after a health
     rollback the replayed step runs clean, so detection → rollback →
     recovery is provable end to end."""
-    if not os.environ.get("TPUFLOW_FAULT"):
+    if not knobs.raw("TPUFLOW_FAULT"):
         return None
     rank = _rank()
     for kind, mult in _POISON.items():
@@ -304,7 +305,7 @@ def ckpt_io_fault(op: str, path: str) -> None:
     succeeds afterwards — deterministic, so tests can pin both "retries
     absorb the blip" (<n> ≤ retry budget) and "the save fails cleanly"
     (<n> > budget)."""
-    if not os.environ.get("TPUFLOW_FAULT"):
+    if not knobs.raw("TPUFLOW_FAULT"):
         return
     f = active("ckpt_io_flaky")
     if f is None:
@@ -325,7 +326,7 @@ def partial_commit() -> bool:
     """Commit hook: with ``ckpt_partial_commit`` active, return True ONCE
     — the manager then leaves the staged ``.tmp`` dir in place without a
     commit marker, emulating a writer killed between payload and commit."""
-    if not os.environ.get("TPUFLOW_FAULT"):
+    if not knobs.raw("TPUFLOW_FAULT"):
         return False
     if active("ckpt_partial_commit") is None or "ckpt_partial_commit" in _FIRED:
         return False
@@ -338,7 +339,7 @@ def maybe_upload_stall() -> None:
     """Upload hook: with ``upload_stall[:s]`` active, sleep inside the
     local→persistent copy — a slow shared filesystem the async saver must
     absorb without stalling training."""
-    if not os.environ.get("TPUFLOW_FAULT"):
+    if not knobs.raw("TPUFLOW_FAULT"):
         return
     f = active("upload_stall")
     if f is not None:
@@ -352,7 +353,7 @@ def corrupt_after_write(path: str) -> None:
     """Raw-saver hook: single-shot corruption of the first shard written
     after the spec activates (crc32 in the manifest was computed from the
     in-memory bytes, so restore-side verification must catch this)."""
-    if not os.environ.get("TPUFLOW_FAULT"):
+    if not knobs.raw("TPUFLOW_FAULT"):
         return
     for kind in ("ckpt_truncate", "ckpt_flip_byte"):
         if active(kind) is None or kind in _FIRED:

@@ -25,6 +25,7 @@ import os
 import time
 from typing import Any
 
+from tpuflow.utils import knobs
 from tpuflow.utils.preempt import (
     Preempted,
     emergency_save_advised,
@@ -181,7 +182,7 @@ class GptTrainConfig:
         # config edit — the MFU-push knob for remat-off training, where
         # the flash custom_vjp residuals (outputs + lse) are SAVED from
         # the forward instead of re-running every block's kernels.
-        env_sel = os.environ.get("TPUFLOW_REMAT_POLICY", "").strip()
+        env_sel = knobs.raw("TPUFLOW_REMAT_POLICY", "").strip()
         if env_sel:
             if env_sel not in ("full", "dots", "none"):
                 # Config-time failure, same contract as a bad
@@ -546,7 +547,7 @@ def _run_fsdp_generation(
         monitor = health_mod.HealthMonitor.from_env()
         profile = health_mod.ProfileWindow.from_env()
         lr_scale = 1.0
-        fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
+        fault_env = bool(knobs.raw("TPUFLOW_FAULT"))
         elastic = _membership.enabled()
 
         # Dispatch-ahead (ISSUE 4): up to `depth` steps run in flight;
@@ -1179,7 +1180,7 @@ def _train_pipeline(
         monitor = health_mod.HealthMonitor.from_env()
         profile = health_mod.ProfileWindow.from_env()
         lr_scale = 1.0
-        fault_env = bool(os.environ.get("TPUFLOW_FAULT"))
+        fault_env = bool(knobs.raw("TPUFLOW_FAULT"))
         from tpuflow.dist import membership as _membership
 
         elastic = _membership.enabled()

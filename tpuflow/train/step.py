@@ -47,6 +47,7 @@ from tpuflow.utils.preempt import (  # noqa: F401  (re-exported API)
 # supervisor announced a new mesh generation, handled by the generation
 # loops (train.gpt, Trainer.fit).
 from tpuflow.dist.membership import MeshReform  # noqa: F401  (re-export)
+from tpuflow.utils import knobs
 
 
 def dispatch_depth(default: int = 2) -> int:
@@ -73,7 +74,7 @@ def dispatch_depth(default: int = 2) -> int:
     host-side accounting; on accelerators the window IS the only
     per-step synchronization.
     """
-    env = os.environ.get("TPUFLOW_DISPATCH_DEPTH")
+    env = knobs.raw("TPUFLOW_DISPATCH_DEPTH")
     if env:
         try:
             return max(1, int(env))
@@ -312,7 +313,7 @@ def comm_overlap_enabled(default: bool = True) -> bool:
     changes programs where it can matter: ``make_train_step`` applies it
     when ``accum_steps > 1`` AND the caller passed ``grad_shardings``.
     """
-    return os.environ.get("TPUFLOW_COMM_OVERLAP", "1").lower() not in (
+    return knobs.raw("TPUFLOW_COMM_OVERLAP", "1").lower() not in (
         "0", "false", "off",
     )
 

@@ -33,6 +33,7 @@ import jax
 from tpuflow import dist, obs
 from tpuflow.ckpt import Checkpoint, CheckpointManager
 from tpuflow.utils.heartbeat import beat as _heartbeat
+from tpuflow.utils import knobs
 from tpuflow.utils.preempt import (
     Preempted,
     launch_attempt,
@@ -329,7 +330,7 @@ class TrainContext:
         # The stamp carries the step so a stall report names WHERE the
         # member stopped, not just how stale the stamp is.
         _heartbeat(save_step)
-        if os.environ.get("TPUFLOW_FAULT"):
+        if knobs.raw("TPUFLOW_FAULT"):
             from tpuflow.testing import faults
 
             faults.step_boundary(save_step)

@@ -20,10 +20,11 @@ protocol and then gone silent.
 from __future__ import annotations
 
 import os
+from tpuflow.utils import knobs
 
 
 def heartbeat_file() -> str | None:
-    return os.environ.get("TPUFLOW_HEARTBEAT_FILE") or None
+    return knobs.raw("TPUFLOW_HEARTBEAT_FILE") or None
 
 
 def beat(step: int | None = None) -> None:
@@ -47,7 +48,7 @@ def beat(step: int | None = None) -> None:
         os.utime(path, None)
     except (OSError, TypeError, ValueError):
         return
-    if os.environ.get("TPUFLOW_FAULT"):
+    if knobs.raw("TPUFLOW_FAULT"):
         from tpuflow.testing import faults
 
         # After the stamp, so a stalled member shows exactly one beat and

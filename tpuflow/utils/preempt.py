@@ -19,6 +19,8 @@ import signal
 import threading
 import time
 
+from tpuflow.utils import knobs
+
 # BSD EX_TEMPFAIL: "try again later". Distinct from every exit code a crash
 # produces (Python exceptions → 1, signals → 128+N / negative), so the gang
 # supervisor can classify a member's death as requeue-not-failure.
@@ -45,7 +47,7 @@ def launch_attempt() -> int:
     import os
 
     try:
-        return int(os.environ.get("TPUFLOW_ATTEMPT", "0") or 0)
+        return int(knobs.raw("TPUFLOW_ATTEMPT", "0") or 0)
     except ValueError:
         return 0
 
@@ -86,7 +88,7 @@ def grace_budget_s(default: float = 30.0) -> float:
     ``default``."""
     import os
 
-    env = os.environ.get("TPUFLOW_PREEMPT_GRACE_S")
+    env = knobs.raw("TPUFLOW_PREEMPT_GRACE_S")
     if env:
         try:
             return max(0.0, float(env))
@@ -117,7 +119,7 @@ def emergency_save_advised(threshold_default: float = 10.0) -> bool:
     remaining = grace_remaining_s()
     if remaining is None:
         return False
-    env = os.environ.get("TPUFLOW_PREEMPT_EMERGENCY_S")
+    env = knobs.raw("TPUFLOW_PREEMPT_EMERGENCY_S")
     threshold = threshold_default
     if env:
         try:
