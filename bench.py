@@ -621,6 +621,7 @@ def bench_serving(model, params, cfg, on_tpu: bool) -> dict:
     arrive = np.cumsum(gaps)
 
     def drive(engine):
+        engine.ledger.reset()  # ledger window = this timed drive only
         t0 = _time.monotonic()
         i, handles, occ = 0, [], []
         while i < R or engine.live_slots or engine.queue_depth:
@@ -633,10 +634,16 @@ def bench_serving(model, params, cfg, on_tpu: bool) -> dict:
             did = engine.step()
             occ.append(engine.live_slots / engine.max_slots)
             if not did and i < R:
-                _time.sleep(0.0005)
+                with engine.ledger.bucket("idle"):
+                    _time.sleep(0.0005)
         wall = _time.monotonic() - t0
         toks = sum(len(h.tokens) for h in handles)
         ttfts = sorted(h.ttft_s for h in handles)
+        # Ledger-derived replica shape (ISSUE 13): the decode/idle split
+        # and ITL p99 ROADMAP item 2's router reads as the calibrated
+        # per-replica reference.
+        led = engine.ledger.snapshot()
+        fr = led["fractions"]
         return {
             "tokens_per_s": round(toks / wall, 1),
             "wall_s": round(wall, 3),
@@ -645,6 +652,11 @@ def bench_serving(model, params, cfg, on_tpu: bool) -> dict:
                 ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 4
             ),
             "mean_slot_occupancy": round(float(np.mean(occ)), 3),
+            "decode_fraction": round(fr["decode"] + fr["verify"], 3),
+            "idle_fraction": round(fr["idle"], 3),
+            "itl_p99_s": (
+                round(led["itl_p99_s"], 5) if "itl_p99_s" in led else None
+            ),
         }
 
     def sequential():
@@ -777,6 +789,7 @@ def bench_serving_paged(model, params, cfg, on_tpu: bool) -> dict:
             for p in prompts
         ]
         res = []
+        engine.ledger.reset()  # ledger window = this saturated drive
         t0 = _time.monotonic()
         while engine.live_slots or engine.queue_depth:
             engine.step()
@@ -785,10 +798,17 @@ def bench_serving_paged(model, params, cfg, on_tpu: bool) -> dict:
                 res.append(r)
         wall = _time.monotonic() - t0
         toks = sum(len(h.tokens) for h in handles)
+        led = engine.ledger.snapshot()
+        fr = led["fractions"]
         return {
             "tokens_per_s": round(toks / wall, 1),
             "wall_s": round(wall, 3),
             "residency": round(float(np.mean(res)), 3) if res else None,
+            "decode_fraction": round(fr["decode"] + fr["verify"], 3),
+            "idle_fraction": round(fr["idle"], 3),
+            "itl_p99_s": (
+                round(led["itl_p99_s"], 5) if "itl_p99_s" in led else None
+            ),
         }, handles
 
     # Slot baseline: S contiguous rows = S * n_ctx resident tokens.
@@ -2292,11 +2312,19 @@ def _compact_summary(record: dict, train) -> dict:
         }
     serving = ev_train.get("serving", {})
     if isinstance(serving.get("vs_sequential"), (int, float)):
+        # The warm pass carries the ledger-derived replica shape
+        # (ISSUE 13): steady-state decode/idle fractions + latency
+        # p99s are what ROADMAP item 2's router calibrates against.
+        warm = serving.get("engine_warm", {})
         digest["serving"] = {
             "tokens_per_s": serving.get("engine", {}).get("tokens_per_s"),
             "vs_sequential": serving["vs_sequential"],
             "vs_sequential_warm": serving.get("vs_sequential_warm"),
             "ttft_p50_s": serving.get("engine", {}).get("ttft_p50_s"),
+            "ttft_p99_s": warm.get("ttft_p99_s"),
+            "itl_p99_s": warm.get("itl_p99_s"),
+            "decode_fraction": warm.get("decode_fraction"),
+            "idle_fraction": warm.get("idle_fraction"),
         }
     # Paged-KV serving verdicts (ISSUE 11): equal-HBM paged-vs-slot
     # tokens/s, residency efficiency, prefix-cache hit rate, and the
@@ -2313,6 +2341,11 @@ def _compact_summary(record: dict, train) -> dict:
             "prefix_hit_rate": paged.get("prefix_hit_rate"),
             "spec_accept": paged.get("spec", {}).get("accept_rate"),
             "spec_numerics_ok": paged.get("spec", {}).get("numerics_ok"),
+            "decode_fraction": paged.get("paged", {}).get(
+                "decode_fraction"
+            ),
+            "idle_fraction": paged.get("paged", {}).get("idle_fraction"),
+            "itl_p99_s": paged.get("paged", {}).get("itl_p99_s"),
         }
     int8 = ev_train.get("decode", {}).get("int8", {})
     for mode in ("weight_only", "fused_native", "weight", "mxu"):

@@ -251,6 +251,151 @@ def test_serve_ledger_feeds_metrics_export():
     assert "tpuflow_serve_spec_accept_rate 2.1" in text
 
 
+# ---------------------------------------- serving observatory (ISSUE 13)
+def test_lifecycle_trace_ledger_slo_and_access_log(
+    engine, model_params, tmp_path
+):
+    """The observatory through the SHARED warmed engine (zero fresh
+    compiles): a staggered multi-request run gives every request a
+    trace with exactly one terminal event, the engine-time ledger's
+    buckets sum to the measured serve wall within 5% (exact by cursor
+    construction), forced SLOs emit serve.slo_violation events + the
+    counter, the access log lands one line per terminal request, and
+    the serve-summary CLI reads it back — with compile_stats()
+    unchanged, tracing/SLO/access-log all armed (the acceptance's
+    never-recompile clause)."""
+    from tpuflow import obs
+    from tpuflow.obs.__main__ import main as obs_main
+    from tpuflow.obs.serve_ledger import load_access_log, summarize_access
+
+    model, params = model_params
+    run_dir = str(tmp_path / "run")
+    base = engine.compile_stats()
+    led0 = obs.goodput_live()
+    obs.configure(os.path.join(run_dir, "obs"), proc=0)
+    try:
+        engine.ledger.reset()
+        engine.ledger.slo_ttft_s = 1e-9  # everything violates: SLO path
+        engine.ledger.slo_itl_s = 1e-9
+        rng = np.random.default_rng(31)
+        prompts = [
+            rng.integers(0, 512, size=L).astype(np.int32)
+            for L in (3, 9, 5)
+        ]
+        # Staggered: two up front (fills both slots), the third joins
+        # mid-decode and must trace a queued/slots backpressure phase.
+        r1 = engine.submit(prompts[0], max_new_tokens=6)
+        r2 = engine.submit(prompts[1], max_new_tokens=6)
+        r3 = engine.submit(prompts[2], max_new_tokens=5)
+        engine.step()
+        engine.run_until_idle(max_iters=200)
+        reqs = [r1, r2, r3]
+        for p, r, n in zip(prompts, reqs, (6, 6, 5)):
+            np.testing.assert_array_equal(
+                r.result(), _solo(model, params, p, n)
+            )
+        # Exactly one terminal transition per submitted request.
+        for r in reqs:
+            phases = [t["phase"] for t in r.trace]
+            assert phases[0] == "submitted"
+            assert phases.count("complete") == 1
+            assert phases.count("drained") == 0
+            assert r.terminal_phase == "complete"
+            assert "admitted" in phases and "first_token" in phases
+            assert "tick" in phases
+            assert r.itl_s, "no per-tick ITL observations"
+            assert r.slo_violations >= 1  # forced TTFT SLO at least
+        assert any(
+            t["phase"] == "queued" and t["reason"] == "slots"
+            for t in r3.trace
+        ), r3.trace
+        # Ledger: buckets sum to the measured engine wall within 5%
+        # (cursor construction makes them equal; 5% is the acceptance
+        # slack), with real prefill/decode/insert charges.
+        snap = engine.ledger.snapshot()
+        assert sum(snap["buckets"].values()) == pytest.approx(
+            snap["wall_s"], rel=0.05
+        )
+        assert snap["buckets"]["prefill"] > 0
+        assert snap["buckets"]["decode"] > 0
+        assert snap["buckets"]["insert"] > 0
+        assert snap["decode_utilization"] is not None
+        assert snap["slo_violations"] >= 3
+        assert "fp.plain" in snap["ttft"] and "fp.plain" in snap["itl"]
+        # The live process ledger carries the observatory keys /metrics
+        # renders (fractions, ITL percentiles, SLO count).
+        ps = led0.snapshot()
+        for key in (
+            "serve_idle_fraction", "serve_decode_fraction",
+            "serve_prefill_fraction", "serve_itl_p99_s",
+            "serve_slo_violations",
+        ):
+            assert key in ps, key
+        # The event stream carries the trace + SLO evidence.
+        obs.flush()
+        events = []
+        d = os.path.join(run_dir, "obs")
+        for name in os.listdir(d):
+            if name.startswith("events."):
+                events.extend(obs.read_events(os.path.join(d, name)))
+        names = {(e["kind"], e["name"]) for e in events}
+        assert ("event", "serve.trace") in names
+        assert ("event", "serve.slo_violation") in names
+        assert ("counter", "serve.slo_violations") in names
+        assert ("gauge", "serve.idle_fraction") in names
+        assert ("gauge", "serve.decode_fraction") in names
+        assert ("gauge", "serve.prefill_fraction") in names
+        # Access log: one line per terminal request; serve-summary
+        # reproduces the percentile view from it alone.
+        records = load_access_log(run_dir)
+        assert len(records) == 3
+        assert {r["request"] for r in records} == {x.id for x in reqs}
+        s = summarize_access(records)
+        assert s["requests"] == 3 and s["ttft"]["count"] == 3
+        assert s["itl"]["count"] == sum(len(r.itl_s) for r in reqs)
+        assert obs_main(["serve-summary", run_dir]) == 0
+        # Never-recompile with the whole observatory armed.
+        assert engine.compile_stats() == base, "observatory recompiled"
+    finally:
+        engine.ledger.slo_ttft_s = None
+        engine.ledger.slo_itl_s = None
+        engine._access = None
+        obs.configure(None)
+
+
+def test_drain_queued_traces_terminal(engine):
+    """drain_queued (the SIGTERM drain path) terminal-traces every
+    still-queued request as drained — idempotently — while leaving the
+    queue intact for the requeue; a later resumed run may still
+    complete them (the trace then records the resumed completion)."""
+    r = engine.submit([1, 2, 3], max_new_tokens=3)
+    assert engine.drain_queued() == 1
+    assert r.terminal_phase == "drained" and not r.done
+    assert engine.queue_depth == 1  # queue preserved for the requeue
+    assert engine.drain_queued() == 0  # idempotent: one terminal only
+    assert sum(
+        1 for t in r.trace if t["phase"] == "drained"
+    ) == 1
+    # Leave the shared engine clean; the resumed engine completes it.
+    engine.run_until_idle(max_iters=100)
+    assert r.done and r.terminal_phase == "complete"
+
+
+def test_serve_trace_disarmed_is_one_bool_check(engine):
+    """TPUFLOW_SERVE_TRACE=0 semantics: with _trace_on False the trace
+    hook records nothing — no list growth, no events — and the engine
+    still serves exactly (the TPUFLOW_OBS=0 overhead twin lives in
+    tests/test_obs.py)."""
+    old = engine._trace_on
+    engine._trace_on = False
+    try:
+        r = engine.submit([5, 6, 7], max_new_tokens=3)
+        engine.run_until_idle(max_iters=100)
+        assert r.done and r.trace == [] and r.terminal_phase is None
+    finally:
+        engine._trace_on = old
+
+
 # ------------------------------------------------- engine decode contracts
 def test_unequal_requests_token_exact_and_never_recompile(
     engine, model_params
@@ -701,6 +846,14 @@ def test_serve_forever_heartbeats_and_preempt_drain(
             r1.result(), _solo(model, params, p1, 8)
         )
         assert not r2.done and eng.queue_depth == 1
+        # Queued-then-drained under SIGTERM (ISSUE 13): the queued
+        # request's trace reaches exactly one terminal event — drained
+        # — while the completed one's terminal is complete.
+        assert r1.terminal_phase == "complete"
+        assert r2.terminal_phase == "drained"
+        assert sum(
+            1 for t in r2.trace if t["phase"] in ("complete", "drained")
+        ) == 1
         assert hb.exists()  # at least one iteration stamped the heartbeat
     finally:
         preempt.clear_preemption()

@@ -216,13 +216,21 @@ def follow(url: str, interval: float, max_s: float) -> int:
             serving = ""
             if "serve_slot_occupancy" in st:
                 # A serving process (tpuflow.infer.serve feeds these):
-                # the operator's live queue/TTFT/throughput view.
+                # the operator's live queue/TTFT/throughput view, plus
+                # the engine-time ledger fractions and SLO count
+                # (ISSUE 13) — one line answers "is this replica
+                # earning its HBM".
                 serving = (
                     f" | serve q={st.get('serve_queue_depth', '-')} "
                     f"occ={fmt(st, 'serve_slot_occupancy', '{:.2f}')} "
                     f"tok/s={fmt(st, 'serve_tokens_per_s', '{:.0f}')} "
                     f"ttft50={fmt(st, 'serve_ttft_p50_s', '{:.3f}')}s "
                     f"p99={fmt(st, 'serve_ttft_p99_s', '{:.3f}')}s "
+                    f"itl99={fmt(st, 'serve_itl_p99_s', '{:.4f}')}s "
+                    f"idle={fmt(st, 'serve_idle_fraction', '{:.2f}')} "
+                    f"dec={fmt(st, 'serve_decode_fraction', '{:.2f}')} "
+                    f"pre={fmt(st, 'serve_prefill_fraction', '{:.2f}')} "
+                    f"slo={st.get('serve_slo_violations', '-')} "
                     f"done={st.get('serve_requests', '-')}"
                 )
             print(

@@ -196,6 +196,7 @@ _TIMELINE_COLORS = {
     "ckpt.": "#eb6834",
     "data.": "#2e9960",
     "infer.": "#9268d4",
+    "serve.": "#d08a3a",
     "device.": "#c2b33a",
 }
 
@@ -347,6 +348,53 @@ def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> N
                     headers=["attempt", "procs", "start", "dur", ""],
                 )
             )
+
+    # Serving observatory (ISSUE 13): a run that fed a ServeEngine gets
+    # its own section — load, latency, the engine-time ledger's last
+    # fractions, and SLO accounting — mirroring what /metrics and
+    # `python -m tpuflow.obs serve-summary` report.
+    counters = summary.get("counters", {})
+    gauges = summary.get("gauges", {})
+    if counters.get("serve.requests") or "serve.queue_depth" in gauges:
+        buf.append(Markdown("## Serving"))
+        rows = []
+        if counters.get("serve.requests"):
+            rows.append(
+                ["requests completed", f"{counters['serve.requests']:,.0f}"]
+            )
+        if counters.get("serve.tokens"):
+            rows.append(
+                ["tokens served", f"{counters['serve.tokens']:,.0f}"]
+            )
+        if counters.get("serve.slo_violations"):
+            rows.append(
+                [
+                    "SLO violations",
+                    f"{counters['serve.slo_violations']:,.0f}",
+                ]
+            )
+        for name, label, spec in (
+            ("serve.queue_depth", "queue depth (last/max)", "{:.0f}"),
+            ("serve.slot_occupancy", "slot occupancy (last/max)", "{:.2f}"),
+            ("serve.ttft_s", "TTFT s (last/max)", "{:.4f}"),
+            ("serve.idle_fraction", "ledger: idle fraction", "{:.3f}"),
+            ("serve.decode_fraction", "ledger: decode fraction", "{:.3f}"),
+            ("serve.prefill_fraction", "ledger: prefill fraction",
+             "{:.3f}"),
+            ("serve.decode_utilization", "decode utilization", "{:.3f}"),
+            ("serve.masked_row_waste", "masked-row waste", "{:.3f}"),
+            ("serve.spec_accept_rate", "spec accept rate", "{:.3f}"),
+            ("serve.pages_free", "pages free (last)", "{:.0f}"),
+        ):
+            g = gauges.get(name)
+            if not g:
+                continue
+            val = spec.format(g.get("last", 0.0))
+            if "last/max" in label:
+                val += f" / {spec.format(g.get('max', 0.0))}"
+            rows.append([label, val])
+        if rows:
+            buf.append(Table(rows, headers=["serving metric", "value"]))
 
     spans = [
         e for e in events if e.get("kind") == "span" and e.get("dur_s", 0) > 0

@@ -328,6 +328,8 @@ def serving_deployment(
     max_slots: int | None = None,
     prefill_chunk: int | None = None,
     buckets: list[int] | None = None,
+    slo_ttft_ms: float | None = None,
+    slo_itl_ms: float | None = None,
     drain_grace_s: int = 120,
     env: dict[str, str] | None = None,
 ) -> dict:
@@ -370,6 +372,24 @@ def serving_deployment(
             {
                 "name": "TPUFLOW_SERVE_BUCKETS",
                 "value": ",".join(str(int(b)) for b in buckets),
+            }
+        )
+    # Declared latency SLOs (ISSUE 13): the engine emits
+    # serve.slo_violation events + the violation counter the moment a
+    # replica misses them — declared beside the hardware, like the
+    # engine-shape knobs above.
+    if slo_ttft_ms is not None:
+        penv.append(
+            {
+                "name": "TPUFLOW_SERVE_SLO_TTFT_MS",
+                "value": str(float(slo_ttft_ms)),
+            }
+        )
+    if slo_itl_ms is not None:
+        penv.append(
+            {
+                "name": "TPUFLOW_SERVE_SLO_ITL_MS",
+                "value": str(float(slo_itl_ms)),
             }
         )
     for k, v in sorted((env or {}).items()):

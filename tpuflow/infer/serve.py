@@ -103,13 +103,44 @@ regression reference, kept one release), ``TPUFLOW_SERVE_PAGE_SIZE``
 ``TPUFLOW_SERVE_PREFIX_CACHE`` (=0 disables shared-prefix reuse),
 ``TPUFLOW_SERVE_SPEC`` (=K arms per-request speculative decode),
 ``TPUFLOW_SERVE`` (=0 keeps ``GenerationPredictor`` on the legacy
-per-batch path).
+per-batch path), ``TPUFLOW_SERVE_TRACE`` (=0 disarms per-request
+lifecycle traces), ``TPUFLOW_SERVE_ACCESS_LOG`` (=0 disarms the
+per-request JSONL access log), ``TPUFLOW_SERVE_SLO_TTFT_MS`` /
+``TPUFLOW_SERVE_SLO_ITL_MS`` (declared latency SLOs; violations emit
+events and a counter).
 
 Telemetry (``serve.*``, catalog-enforced): queue depth, slot occupancy,
 per-request TTFT and decode tokens/s, admission/completion events,
 prefill/decode spans — riding ``tpuflow.obs`` and the live ``/metrics``
 exporter (``tpuflow.obs.export``), watchable via
 ``tools/tpu_watch.py --follow``.
+
+**Serving observatory (ISSUE 13).** Three host-side layers mirror the
+training run observatory; none adds a jitted operand, so
+``compile_stats()`` is unchanged after warmup with everything armed:
+
+- **Per-request lifecycle traces.** Every ``ServeRequest`` carries a
+  trace of its transitions — submitted, queued (with the backpressure
+  reason: ``slots`` or ``pages``), admitted (bucket, pages, shared
+  prefix pages), first_token (TTFT), every decode/verify tick it
+  participated in (tokens committed, drafts accepted), and exactly one
+  terminal (``complete`` with the finish reason, or ``drained`` on the
+  SIGTERM path) — mirrored as ``serve.trace`` events and, at the
+  terminal, as one line in the ``obs/access.p*.jsonl`` access log that
+  ``python -m tpuflow.obs serve-summary <run_dir>`` reads (no jax
+  import, works mid-run).
+- **Engine-time ledger** (``tpuflow.obs.serve_ledger.ServeLedger``,
+  at ``engine.ledger``): every second of serve wall charges to exactly
+  one bucket — prefill / decode / verify / insert / host_sched / idle —
+  by cursor construction, plus occupancy-weighted decode utilization,
+  masked-row waste from the (fp,int8)x(spec,plain) group partition,
+  and speculative drafted-vs-accepted economics.
+- **SLO accounting.** ``TPUFLOW_SERVE_SLO_TTFT_MS`` /
+  ``TPUFLOW_SERVE_SLO_ITL_MS`` declare latency SLOs; a violating
+  request emits ``serve.slo_violation`` and bumps the
+  ``serve.slo_violations`` counter, and TTFT/ITL percentiles (split by
+  numeric path and spec/plain group) ride ``/metrics``, ``/status``,
+  and ``tpu_watch --follow``.
 """
 
 from __future__ import annotations
@@ -126,6 +157,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpuflow import obs
+from tpuflow.obs import serve_ledger as _ledger
 from tpuflow.infer.generate import (
     chunked_prefill,
     normalize_prefill_chunk,
@@ -464,10 +496,36 @@ class ServeRequest:
     tokens: list[int] = dataclasses.field(default_factory=list)
     state: str = "queued"  # queued | running | done
     finish_reason: str | None = None
+    # Serving observatory (ISSUE 13): the request's lifecycle trace
+    # (phase dicts, mirrored as serve.trace events when tracing is
+    # armed), its per-tick ITL observations (tick wall / tokens
+    # committed — what the SLO gate and the access log read), the last
+    # backpressure reason while queued, and its SLO violation count.
+    trace: list[dict] = dataclasses.field(default_factory=list)
+    itl_s: list[float] = dataclasses.field(default_factory=list)
+    queue_reason: str | None = None
+    slo_violations: int = 0
+    drained: bool = False
+    t_last_tick: float | None = None
 
     @property
     def done(self) -> bool:
         return self.state == "done"
+
+    @property
+    def group(self) -> str:
+        """Traffic-group label: (fp|int8).(plain|spec) — the scheduler's
+        decode-block partition, the split the SLO histograms report by."""
+        return _ledger.group_key(self.quantize, self.speculative)
+
+    @property
+    def terminal_phase(self) -> str | None:
+        """The trace's terminal phase (complete | drained), or None while
+        the request is still in flight (or tracing is disarmed)."""
+        for t in reversed(self.trace):
+            if t.get("phase") in ("complete", "drained"):
+                return t["phase"]
+        return None
 
     @property
     def ttft_s(self) -> float | None:
@@ -583,6 +641,18 @@ class ServeEngine:
                 f"decode_block must be >= 1, got {self.decode_block}"
             )
         self.pad_id = int(pad_id)
+        # Serving observatory (ISSUE 13): lifecycle tracing, the
+        # engine-time ledger (buckets sum to serve wall by
+        # construction), declared SLOs, and the per-request access log.
+        # All host-side — no jitted program gains an operand, so
+        # compile_stats() is identical with everything armed.
+        self._trace_on = _env_flag("TPUFLOW_SERVE_TRACE", True)
+        self._access_on = _env_flag("TPUFLOW_SERVE_ACCESS_LOG", True)
+        self._access: _ledger.AccessLog | None = None
+        self.ledger = _ledger.ServeLedger(
+            slo_ttft_s=_ledger.resolve_slo_s("TPUFLOW_SERVE_SLO_TTFT_MS"),
+            slo_itl_s=_ledger.resolve_slo_s("TPUFLOW_SERVE_SLO_ITL_MS"),
+        )
 
         S = self.max_slots
         # Paged KV (ISSUE 11): the pool geometry + the per-slot page
@@ -1012,6 +1082,10 @@ class ServeEngine:
             )
         self._next_id += 1
         self._queue.append(req)
+        self._trace(
+            req, "submitted", prompt_len=int(prompt.size),
+            max_new=req.max_new_tokens, bucket=bucket, group=req.group,
+        )
         return req
 
     @property
@@ -1061,6 +1135,91 @@ class ServeEngine:
             return None
         return resident / allocated
 
+    # ------------------------------------------- lifecycle traces (ISSUE 13)
+    def _trace(self, req: ServeRequest, phase: str, **attrs) -> None:
+        """One lifecycle transition: appended to the request's host-side
+        trace and mirrored as a serve.trace event. One bool check when
+        disarmed (TPUFLOW_SERVE_TRACE=0) — pinned by the overhead test."""
+        if not self._trace_on:
+            return
+        req.trace.append({"phase": phase, "t": time.monotonic(), **attrs})
+        obs.event("serve.trace", request=req.id, phase=phase, **attrs)
+
+    def _note_queued(self, req: ServeRequest, reason: str) -> None:
+        """Backpressure evidence: trace the queued phase once per reason
+        change (a request waiting 10k iterations on a full pool must not
+        write 10k events)."""
+        if req.queue_reason != reason:
+            req.queue_reason = reason
+            self._trace(req, "queued", reason=reason)
+
+    def _slo_violation(
+        self, req: ServeRequest, kind: str, value: float, limit_s: float
+    ) -> None:
+        req.slo_violations += 1
+        obs.event(
+            "serve.slo_violation", request=req.id, slo=kind,
+            value=round(value, 6), limit_s=limit_s, group=req.group,
+        )
+        obs.counter("serve.slo_violations", 1)
+
+    def _access_write(self, req: ServeRequest, terminal: str) -> None:
+        """One access-log line at the request's terminal transition
+        (complete or drained). Lazy: the writer opens beside the event
+        fragments the first time a recorder-enabled process finishes a
+        request — no obs dir, no file."""
+        if not self._access_on:
+            return
+        if self._access is None:
+            rec = obs.recorder()
+            if rec is None:
+                return
+            self._access = _ledger.AccessLog(rec.directory, proc=rec.proc)
+        ttft = req.ttft_s
+        rate = req.decode_tokens_per_s
+        self._access.write(
+            {
+                "request": req.id,
+                "ts": req.t_submit,
+                "group": req.group,
+                "quant": req.quantize,
+                "spec": req.speculative,
+                "prompt_len": int(req.prompt.size),
+                "max_new_tokens": req.max_new_tokens,
+                "bucket": req.bucket,
+                "tokens": len(req.tokens),
+                "terminal": terminal,
+                "finish_reason": req.finish_reason or terminal,
+                "queue_wait_s": (
+                    None if req.t_admit is None
+                    else round(req.t_admit - req.t_submit, 6)
+                ),
+                "ttft_s": None if ttft is None else round(ttft, 6),
+                "itl_s": [round(v, 6) for v in req.itl_s],
+                "decode_tokens_per_s": (
+                    None if rate is None else round(rate, 2)
+                ),
+                "slo_violations": req.slo_violations,
+                "trace": req.trace,
+            }
+        )
+
+    def drain_queued(self) -> int:
+        """Terminal-trace every still-queued request as ``drained`` (the
+        SIGTERM drain path: the process is exiting; queued work rides
+        the requeue). The queue itself is untouched — a resumed engine
+        can still admit them — but every submitted request's trace now
+        reaches exactly one terminal event. Returns the count."""
+        n = 0
+        for req in self._queue:
+            if req.drained:
+                continue
+            req.drained = True
+            self._trace(req, "drained", reason="preempt_drain")
+            self._access_write(req, "drained")
+            n += 1
+        return n
+
     def _free_slot(self) -> int | None:
         for s, req in enumerate(self._slots):
             if req is None:
@@ -1077,6 +1236,7 @@ class ServeEngine:
         if self.paged:
             got = self.pool.acquire(req.prompt, self._pages_needed(req))
             if got is None:
+                self._note_queued(req, "pages")
                 return False
             page_ids, matched = got
         now = time.monotonic()
@@ -1089,7 +1249,7 @@ class ServeEngine:
         chunk = normalize_prefill_chunk(self.prefill_chunk, W)
         prefill = self._prefill_q if req.quantize else self._prefill
         prm = self._qparams if req.quantize else self.params
-        with obs.span(
+        with self.ledger.bucket("prefill"), obs.span(
             "serve.prefill", request=req.id, bucket=W, prompt_len=int(L),
             chunk=chunk, quant=bool(req.quantize),
         ):
@@ -1098,6 +1258,7 @@ class ServeEngine:
             )
             first = int(np.asarray(tok0)[0])
         req.t_first = time.monotonic()
+        req.t_last_tick = req.t_first
         req.tokens.append(first)
         req.state = "running"
         obs.event(
@@ -1107,7 +1268,19 @@ class ServeEngine:
             pages=0 if page_ids is None else len(page_ids),
             shared_pages=matched,
         )
+        self._trace(
+            req, "admitted", slot=slot, bucket=W,
+            queue_wait_s=round(now - req.t_submit, 6),
+            pages=0 if page_ids is None else len(page_ids),
+            shared_pages=matched,
+        )
         obs.gauge("serve.ttft_s", round(req.ttft_s, 6))
+        self._trace(req, "first_token", ttft_s=round(req.ttft_s, 6))
+        self.ledger.note_ttft(req.group, req.ttft_s)
+        if self.ledger.check_ttft(req.ttft_s):
+            self._slo_violation(
+                req, "ttft", req.ttft_s, self.ledger.slo_ttft_s
+            )
         led = obs.goodput_live()
         led.note_serve_ttft(req.ttft_s)
         done = (req.eos_id is not None and first == req.eos_id) or (
@@ -1130,18 +1303,20 @@ class ServeEngine:
             table_row[: len(page_ids)] = page_ids
             write_mask = np.zeros((self.pages_per_slot,), bool)
             write_mask[matched: len(page_ids)] = True
-            self._cache = self._insert(
-                self._cache, row_cache, jnp.asarray(table_row),
-                jnp.int32(W - L), jnp.asarray(write_mask),
-            )
+            with self.ledger.bucket("insert"):
+                self._cache = self._insert(
+                    self._cache, row_cache, jnp.asarray(table_row),
+                    jnp.int32(W - L), jnp.asarray(write_mask),
+                )
             self._page_table[slot] = table_row
             self._slot_pages[slot] = list(page_ids)
             self._lengths[slot] = L
             self._pads[slot] = 0
         else:
-            self._cache = self._insert(
-                self._cache, row_cache, np.int32(slot)
-            )
+            with self.ledger.bucket("insert"):
+                self._cache = self._insert(
+                    self._cache, row_cache, np.int32(slot)
+                )
             self._lengths[slot] = W
             self._pads[slot] = W - L
         self._slots[slot] = req
@@ -1169,6 +1344,11 @@ class ServeEngine:
             obs.counter("serve.quant_requests", 1)
         if rate is not None:
             obs.gauge("serve.tokens_per_s", round(rate, 2))
+        self._trace(
+            req, "complete", reason=reason, tokens=len(req.tokens),
+            slo_violations=req.slo_violations,
+        )
+        self._access_write(req, "complete")
         obs.goodput_live().note_serve_complete()
 
     def _emit_state_gauges(self) -> None:
@@ -1182,6 +1362,7 @@ class ServeEngine:
             None if pool is None else pool.free_pages,
             None if pool is None else pool.prefix_hits,
         )
+        fr = self.ledger.fractions()
         if state != self._last_gauges or self._iters % 64 == 0:
             self._last_gauges = state
             obs.gauge("serve.queue_depth", state[0])
@@ -1192,8 +1373,37 @@ class ServeEngine:
             if pool is not None:
                 obs.gauge("serve.pages_free", state[2])
                 obs.gauge("serve.prefix_hits", state[3])
+            # Engine-time ledger fractions (ISSUE 13): the idle /
+            # decode / prefill split one babysitter line reads, plus
+            # the token-efficiency gauges, sampled on the same
+            # change/periodic cadence as the load gauges. verify and
+            # decode merge into one "earning tokens" fraction.
+            obs.gauge("serve.idle_fraction", round(fr["idle"], 4))
+            obs.gauge(
+                "serve.decode_fraction",
+                round(fr["decode"] + fr["verify"], 4),
+            )
+            obs.gauge("serve.prefill_fraction", round(fr["prefill"], 4))
+            util = self.ledger.decode_utilization
+            if util is not None:
+                obs.gauge("serve.decode_utilization", round(util, 4))
+            waste = self.ledger.masked_row_waste
+            if waste is not None:
+                obs.gauge("serve.masked_row_waste", round(waste, 4))
         led = obs.goodput_live()
         led.note_serve_state(state[0], state[1], self.max_slots)
+        led.note_serve_ledger(
+            {
+                "idle": fr["idle"],
+                "decode": fr["decode"] + fr["verify"],
+                "prefill": fr["prefill"],
+                "insert": fr["insert"],
+                "host_sched": fr["host_sched"],
+            },
+            utilization=self.ledger.decode_utilization,
+            masked_waste=self.ledger.masked_row_waste,
+            slo_violations=self.ledger.slo_violations,
+        )
         if pool is not None:
             led.note_serve_pages(pool.free_pages, pool.usable_pages)
             led.note_serve_prefix(pool.prefix_hits, pool.prefix_lookups)
@@ -1223,6 +1433,8 @@ class ServeEngine:
             return 0
         prm = self._qparams if quant else self.params
         old_remaining = self._remaining.copy()
+        group_live = int(mask.sum())
+        total_live = int(self._live.sum())
         # Two literal span calls (not one with a computed name): the
         # obs_lint drift guard only sees literal emitter names.
         span = (
@@ -1230,7 +1442,10 @@ class ServeEngine:
             if quant
             else obs.span("serve.decode", slots=int(mask.sum()), spec=spec)
         )
-        with span as sp:
+        # The whole block — host drafts, device dispatch, the fence, the
+        # state merge — charges to the decode (or verify) ledger bucket;
+        # everything between blocks lands in host_sched by construction.
+        with self.ledger.bucket("verify" if spec else "decode"), span as sp:
             if spec:
                 # Host-side prompt-lookup drafts per slot (a wrong draft
                 # only costs speed; the verify forward arbitrates).
@@ -1283,6 +1498,11 @@ class ServeEngine:
             self._live = np.where(mask, np.array(live), self._live)
             emitted = int((old_remaining - self._remaining).sum())
             sp.set(tokens=emitted)
+            self.ledger.note_decode_block(
+                self.max_slots, group_live, total_live, spec=spec,
+                drafted=group_live * self.spec_draft if spec else 0,
+                committed=emitted,
+            )
             if spec:
                 self._spec_committed += emitted
                 self._spec_forwards += int(mask.sum())
@@ -1291,12 +1511,39 @@ class ServeEngine:
                 obs.goodput_live().note_serve_spec(
                     self._spec_committed, self._spec_forwards
                 )
+        now = time.monotonic()
+        led = obs.goodput_live()
         for s, req in enumerate(self._slots):
             if req is None or not mask[s]:
                 continue
             n = int(old_remaining[s] - self._remaining[s])
             if n:
                 req.tokens.extend(int(t) for t in toks[s, :n])
+                # One ITL observation per tick (tick wall / tokens
+                # committed): the per-token latency the SLO gate,
+                # /metrics percentiles, and the access log all share.
+                anchor = (
+                    req.t_last_tick
+                    if req.t_last_tick is not None else req.t_first
+                )
+                itl = None
+                if anchor is not None:
+                    itl = max(now - anchor, 0.0) / n
+                    req.itl_s.append(itl)
+                    self.ledger.note_itl(req.group, itl)
+                    led.note_serve_itl(itl)
+                req.t_last_tick = now
+                if spec:
+                    self._trace(
+                        req, "tick", tokens=n, spec=True,
+                        drafted=self.spec_draft, accepted=n - 1,
+                    )
+                else:
+                    self._trace(req, "tick", tokens=n, spec=False)
+                if itl is not None and self.ledger.check_itl(itl):
+                    self._slo_violation(
+                        req, "itl", itl, self.ledger.slo_itl_s
+                    )
             if not self._live[s]:
                 last = req.tokens[-1] if req.tokens else None
                 if req.eos_id is not None and last == req.eos_id:
@@ -1334,6 +1581,7 @@ class ServeEngine:
         while admit and self._queue:
             slot = self._free_slot()
             if slot is None:
+                self._note_queued(self._queue[0], "slots")
                 break
             if not self._admit_one(self._queue[0], slot):
                 break  # page backpressure: stays queued, never dropped
@@ -1600,6 +1848,10 @@ def serve_forever(
         did = engine.step(admit=not draining)
         heartbeat.beat(step=engine._iters)
         if draining and not engine._live.any():
+            # Queued requests ride the requeue; their traces reach the
+            # drained terminal so no submitted request vanishes from
+            # the access log (ISSUE 13).
+            engine.drain_queued()
             return
         if should_stop is not None and should_stop():
             return
@@ -1607,5 +1859,7 @@ def serve_forever(
             return
         if not did:
             if draining:
+                engine.drain_queued()
                 return
-            time.sleep(idle_sleep_s)
+            with engine.ledger.bucket("idle"):
+                time.sleep(idle_sleep_s)

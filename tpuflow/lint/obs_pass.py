@@ -105,6 +105,16 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("event", "serve.page_evict"),
     ("span", "serve.quant_decode"),
     ("counter", "serve.quant_requests"),
+    # Serving observatory (ISSUE 13): lifecycle traces, engine-time
+    # ledger fractions, and declared-SLO accounting.
+    ("event", "serve.trace"),
+    ("event", "serve.slo_violation"),
+    ("counter", "serve.slo_violations"),
+    ("gauge", "serve.idle_fraction"),
+    ("gauge", "serve.decode_fraction"),
+    ("gauge", "serve.prefill_fraction"),
+    ("gauge", "serve.decode_utilization"),
+    ("gauge", "serve.masked_row_waste"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
     ("event", "ops.flash_bwd_fused"),

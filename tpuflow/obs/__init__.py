@@ -42,6 +42,14 @@ from tpuflow.obs.health import (
     TrainingDiverged,
     health_summary,
 )
+from tpuflow.obs.serve_ledger import (
+    GROUPS as SERVE_GROUPS,
+    SERVE_BUCKETS,
+    AccessLog,
+    ServeLedger,
+    load_access_log,
+    summarize_access,
+)
 from tpuflow.obs.recorder import (
     Recorder,
     configure,
@@ -64,6 +72,7 @@ from tpuflow.obs.timeline import (
 )
 
 __all__ = [
+    "AccessLog",
     "Anomaly",
     "CATALOG",
     "GOODPUT_BUCKETS",
@@ -73,6 +82,9 @@ __all__ = [
     "ProcessLedger",
     "ProfileWindow",
     "Recorder",
+    "SERVE_BUCKETS",
+    "SERVE_GROUPS",
+    "ServeLedger",
     "TrainingDiverged",
     "compute_goodput",
     "configure",
@@ -88,6 +100,7 @@ __all__ = [
     "histogram",
     "is_registered",
     "kind_of",
+    "load_access_log",
     "load_run_events",
     "maybe_start_export",
     "merge_run_events",
@@ -96,5 +109,6 @@ __all__ = [
     "recorder",
     "span",
     "summarize",
+    "summarize_access",
     "timed_iter",
 ]

@@ -1,10 +1,18 @@
-"""Operator CLI: ``python -m tpuflow.obs summarize <run_dir> [--json]``.
+"""Operator CLI: ``python -m tpuflow.obs <command> <run_dir> [--json]``.
 
-Reads a run directory's merged telemetry (the committed ``events.jsonl``,
-or the per-process fragments of a still-running/crashed run) and prints
-the headline metrics plus the goodput ledger — no client API, no jax
-import, safe to point at a live run from a login shell. ``--json`` dumps
-the full ``obs.summarize`` structure for CI and scripts.
+Two commands, both jax-free and safe against a LIVE run from a login
+shell:
+
+- ``summarize`` — the run's merged telemetry (the committed
+  ``events.jsonl``, or the per-process fragments of a still-running/
+  crashed run): headline metrics plus the goodput ledger.
+- ``serve-summary`` — the serving observatory (ISSUE 13): TTFT/ITL
+  percentiles split by traffic group, finish reasons, and SLO
+  violations reproduced from the per-request ACCESS LOG alone (the same
+  ``pctl`` math the live /metrics exporter uses), plus the engine-time
+  ledger fractions when the event stream carries them.
+
+``--json`` dumps the full structure for CI and scripts.
 """
 
 from __future__ import annotations
@@ -13,24 +21,26 @@ import json
 import sys
 
 from tpuflow.obs.goodput import BUCKETS
+from tpuflow.obs.serve_ledger import (
+    SERVE_BUCKETS,
+    load_access_log,
+    summarize_access,
+)
 from tpuflow.obs.timeline import load_run_events, summarize
 
-_USAGE = "usage: python -m tpuflow.obs summarize <run_dir> [--json]"
+_USAGE = (
+    "usage: python -m tpuflow.obs {summarize|serve-summary} "
+    "<run_dir> [--json]"
+)
 
 
-def main(argv: list[str]) -> int:
-    args = [a for a in argv if not a.startswith("-")]
-    flags = {a for a in argv if a.startswith("-")}
-    if flags - {"--json"} or len(args) != 2 or args[0] != "summarize":
-        print(_USAGE, file=sys.stderr)
-        return 2
-    run_dir = args[1]
+def _summarize(run_dir: str, as_json: bool) -> int:
     events = load_run_events(run_dir)
     if not events:
         print(f"no telemetry found under {run_dir}", file=sys.stderr)
         return 1
     s = summarize(events)
-    if "--json" in flags:
+    if as_json:
         json.dump(s, sys.stdout, indent=2, sort_keys=True, default=str)
         print()
         return 0
@@ -58,6 +68,90 @@ def main(argv: list[str]) -> int:
                 f"for {a['dur_s']:.1f}s [{procs}]"
             )
     return 0
+
+
+def _fmt_lat(p: dict | None) -> str:
+    if not p:
+        return "-"
+    return (
+        f"p50={p['p50']:.4f}s p95={p['p95']:.4f}s p99={p['p99']:.4f}s "
+        f"(n={p['count']})"
+    )
+
+
+def _serve_summary(run_dir: str, as_json: bool) -> int:
+    records = load_access_log(run_dir)
+    if not records:
+        print(
+            f"no serve access log found under {run_dir} "
+            "(obs/access.p*.jsonl — armed by TPUFLOW_SERVE_ACCESS_LOG)",
+            file=sys.stderr,
+        )
+        return 1
+    s = summarize_access(records)
+    # The engine-time ledger fractions ride the event stream as gauges;
+    # best-effort (an access log with no events is still a summary).
+    ledger: dict[str, float] = {}
+    for ev in load_run_events(run_dir):
+        if ev.get("kind") != "gauge":
+            continue
+        name = ev.get("name", "")
+        if name in (
+            "serve.idle_fraction",
+            "serve.decode_fraction",
+            "serve.prefill_fraction",
+            "serve.decode_utilization",
+            "serve.masked_row_waste",
+        ):
+            try:
+                ledger[name] = float(ev.get("value", 0.0))
+            except (TypeError, ValueError):
+                pass
+    if ledger:
+        s["ledger"] = ledger
+    if as_json:
+        json.dump(s, sys.stdout, indent=2, sort_keys=True, default=str)
+        print()
+        return 0
+    print(
+        f"requests: {s['requests']}  tokens: {s['tokens']}  "
+        f"slo_violations: {s['slo_violations']}"
+    )
+    print(
+        "finish: "
+        + ", ".join(f"{k}={v}" for k, v in s["finish_reasons"].items())
+    )
+    print(f"ttft: {_fmt_lat(s['ttft'])}")
+    print(f"itl:  {_fmt_lat(s['itl'])}")
+    for g, rec in s["by_group"].items():
+        print(f"  {g}: n={rec['requests']}")
+        print(f"    ttft: {_fmt_lat(rec['ttft'])}")
+        print(f"    itl:  {_fmt_lat(rec['itl'])}")
+    if ledger:
+        print("ledger (last gauges):")
+        for b in SERVE_BUCKETS:
+            v = ledger.get(f"serve.{b}_fraction")
+            if v is not None:
+                print(f"  {b}: {100.0 * v:.1f}%")
+        for extra in ("serve.decode_utilization", "serve.masked_row_waste"):
+            if extra in ledger:
+                print(f"  {extra.split('.', 1)[1]}: {ledger[extra]:.4f}")
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("-")]
+    flags = {a for a in argv if a.startswith("-")}
+    if (
+        flags - {"--json"}
+        or len(args) != 2
+        or args[0] not in ("summarize", "serve-summary")
+    ):
+        print(_USAGE, file=sys.stderr)
+        return 2
+    if args[0] == "serve-summary":
+        return _serve_summary(args[1], "--json" in flags)
+    return _summarize(args[1], "--json" in flags)
 
 
 if __name__ == "__main__":

@@ -246,6 +246,59 @@ CATALOG: dict[str, tuple[str, str]] = {
         "pool pressure reclaimed an idle (refcount-0) prefix-cache page "
         "LRU-first; its cached prefix must be recomputed on next use",
     ),
+    # Serving observatory (ISSUE 13): per-request lifecycle traces, the
+    # engine-time ledger fractions, and declared-SLO accounting — the
+    # serving analog of the goodput ledger (tpuflow.obs.serve_ledger),
+    # read by `python -m tpuflow.obs serve-summary` and the timeline
+    # card's Serving section.
+    "serve.trace": (
+        "event",
+        "one request-lifecycle transition (request, phase=submitted|"
+        "queued|admitted|first_token|tick|complete|drained, plus the "
+        "phase's evidence: backpressure reason, bucket/pages, ttft_s, "
+        "tokens committed per tick, finish reason); the full per-request "
+        "record also lands in the obs/ access log",
+    ),
+    "serve.slo_violation": (
+        "event",
+        "a request violated a declared latency SLO (slo=ttft|itl, "
+        "value, limit_s, group; armed by TPUFLOW_SERVE_SLO_TTFT_MS / "
+        "TPUFLOW_SERVE_SLO_ITL_MS)",
+    ),
+    "serve.slo_violations": (
+        "counter",
+        "cumulative declared-SLO violations (TTFT + ITL) — the number "
+        "tpu_watch --follow and /metrics surface",
+    ),
+    "serve.idle_fraction": (
+        "gauge",
+        "engine-time ledger: fraction of serve wall spent in the idle "
+        "sleep (nothing queued, nothing live) — high idle with low "
+        "queue depth means the replica is over-provisioned",
+    ),
+    "serve.decode_fraction": (
+        "gauge",
+        "engine-time ledger: fraction of serve wall inside the decode "
+        "(+ verify) device dispatches — the bucket that earns tokens",
+    ),
+    "serve.prefill_fraction": (
+        "gauge",
+        "engine-time ledger: fraction of serve wall inside admission "
+        "prefill dispatches — high under churny short-request traffic",
+    ),
+    "serve.decode_utilization": (
+        "gauge",
+        "occupancy-weighted decode utilization: live rows / batch rows "
+        "summed over dispatched blocks (1.0 = every row of every block "
+        "earned its FLOPs; low values say raise arrival rate or shrink "
+        "slots)",
+    ),
+    "serve.masked_row_waste": (
+        "gauge",
+        "fraction of dispatched batch rows live engine-wide but masked "
+        "OUT of the dispatching group's program — what the "
+        "(fp,int8)x(spec,plain) partition costs on mixed traffic",
+    ),
     # Per-request int8 serving (ISSUE 9): the quantized twin of the
     # persistent decode program, plus the completion trail that lets an
     # operator split throughput by numeric path.

@@ -65,6 +65,21 @@ _PROM_SPEC = (
     ("tpuflow_serve_pages_free", "serve_pages_free", "gauge"),
     ("tpuflow_serve_prefix_hit_rate", "serve_prefix_hit_rate", "gauge"),
     ("tpuflow_serve_spec_accept_rate", "serve_spec_accept_rate", "gauge"),
+    # Serving observatory (ISSUE 13): engine-time ledger fractions, ITL
+    # percentiles, and declared-SLO accounting; keys only present while
+    # an engine feeds this process's ledger.
+    ("tpuflow_serve_ttft_p95_seconds", "serve_ttft_p95_s", "gauge"),
+    ("tpuflow_serve_itl_p50_seconds", "serve_itl_p50_s", "gauge"),
+    ("tpuflow_serve_itl_p95_seconds", "serve_itl_p95_s", "gauge"),
+    ("tpuflow_serve_itl_p99_seconds", "serve_itl_p99_s", "gauge"),
+    ("tpuflow_serve_idle_fraction", "serve_idle_fraction", "gauge"),
+    ("tpuflow_serve_decode_fraction", "serve_decode_fraction", "gauge"),
+    ("tpuflow_serve_prefill_fraction", "serve_prefill_fraction", "gauge"),
+    ("tpuflow_serve_decode_utilization", "serve_decode_utilization",
+     "gauge"),
+    ("tpuflow_serve_masked_row_waste", "serve_masked_row_waste", "gauge"),
+    ("tpuflow_serve_slo_violations_total", "serve_slo_violations",
+     "counter"),
 )
 
 

@@ -320,6 +320,15 @@ class ProcessLedger:
         self.serve_prefix_lookups = 0
         self.serve_spec_committed = 0
         self.serve_spec_forwards = 0
+        # Serving observatory (ISSUE 13): engine-time ledger fractions,
+        # efficiency gauges, and declared-SLO violation count, fed by
+        # the engine each scheduler iteration; ITL observations ride a
+        # bounded deque exactly like the TTFTs.
+        self.serve_ledger_fractions: dict[str, float] = {}
+        self.serve_decode_utilization: float | None = None
+        self.serve_masked_row_waste: float | None = None
+        self.serve_slo_violations = 0
+        self._serve_itls: collections.deque = collections.deque(maxlen=2048)
         self._serve_ttfts: collections.deque = collections.deque(maxlen=512)
         self._serve_recent: collections.deque = collections.deque(maxlen=128)
         # (monotonic, cumulative steps+reports, cumulative tokens) marks
@@ -410,6 +419,28 @@ class ProcessLedger:
         self.serve_spec_committed = int(committed)
         self.serve_spec_forwards = int(forwards)
 
+    def note_serve_itl(self, itl_s: float | None) -> None:
+        """One decode tick's per-token latency observation (tick wall /
+        tokens committed) for the live ITL percentiles."""
+        if isinstance(itl_s, (int, float)):
+            self._serve_itls.append(float(itl_s))
+
+    def note_serve_ledger(
+        self,
+        fractions: dict[str, float],
+        *,
+        utilization: float | None = None,
+        masked_waste: float | None = None,
+        slo_violations: int = 0,
+    ) -> None:
+        """The engine-time ledger's live view (tpuflow.obs.serve_ledger):
+        bucket fractions of serve wall, decode utilization, masked-row
+        waste, and the SLO violation count."""
+        self.serve_ledger_fractions = dict(fractions)
+        self.serve_decode_utilization = utilization
+        self.serve_masked_row_waste = masked_waste
+        self.serve_slo_violations = int(slo_violations)
+
     def snapshot(self) -> dict[str, Any]:
         """Point-in-time view for the export endpoint. Rolling rates come
         from the recent-fence window; MFU only when both the model FLOP
@@ -460,14 +491,34 @@ class ProcessLedger:
                     out["serve_tokens_per_s"] = round(
                         (tok_b - tok_a) / (t_b - t_a), 2
                     )
+            # Nearest-rank percentiles via the shared pctl so the
+            # access-log serve-summary reproduces these exact numbers.
+            from tpuflow.obs.serve_ledger import pctl as _pctl
+
             if self._serve_ttfts:
                 ts = sorted(self._serve_ttfts)
-                out["serve_ttft_p50_s"] = round(
-                    ts[len(ts) // 2], 6
+                out["serve_ttft_p50_s"] = round(_pctl(ts, 0.50), 6)
+                out["serve_ttft_p95_s"] = round(_pctl(ts, 0.95), 6)
+                out["serve_ttft_p99_s"] = round(_pctl(ts, 0.99), 6)
+            if self._serve_itls:
+                its = sorted(self._serve_itls)
+                out["serve_itl_p50_s"] = round(_pctl(its, 0.50), 6)
+                out["serve_itl_p95_s"] = round(_pctl(its, 0.95), 6)
+                out["serve_itl_p99_s"] = round(_pctl(its, 0.99), 6)
+            # Engine-time ledger view (ISSUE 13): bucket fractions,
+            # efficiency gauges, SLO count — keys only when an engine
+            # has fed the ledger at least once.
+            for b, v in sorted(self.serve_ledger_fractions.items()):
+                out[f"serve_{b}_fraction"] = round(float(v), 4)
+            if self.serve_decode_utilization is not None:
+                out["serve_decode_utilization"] = round(
+                    self.serve_decode_utilization, 4
                 )
-                out["serve_ttft_p99_s"] = round(
-                    ts[min(len(ts) - 1, int(len(ts) * 0.99))], 6
+            if self.serve_masked_row_waste is not None:
+                out["serve_masked_row_waste"] = round(
+                    self.serve_masked_row_waste, 4
                 )
+            out["serve_slo_violations"] = self.serve_slo_violations
             if self.serve_pages_total:
                 out["serve_pages_free"] = self.serve_pages_free
                 if self.serve_prefix_lookups:

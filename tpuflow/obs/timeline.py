@@ -177,6 +177,14 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
     committed = counters.get("infer.spec.committed", 0.0)
     if fwds > 0:
         headline["spec_tokens_per_forward"] = committed / fwds
+    # Serving observatory (ISSUE 13): request/SLO counts in the headline
+    # so a glance at run.json answers "did this replica meet its SLOs".
+    if counters.get("serve.requests"):
+        headline["serve_requests"] = int(counters["serve.requests"])
+    if counters.get("serve.slo_violations"):
+        headline["serve_slo_violations"] = int(
+            counters["serve.slo_violations"]
+        )
 
     # Training-health view (ISSUE 3): anomaly/rollback/profile events +
     # last numerics gauges, with headline counts so a glance at run.json

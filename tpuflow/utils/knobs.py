@@ -392,6 +392,22 @@ REGISTRY: dict[str, Knob] = dict(
            "K arms per-request speculative decode at draft length K "
            "(submit(speculative=False) opts a request out)", "serve",
            _A_SERVE, default_doc="off"),
+        _k("TPUFLOW_SERVE_TRACE", "bool", True,
+           "0 = disarm per-request lifecycle traces (serve.trace "
+           "events + the request's host-side trace list)", "serve",
+           _A_SERVE),
+        _k("TPUFLOW_SERVE_ACCESS_LOG", "bool", True,
+           "0 = disarm the per-request JSONL access log "
+           "(obs/access.p*.jsonl, read by `serve-summary`)", "serve",
+           _A_SERVE),
+        _k("TPUFLOW_SERVE_SLO_TTFT_MS", "float", None,
+           "declared TTFT SLO in ms; a violating request emits "
+           "serve.slo_violation and bumps the violation counter",
+           "serve", _A_SERVE, default_doc="off"),
+        _k("TPUFLOW_SERVE_SLO_ITL_MS", "float", None,
+           "declared inter-token-latency SLO in ms, checked per decode "
+           "tick (tick wall / tokens committed)", "serve", _A_SERVE,
+           default_doc="off"),
         # -------------------------------------------------------- testing
         _k("TPUFLOW_FAULT", "str", None,
            "comma-separated fault-injection specs (chaos suite)",
