@@ -55,6 +55,35 @@ def _log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+def _record_fleet_snapshot(rec: dict, leg: str) -> None:
+    """Persist this serving leg's /status-shaped replica view as a
+    one-replica fleet snapshot JSONL (ISSUE 14) and record the path —
+    the calibrated per-replica reference ROADMAP item 2's router reads,
+    in the exact shape `python -m tpuflow.obs fleet-summary` emits for
+    a live fleet (so router calibration and bench evidence share one
+    parser)."""
+    try:
+        from tpuflow import obs as _obs
+        from tpuflow.obs import fleet as _fleet
+
+        status = _obs.goodput_live().snapshot()
+        status.setdefault("replica", _fleet.replica_identity())
+        snap = {
+            "ts": time.time(),
+            "leg": leg,
+            "fleet": _fleet.aggregate([status]),
+            "replicas": [status],
+        }
+        out_dir = knobs.raw("TPUFLOW_BENCH_DIR") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "tpuflow_bench"
+        )
+        path = os.path.join(out_dir, "fleet_snapshot.jsonl")
+        if _fleet.append_snapshot(path, snap):
+            rec["fleet_snapshot_path"] = path
+    except Exception as e:  # evidence trail must not erase the leg
+        rec["fleet_snapshot_error"] = repr(e)[:200]
+
+
 # On-TPU evidence ledger (committed to the repo): every bench leg that
 # actually executed on the TPU platform persists its record here the moment
 # it succeeds, so a tunnel that is healthy mid-round but dead at round-end
@@ -709,6 +738,7 @@ def bench_serving(model, params, cfg, on_tpu: bool) -> dict:
         ) if warm_seq else None,
         "compile_stats": engine.compile_stats(),
     }
+    _record_fleet_snapshot(rec, "serving")
     try:
         rec["paged"] = bench_serving_paged(model, params, cfg, on_tpu)
     except Exception as e:  # the paged sub-leg must not erase the record
@@ -874,6 +904,7 @@ def bench_serving_paged(model, params, cfg, on_tpu: bool) -> dict:
         },
         "compile_stats": paged_eng.compile_stats(),
     }
+    _record_fleet_snapshot(rec, "serving.paged")
     _log(f"[bench] serving.paged: {rec}")
     return rec
 

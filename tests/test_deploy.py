@@ -165,10 +165,12 @@ def test_deploy_cli_writes_manifests(isolated_home, tmp_path):
 
 
 def test_serving_deployment_manifest(tmp_path):
-    """Serving Deployment (ISSUE 8): long-lived replicas with TPU node
-    selectors, the /status readiness probe on the live-export port, the
-    TPUFLOW_SERVE_* engine shape in the pod env, and a drain grace
-    window covering serve_forever's SIGTERM drain."""
+    """Serving Deployment (ISSUE 8 + fleet wiring, ISSUE 14): long-lived
+    replicas with TPU node selectors, the /status readiness probe on the
+    live-export port, the TPUFLOW_SERVE_* engine shape in the pod env,
+    a drain grace window covering serve_forever's SIGTERM drain, the
+    replica id stamped from the pod name, Prometheus scrape annotations,
+    and the headless fleet-discovery Service beside the ClusterIP one."""
     from tpuflow.flow.deploy import materialize_serving
 
     files = materialize_serving(
@@ -185,6 +187,7 @@ def test_serving_deployment_manifest(tmp_path):
     )
     assert sorted(os.path.basename(f) for f in files) == [
         "gpt2-serve.deployment.yaml",
+        "gpt2-serve.headless.yaml",
         "gpt2-serve.service.yaml",
     ]
     with open(tmp_path / "m" / "gpt2-serve.deployment.yaml") as f:
@@ -201,7 +204,9 @@ def test_serving_deployment_manifest(tmp_path):
     assert container["resources"]["limits"]["google.com/tpu"] == 4
     probe = container["readinessProbe"]["httpGet"]
     assert probe == {"path": "/status", "port": 9100}
-    env = {e["name"]: e["value"] for e in container["env"]}
+    env = {
+        e["name"]: e["value"] for e in container["env"] if "value" in e
+    }
     assert env["TPUFLOW_OBS_HTTP_PORT"] == "9100"
     assert env["TPUFLOW_OBS_HTTP_HOST"] == "0.0.0.0"
     assert env["TPUFLOW_SERVE_SLOTS"] == "16"
@@ -209,6 +214,22 @@ def test_serving_deployment_manifest(tmp_path):
     assert env["TPUFLOW_SERVE_BUCKETS"] == "64,128,256"
     assert env["TPUFLOW_SERVE_DECODE_BLOCK"] == "16"
     assert env["TPUFLOW_PREEMPT_GRACE_S"] == "90"
+    # Replica identity: the pod name IS the replica id (fieldRef, not a
+    # literal value — each replica of the Deployment gets its own).
+    from_field = {
+        e["name"]: e["valueFrom"]
+        for e in container["env"]
+        if "valueFrom" in e
+    }
+    assert from_field["TPUFLOW_FLEET_REPLICA_ID"] == {
+        "fieldRef": {"fieldPath": "metadata.name"}
+    }
+    # Scrape annotations: a cluster Prometheus discovers every
+    # replica's /metrics (incl. the mergeable histogram buckets).
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "9100"
+    assert ann["prometheus.io/path"] == "/metrics"
     # Service fronts the same selector on the same port.
     with open(tmp_path / "m" / "gpt2-serve.service.yaml") as f:
         svc = yaml.safe_load(f)
@@ -218,3 +239,15 @@ def test_serving_deployment_manifest(tmp_path):
     assert (
         dep["spec"]["template"]["metadata"]["labels"]["app"] == "gpt2-serve"
     )
+    # Headless fleet-discovery Service (ISSUE 14): clusterIP None means
+    # the DNS name resolves to EVERY pod IP — the k8s discovery mode of
+    # tpuflow.obs.fleet; not-ready addresses stay published so a
+    # draining replica is marked degraded instead of vanishing.
+    with open(tmp_path / "m" / "gpt2-serve.headless.yaml") as f:
+        hsvc = yaml.safe_load(f)
+    assert hsvc["kind"] == "Service"
+    assert hsvc["metadata"]["name"] == "gpt2-serve-fleet"
+    assert hsvc["spec"]["clusterIP"] == "None"
+    assert hsvc["spec"]["publishNotReadyAddresses"] is True
+    assert hsvc["spec"]["selector"] == {"app": "gpt2-serve"}
+    assert hsvc["spec"]["ports"][0]["port"] == 9100

@@ -1,0 +1,617 @@
+"""Fleet observatory (ISSUE 14), jax-free layer: mergeable histogram
+math (summed buckets == pooled buckets, bit for bit), discovery modes
+(URL lists, the registration dir, torn registration files), the poller's
+malformed-/status hardening and staleness marking, health-score rules,
+aggregation (occupancy-weighted utilization, per-group SLO rates), and
+the acceptance integration — 3 concurrently exporting in-process
+replicas whose fleet TTFT/ITL p99 is bit-equal to pooling their raw
+access logs, with a killed replica marked stale.
+
+Everything here is host-pure: ProcessLedger + MetricsServer +
+FleetObservatory never touch jax (the engine-integration coverage —
+compile_stats unchanged with registration + histogram export armed —
+lives in tests/test_serve.py)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from tpuflow.obs import fleet
+from tpuflow.obs import serve_ledger as sl
+from tpuflow.obs.export import MetricsServer, prometheus_text
+from tpuflow.obs.goodput import ProcessLedger
+
+
+# ------------------------------------------------------------ histograms
+def test_hist_edges_resolution(monkeypatch):
+    monkeypatch.delenv("TPUFLOW_FLEET_HIST_BUCKETS", raising=False)
+    assert fleet.resolve_hist_edges() == fleet.DEFAULT_HIST_EDGES
+    monkeypatch.setenv("TPUFLOW_FLEET_HIST_BUCKETS", "0.01,0.1,1.0")
+    assert fleet.resolve_hist_edges() == (0.01, 0.1, 1.0)
+    # Malformed (non-numeric, non-increasing, non-positive) -> default,
+    # never a crash at server start.
+    for bad in ("banana", "0.1,0.05", "0,1", "-1,2", ""):
+        monkeypatch.setenv("TPUFLOW_FLEET_HIST_BUCKETS", bad)
+        assert fleet.resolve_hist_edges() == fleet.DEFAULT_HIST_EDGES
+
+
+def test_mergeable_histogram_counts_and_cumulative():
+    h = fleet.MergeableHistogram((0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.02, 0.5, 2.0):
+        h.observe(v)
+    # Bucket semantics: first bucket is [0, e0], then (e_i-1, e_i],
+    # last is the overflow. 0.01 lands ON its edge (le convention).
+    assert h.counts == [2, 1, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(2.535)
+    assert h.cumulative() == [2, 3, 4, 5]
+    d = h.to_dict()
+    assert d["edges"] == [0.01, 0.1, 1.0]
+    assert d["counts"] == [2, 1, 1, 1]
+
+
+def test_summed_buckets_bit_equal_pooled_and_within_one_bucket():
+    """THE merge property (tentpole): per-replica bucket counts summed
+    over 3 simulated replicas are bit-equal to the bucket counts of the
+    pooled raw observations, so the fleet percentile from the merged
+    counts is bit-equal to bucketing the pool — and within one bucket
+    width of the pooled nearest-rank percentile."""
+    import random
+
+    rng = random.Random(7)
+    edges = fleet.DEFAULT_HIST_EDGES
+    replicas, pooled = [], []
+    for _ in range(3):
+        vals = [rng.lognormvariate(-4.0, 1.5) for _ in range(257)]
+        h = fleet.MergeableHistogram(edges)
+        for v in vals:
+            h.observe(v)
+        replicas.append(h)
+        pooled.extend(vals)
+    merged = fleet.merge_hists(h.to_dict() for h in replicas)
+    hp = fleet.MergeableHistogram(edges)
+    for v in pooled:
+        hp.observe(v)
+    # Bit-equal: integer sums, no estimation anywhere.
+    assert merged["counts"] == hp.counts
+    assert merged["count"] == hp.count == len(pooled)
+    pooled.sort()
+    for q in (0.5, 0.95, 0.99):
+        got = fleet.hist_pctl(merged["edges"], merged["counts"], q)
+        want = fleet.hist_pctl(hp.edges, hp.counts, q)
+        assert got == want  # bit-equal vs pooling the raw observations
+        raw = sl.pctl(pooled, q)
+        # The histogram answer is the upper edge of the raw answer's
+        # bucket: within one bucket width.
+        i = next(
+            (k for k, e in enumerate(edges) if raw <= e), len(edges)
+        )
+        lo = 0.0 if i == 0 else edges[i - 1]
+        assert got >= raw
+        assert got - raw <= edges[min(i, len(edges) - 1)] - lo + 1e-12
+
+
+def test_pctl_empty_and_single_observation_edges():
+    """The shared nearest-rank helper's edge cases (satellite): empty
+    windows and single observations, raw and histogram sides."""
+    assert sl.pctl([], 0.99) == 0.0
+    assert sl.percentiles([]) is None
+    for q in (0.0, 0.5, 0.99):
+        assert sl.pctl([0.042], q) == 0.042
+    p = sl.percentiles([0.042])
+    assert p["count"] == 1 and p["p50"] == p["p99"] == 0.042
+    # Histogram twins.
+    assert fleet.hist_pctl((0.01, 0.1), [0, 0, 0], 0.99) is None
+    assert fleet.hist_percentiles(None) is None
+    assert fleet.hist_percentiles({"count": 0}) is None
+    h = fleet.MergeableHistogram((0.01, 0.1))
+    h.observe(0.05)
+    for q in (0.0, 0.5, 0.99):
+        assert fleet.hist_pctl(h.edges, h.counts, q) == 0.1
+    # Overflow-bucket ranks are inf (edges under-span), never a lie.
+    h2 = fleet.MergeableHistogram((0.01,))
+    h2.observe(5.0)
+    assert fleet.hist_pctl(h2.edges, h2.counts, 0.5) == float("inf")
+
+
+def test_merge_hists_skips_mismatched_edges():
+    a = fleet.MergeableHistogram((0.01, 0.1))
+    b = fleet.MergeableHistogram((0.02, 0.2))
+    a.observe(0.05)
+    b.observe(0.05)
+    merged = fleet.merge_hists([a.to_dict(), b.to_dict()])
+    assert merged["count"] == 1 and merged["skipped"] == 1
+    assert fleet.merge_hists([]) is None
+    assert fleet.merge_hists([{"bogus": 1}]) is None
+
+
+# ------------------------------------------------- registration/discovery
+def test_registration_roundtrip_and_torn_file(tmp_path):
+    d = str(tmp_path / "fleet")
+    path = fleet.register_replica(
+        d, "http://127.0.0.1:9100", identity={"id": "pod-a", "attempt": 2}
+    )
+    assert os.path.basename(path) == "replica-pod-a.json"
+    # Re-registration (a restarted replica) overwrites its own file.
+    fleet.register_replica(
+        d, "http://127.0.0.1:9101", identity={"id": "pod-a", "attempt": 3}
+    )
+    regs = fleet.read_registrations(d)
+    assert len(regs) == 1
+    assert regs[0]["url"] == "http://127.0.0.1:9101"
+    assert regs[0]["replica"]["attempt"] == 3
+    # A torn (mid-write) registration file is skipped, never a crash.
+    with open(os.path.join(d, "replica-torn.json"), "w") as f:
+        f.write('{"url": "http://trunca')
+    with open(os.path.join(d, "replica-notdict.json"), "w") as f:
+        f.write('"just a string"')
+    regs = fleet.read_registrations(d)
+    assert [r["replica"]["id"] for r in regs] == ["pod-a"]
+    assert fleet.read_registrations(str(tmp_path / "missing")) == []
+
+
+def test_maybe_register_env_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUFLOW_FLEET_REGISTRATION_DIR", raising=False)
+    assert fleet.maybe_register("http://x:1") is None
+    d = str(tmp_path / "reg")
+    monkeypatch.setenv("TPUFLOW_FLEET_REGISTRATION_DIR", d)
+    path = fleet.maybe_register("http://127.0.0.1:7777")
+    assert path is not None
+    (rec,) = fleet.read_registrations(d)
+    assert rec["url"] == "http://127.0.0.1:7777"
+    assert rec["replica"]["id"]  # host-pid default identity
+
+
+def test_discover_replicas_modes(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUFLOW_FLEET_REPLICAS", raising=False)
+    monkeypatch.delenv("TPUFLOW_FLEET_REGISTRATION_DIR", raising=False)
+    assert fleet.discover_replicas() == []
+    # Comma URL list: normalized (scheme added, trailing slash dropped).
+    got = fleet.discover_replicas("127.0.0.1:8080/, http://127.0.0.1:8081")
+    assert [u for u, _ in got] == [
+        "http://127.0.0.1:8080",
+        "http://127.0.0.1:8081",
+    ]
+    # Env list when no explicit target.
+    monkeypatch.setenv("TPUFLOW_FLEET_REPLICAS", "127.0.0.1:9000")
+    assert fleet.discover_replicas() == [("http://127.0.0.1:9000", None)]
+    # Registration dir (explicit target wins over the env URL list;
+    # ids ride along).
+    d = str(tmp_path / "reg")
+    fleet.register_replica(d, "http://127.0.0.1:9001", identity={"id": "r1"})
+    assert fleet.discover_replicas(d) == [("http://127.0.0.1:9001", "r1")]
+    monkeypatch.delenv("TPUFLOW_FLEET_REPLICAS", raising=False)
+    monkeypatch.setenv("TPUFLOW_FLEET_REGISTRATION_DIR", d)
+    assert fleet.discover_replicas() == [("http://127.0.0.1:9001", "r1")]
+
+
+# ---------------------------------------------------------- health score
+def test_health_score_rules():
+    assert fleet.health_score(None, stale=True) == (0.0, ["stale"])
+    assert fleet.health_score({"ok": 1}, stale=True) == (0.0, ["stale"])
+    assert fleet.health_score({"serve_queue_depth": 1}, stale=False) == (
+        1.0,
+        [],
+    )
+    s, r = fleet.health_score(
+        {"nonfinite_steps": 2}, stale=False
+    )
+    assert s == 0.5 and r == ["nonfinite"]
+    s, r = fleet.health_score(
+        {"loss": float("nan")}, stale=False
+    )
+    assert s == 0.5 and r == ["nonfinite"]
+    s, r = fleet.health_score({}, stale=False, slo_delta=3)
+    assert s == 0.75 and r == ["slo_violating"]
+    s, r = fleet.health_score({}, stale=False, queue_growing=True)
+    assert s == 0.75 and r == ["queue_growing"]
+    s, r = fleet.health_score(
+        {"nonfinite_steps": 1},
+        stale=False,
+        slo_delta=1,
+        queue_growing=True,
+    )
+    assert s == 0.0
+    assert r == ["nonfinite", "slo_violating", "queue_growing"]
+
+
+# ------------------------------------------------------------ aggregation
+def _status(
+    q=0, occ=0.5, util=0.8, requests=10, slo=0, tps=100.0, pages=4,
+    ttft_hist=None, slo_by_group=None, req_by_group=None,
+):
+    st = {
+        "serve_queue_depth": q,
+        "serve_slot_occupancy": occ,
+        "serve_decode_utilization": util,
+        "serve_requests": requests,
+        "serve_slo_violations": slo,
+        "serve_tokens_per_s": tps,
+        "serve_pages_free": pages,
+    }
+    if ttft_hist:
+        st["serve_ttft_hist"] = ttft_hist
+    if slo_by_group:
+        st["serve_slo_by_group"] = slo_by_group
+    if req_by_group:
+        st["serve_requests_by_group"] = req_by_group
+    return st
+
+
+def test_aggregate_sums_weights_and_group_rates():
+    h1 = fleet.MergeableHistogram((0.01, 0.1, 1.0))
+    h2 = fleet.MergeableHistogram((0.01, 0.1, 1.0))
+    for v in (0.005, 0.05):
+        h1.observe(v)
+    for v in (0.5, 0.5, 0.05):
+        h2.observe(v)
+    a = _status(
+        q=2, occ=1.0, util=0.9, requests=30, slo=3, tps=200.0,
+        ttft_hist=h1.to_dict(),
+        slo_by_group={"fp.plain": 3},
+        req_by_group={"fp.plain": 20, "int8.plain": 10},
+    )
+    b = _status(
+        q=1, occ=0.0, util=0.1, requests=10, slo=1, tps=50.0,
+        ttft_hist=h2.to_dict(),
+        slo_by_group={"int8.plain": 1},
+        req_by_group={"int8.plain": 10},
+    )
+    out = fleet.aggregate([a, b])
+    assert out["queue_depth"] == 3
+    assert out["requests"] == 40
+    assert out["slo_violations"] == 4
+    assert out["tokens_per_s"] == 250.0
+    assert out["pages_free"] == 8
+    # Occupancy-weighted: the occ=0 replica's utilization is ~ignored.
+    assert out["decode_utilization"] == pytest.approx(0.9, abs=1e-6)
+    # Merged histogram percentiles over the pooled 5 observations.
+    assert out["ttft_hist"]["count"] == 5
+    assert out["ttft"]["p50"] == 0.1
+    assert out["ttft"]["p99"] == 1.0
+    # Per-group SLO rates: violations / completions of THAT group.
+    assert out["slo_rate_by_group"]["fp.plain"] == pytest.approx(3 / 20)
+    assert out["slo_rate_by_group"]["int8.plain"] == pytest.approx(1 / 20)
+    # Empty input stays well-formed.
+    assert fleet.aggregate([]) == {"replicas": 0}
+
+
+# ----------------------------------------------------------------- poller
+def test_poller_marks_malformed_status_stale_never_crashes():
+    """The satellite hardening: a /status read mid-write (truncated
+    JSON) or a dead socket marks the replica stale — the fleet poller
+    (and therefore tpu_watch --fleet) keeps running."""
+    calls = {"n": 0}
+
+    def fetch(url, timeout_s):
+        calls["n"] += 1
+        if url.endswith("9001"):
+            # A truncated body fails json parsing exactly like
+            # json.loads('{"steps": 12, "serve_') does.
+            json.loads('{"steps": 12, "serve_')
+        if url.endswith("9002"):
+            raise OSError("connection refused")
+        return _status(requests=5)
+
+    obsy = fleet.FleetObservatory(
+        "127.0.0.1:9000,127.0.0.1:9001,127.0.0.1:9002",
+        stale_s=10.0,
+        poll_interval_s=5.0,  # backoff base: failed replicas sit out
+        fetch=fetch,
+    )
+    snap = obsy.poll()
+    rows = {r["url"].rsplit(":", 1)[1]: r for r in snap["replicas"]}
+    assert not rows["9000"]["stale"]
+    assert rows["9001"]["stale"] and rows["9001"]["health"] == 0.0
+    assert rows["9002"]["stale"] and "error" in rows["9002"]
+    assert snap["fleet"]["replicas"] == 3
+    assert snap["fleet"]["healthy"] == 1
+    assert snap["fleet"]["stale"] == 2
+    # The failed replicas back off: an immediate re-poll skips them.
+    n = calls["n"]
+    obsy.poll()
+    assert calls["n"] == n + 1  # only the healthy replica re-fetched
+
+
+def test_poller_staleness_threshold_and_recovery():
+    """A replica that answered once then died goes stale within the
+    configured threshold; answering again clears it."""
+    alive = {"ok": True}
+
+    def fetch(url, timeout_s):
+        if not alive["ok"]:
+            raise OSError("down")
+        return _status(requests=1)
+
+    obsy = fleet.FleetObservatory(
+        "127.0.0.1:9000",
+        stale_s=0.05,
+        poll_interval_s=0.01,
+        fetch=fetch,
+    )
+    assert not obsy.poll()["replicas"][0]["stale"]
+    alive["ok"] = False
+    time.sleep(0.06)
+    snap = obsy.poll()
+    (row,) = snap["replicas"]
+    assert row["stale"] and row["age_s"] >= 0.05
+    alive["ok"] = True
+    time.sleep(0.02)  # past the first backoff window
+    snap = obsy.poll()
+    assert not snap["replicas"][0]["stale"]
+
+
+def test_poller_qps_queue_trend_and_snapshot_jsonl(tmp_path):
+    state = {"requests": 0, "q": 0, "slo": 0}
+
+    def fetch(url, timeout_s):
+        return _status(
+            q=state["q"], requests=state["requests"], slo=state["slo"]
+        )
+
+    path = str(tmp_path / "snaps" / "fleet.jsonl")
+    obsy = fleet.FleetObservatory(
+        "127.0.0.1:9000",
+        stale_s=10.0,
+        poll_interval_s=0.01,
+        snapshot_path=path,
+        fetch=fetch,
+    )
+    obsy.poll()
+    state.update(requests=50, q=1)
+    time.sleep(0.01)
+    snap = obsy.poll()
+    (row,) = snap["replicas"]
+    assert row["qps"] > 0  # 50 completions between the polls
+    assert snap["fleet"]["qps"] == row["qps"]
+    # Two consecutive queue-depth rises -> queue_growing docks health.
+    state.update(q=2, slo=1)
+    snap = obsy.poll()
+    (row,) = snap["replicas"]
+    assert "queue_growing" in row["health_reasons"]
+    assert "slo_violating" in row["health_reasons"]
+    assert row["health"] == pytest.approx(0.5)
+    # Every poll appended one parseable snapshot line.
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(lines) == 3
+    assert lines[-1]["fleet"]["replicas"] == 1
+
+
+def test_tpu_watch_fleet_survives_truncated_status_over_http(capsys):
+    """The satellite hardening end to end, through the REAL HTTP fetch
+    path and the REAL tpu_watch fleet loop: a replica whose /status
+    body is truncated mid-write is marked STALE on the printed line;
+    the watcher never raises."""
+    import importlib.util
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Torn(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"steps": 12, "serve_queue'  # torn mid-write
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Torn)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # The raw fetch raises ValueError (not a crash deeper in).
+        with pytest.raises(ValueError):
+            fleet._fetch_status(url, 2.0)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "tpu_watch", os.path.join(repo, "tools", "tpu_watch.py")
+        )
+        watch = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(watch)
+        rc = watch.fleet(url, interval=0.01, max_s=0.05)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "STALE" in out and "fleet n=1" in out
+        assert "deadline reached" in out
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_format_lines_smoke():
+    line = fleet.format_fleet_line(
+        {"replicas": 2, "healthy": 1, "stale": 1, "qps": 12.5,
+         "tokens_per_s": 900.0, "queue_depth": 3,
+         "decode_utilization": 0.75, "slo_violations": 2,
+         "ttft": {"p99": 0.25}, "itl": {"p99": 0.012}}
+    )
+    assert "n=2" in line and "ttft99=0.250s" in line
+    stale_row = fleet.format_replica_line(
+        {"id": "pod-b", "stale": True, "health": 0.0,
+         "health_reasons": ["stale"], "age_s": 3.2, "error": "down"}
+    )
+    assert "STALE" in stale_row and "pod-b" in stale_row
+    ok_row = fleet.format_replica_line(
+        {"id": "pod-a", "stale": False, "health": 0.75,
+         "health_reasons": ["queue_growing"], "serve_queue_depth": 4}
+    )
+    assert "health=0.75(queue_growing)" in ok_row
+
+
+# ------------------------------------------------- acceptance integration
+def test_three_live_replicas_fleet_summary_bit_equal_and_staleness(
+    tmp_path, monkeypatch, capsys
+):
+    """THE acceptance drive: 3 concurrently exporting in-process
+    replicas (each a real MetricsServer over its own ProcessLedger) in a
+    registration dir, plus one registered-but-killed replica. The
+    fleet-summary CLI reports fleet TTFT/ITL p99 BIT-EQUAL to pooling
+    the replicas' raw access logs (bucketed on the shared edges), and
+    marks the killed replica stale within the configured threshold."""
+    import random
+
+    from tpuflow.obs.__main__ import main as obs_main
+    from tpuflow.obs.serve_ledger import AccessLog, load_access_log
+
+    monkeypatch.delenv("TPUFLOW_FLEET_HIST_BUCKETS", raising=False)
+    monkeypatch.setenv("TPUFLOW_FLEET_STALE_S", "5.0")
+    rng = random.Random(23)
+    reg = str(tmp_path / "fleet")
+    servers, run_dirs = [], []
+    try:
+        for i in range(3):
+            led = ProcessLedger()
+            led.note_serve_state(
+                queue_depth=i, live_slots=1 + i, max_slots=4
+            )
+            run_dir = str(tmp_path / f"run{i}")
+            log = AccessLog(os.path.join(run_dir, "obs"), proc=0)
+            run_dirs.append(run_dir)
+            for k in range(40):
+                ttft = rng.lognormvariate(-3.5, 1.0)
+                itls = [
+                    rng.lognormvariate(-6.0, 0.8)
+                    for _ in range(rng.randint(1, 4))
+                ]
+                led.note_serve_ttft(ttft)
+                for v in itls:
+                    led.note_serve_itl(v)
+                led.note_serve_complete("fp.plain")
+                log.write(
+                    {"request": k, "ts": k, "group": "fp.plain",
+                     "tokens": len(itls) + 1, "finish_reason": "budget",
+                     "ttft_s": ttft, "itl_s": itls}
+                )
+            ident = {"id": f"replica-{i}", "attempt": 0}
+            srv = MetricsServer(
+                0,
+                snapshot_fn=(
+                    lambda led=led, ident=ident: {
+                        **led.snapshot(), "replica": ident
+                    }
+                ),
+            )
+            servers.append(srv)
+            fleet.register_replica(reg, srv.url, identity=ident)
+        # A killed replica: registered, but its server is gone.
+        dead = MetricsServer(0)
+        fleet.register_replica(
+            reg, dead.url, identity={"id": "replica-dead", "attempt": 0}
+        )
+        dead.close()
+
+        # One replica's /metrics speaks the Prometheus histogram
+        # convention (cumulative le buckets + _sum/_count).
+        import urllib.request
+
+        with urllib.request.urlopen(
+            servers[0].url + "/metrics", timeout=5
+        ) as r:
+            text = r.read().decode()
+        assert 'tpuflow_serve_ttft_seconds_bucket{le="+Inf"} 40' in text
+        assert "tpuflow_serve_ttft_seconds_count 40" in text
+        assert 'tpuflow_serve_itl_seconds_bucket{le="0.001"}' in text
+
+        assert obs_main(["fleet-summary", reg, "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        fl = snap["fleet"]
+        assert fl["replicas"] == 4
+        assert fl["stale"] == 1
+        assert fl["healthy"] == 3
+        dead_row = [
+            r for r in snap["replicas"] if r["id"] == "replica-dead"
+        ][0]
+        assert dead_row["stale"] and dead_row["health"] == 0.0
+        # Identity stamped through /status rides the snapshot.
+        live_row = [
+            r for r in snap["replicas"] if r["id"] == "replica-0"
+        ][0]
+        assert live_row["replica"] == {"id": "replica-0", "attempt": 0}
+
+        # BIT-EQUAL: pool the raw per-replica access logs, bucket them
+        # on the shared edges, and the fleet percentiles must be ==.
+        pooled_ttft, pooled_itl = [], []
+        for rd in run_dirs:
+            for rec in load_access_log(rd):
+                pooled_ttft.append(rec["ttft_s"])
+                pooled_itl.extend(rec["itl_s"])
+        for which, pooled in (
+            ("ttft", pooled_ttft), ("itl", pooled_itl)
+        ):
+            hp = fleet.MergeableHistogram(fleet.DEFAULT_HIST_EDGES)
+            for v in pooled:
+                hp.observe(v)
+            assert snap["fleet"][f"{which}_hist"]["counts"] == hp.counts
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                want = fleet.hist_pctl(hp.edges, hp.counts, q)
+                assert snap["fleet"][which][key] == want, (which, key)
+                # And within one bucket width of the raw nearest-rank.
+                raw = sl.pctl(sorted(pooled), q)
+                assert want >= raw
+        assert fl["requests"] == 120
+        assert fl["requests_by_group"] == {"fp.plain": 120}
+
+        # Human mode prints the headline + one line per replica.
+        assert obs_main(["fleet-summary", reg]) == 0
+        text = capsys.readouterr().out
+        assert "fleet n=4" in text and "STALE" in text
+        assert "replica-1" in text
+        assert "fleet-exact from" in text
+        # Bad usage / empty target exit non-zero with a message.
+        empty = str(tmp_path / "empty")
+        os.makedirs(empty)
+        assert obs_main(["fleet-summary", empty]) == 1
+        monkeypatch.delenv("TPUFLOW_FLEET_REPLICAS", raising=False)
+        monkeypatch.delenv(
+            "TPUFLOW_FLEET_REGISTRATION_DIR", raising=False
+        )
+        assert obs_main(["fleet-summary"]) == 1
+        assert obs_main(["fleet-summary", "a", "b"]) == 2
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_process_ledger_histograms_ride_status_and_prometheus():
+    """The replica side of the merge contract: note_serve_ttft/itl feed
+    the cumulative fixed-edge histograms (never dropped, unlike the
+    windowed percentile reservoirs), the snapshot carries them beside
+    the gauges, and prometheus_text renders cumulative le counts."""
+    led = ProcessLedger()
+    led.note_serve_state(queue_depth=0, live_slots=1, max_slots=2)
+    for v in (0.004, 0.03, 0.3):
+        led.note_serve_ttft(v)
+    led.note_serve_itl(0.002)
+    led.note_serve_complete("fp.plain")
+    led.note_serve_complete("int8.spec")
+    led.note_serve_ledger(
+        {"idle": 0.5, "decode": 0.5},
+        slo_violations=2,
+        slo_by_group={"fp.plain": 2},
+    )
+    snap = led.snapshot()
+    assert snap["serve_ttft_hist"]["count"] == 3
+    assert sum(snap["serve_ttft_hist"]["counts"]) == 3
+    assert snap["serve_itl_hist"]["count"] == 1
+    assert snap["serve_requests_by_group"] == {
+        "fp.plain": 1, "int8.spec": 1
+    }
+    assert snap["serve_slo_by_group"] == {"fp.plain": 2}
+    text = prometheus_text(snap)
+    assert "# TYPE tpuflow_serve_ttft_seconds histogram" in text
+    assert 'tpuflow_serve_ttft_seconds_bucket{le="+Inf"} 3' in text
+    assert "tpuflow_serve_ttft_seconds_count 3" in text
+    assert "tpuflow_serve_itl_seconds_count 1" in text
+    # Cumulative le counts are monotone non-decreasing in edge order.
+    les = [
+        int(ln.rsplit(" ", 1)[1])
+        for ln in text.splitlines()
+        if ln.startswith("tpuflow_serve_ttft_seconds_bucket")
+    ]
+    assert les == sorted(les) and les[-1] == 3

@@ -356,6 +356,14 @@ def test_obs_catalog_lint():
         ("gauge", "serve.prefill_fraction"),
         ("gauge", "serve.decode_utilization"),
         ("gauge", "serve.masked_row_waste"),
+        # Fleet observatory (ISSUE 14) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
+        # registration, the poll sweep, staleness evidence.
+        ("event", "fleet.register"),
+        ("span", "fleet.poll"),
+        ("gauge", "fleet.size"),
+        ("gauge", "fleet.qps"),
+        ("event", "fleet.replica_stale"),
         # Native int8 decode (ISSUE 9) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
         ("span", "serve.quant_decode"),

@@ -381,6 +381,70 @@ def test_drain_queued_traces_terminal(engine):
     assert r.done and r.terminal_phase == "complete"
 
 
+def test_fleet_registration_histogram_export_never_recompile(
+    engine, model_params, monkeypatch, tmp_path
+):
+    """Fleet observatory (ISSUE 14) through the SHARED warmed engine:
+    with the registration dir + live export armed, export start stamps
+    a registration file carrying the replica identity, /status carries
+    that identity plus the mergeable TTFT/ITL histogram buckets, and
+    /metrics renders them in the Prometheus histogram convention — all
+    host-side, with compile_stats() unchanged (the acceptance's
+    never-recompile clause: registration + histogram export armed)."""
+    import json as _json
+    import urllib.request
+
+    from tpuflow import obs
+    from tpuflow.obs import export as obs_export
+    from tpuflow.obs import fleet as fleet_mod
+
+    model, params = model_params
+    base = engine.compile_stats()
+    reg = str(tmp_path / "fleet")
+    monkeypatch.setenv("TPUFLOW_FLEET_REGISTRATION_DIR", reg)
+    monkeypatch.setenv("TPUFLOW_FLEET_REPLICA_ID", "test-replica-0")
+    monkeypatch.setenv("TPUFLOW_OBS_HTTP_PORT", "0")
+    obs_export.stop()
+    try:
+        server = obs.maybe_start_export(proc=0)
+        assert server is not None
+        (rec,) = fleet_mod.read_registrations(reg)
+        assert rec["url"] == server.url
+        assert rec["replica"]["id"] == "test-replica-0"
+        # Serve through the shared engine while the exporter is live.
+        p = np.arange(1, 6, dtype=np.int32)
+        r = engine.submit(p, max_new_tokens=4)
+        engine.run_until_idle(max_iters=200)
+        np.testing.assert_array_equal(
+            r.result(), _solo(model, params, p, 4)
+        )
+        with urllib.request.urlopen(
+            server.url + "/status", timeout=5
+        ) as resp:
+            st = _json.loads(resp.read().decode())
+        assert st["replica"]["id"] == "test-replica-0"
+        hist = st["serve_ttft_hist"]
+        assert hist["count"] >= 1
+        assert len(hist["counts"]) == len(hist["edges"]) + 1
+        assert sum(hist["counts"]) == hist["count"]
+        with urllib.request.urlopen(
+            server.url + "/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        assert "# TYPE tpuflow_serve_ttft_seconds histogram" in text
+        assert 'tpuflow_serve_ttft_seconds_bucket{le="+Inf"}' in text
+        # The fleet observatory polls this live replica end to end.
+        snap = fleet_mod.FleetObservatory(reg, stale_s=30.0).poll()
+        (row,) = snap["replicas"]
+        assert row["id"] == "test-replica-0" and not row["stale"]
+        assert snap["fleet"]["ttft"]["count"] == hist["count"]
+        assert engine.compile_stats() == base, (
+            "fleet registration/histogram export recompiled"
+        )
+    finally:
+        obs_export.stop()
+
+
 def test_serve_trace_disarmed_is_one_bool_check(engine):
     """TPUFLOW_SERVE_TRACE=0 semantics: with _trace_on False the trace
     hook records nothing — no list growth, no events — and the engine

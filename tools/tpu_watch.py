@@ -33,6 +33,17 @@ opted in via TPUFLOW_OBS_HTTP_PORT on the run) and print one status
 line per poll — step, step rate, tokens/s, rolling MFU, goodput-so-far,
 last loss. The url defaults to 127.0.0.1:$TPUFLOW_OBS_HTTP_PORT;
 TPU_WATCH_FOLLOW_INTERVAL_S (default 5) sets the cadence.
+
+Fleet mode (``--fleet [target]``): the multi-replica twin (ISSUE 14) —
+poll EVERY serving replica's /status through the fleet observatory
+(``tpuflow.obs.fleet``) and print a fleet headline line (summed
+QPS/queue/tokens-per-s, occupancy-weighted decode utilization,
+fleet-exact TTFT/ITL p99 from merged histogram buckets, SLO count)
+plus one line per replica with its health score. ``target`` is a
+registration dir or comma URL list; omitted, the TPUFLOW_FLEET_*
+knobs resolve it. A replica answering garbage (a /status read
+mid-write) or nothing at all is marked STALE — the watcher never
+crashes on a dying replica; that is the event it exists to report.
 """
 
 from __future__ import annotations
@@ -248,6 +259,38 @@ def follow(url: str, interval: float, max_s: float) -> int:
     return 0
 
 
+def fleet(target: str | None, interval: float, max_s: float) -> int:
+    """Poll the serving fleet and print one headline + one line per
+    replica per interval (tpuflow.obs.fleet does discovery, per-replica
+    timeout/backoff, staleness marking, and the histogram merge)."""
+    from tpuflow.obs import fleet as fleet_mod
+
+    obsy = fleet_mod.FleetObservatory(target)
+    deadline = time.time() + max_s
+    while time.time() < deadline:
+        stamp = time.strftime("%H:%M:%S")
+        snap = obsy.poll()
+        if not snap["replicas"]:
+            print(
+                f"[tpu_watch {stamp}] fleet: no replicas discovered "
+                "(pass a registration dir / URL list or set "
+                "TPUFLOW_FLEET_REPLICAS); retry in "
+                f"{interval:.0f}s",
+                flush=True,
+            )
+        else:
+            print(
+                f"[tpu_watch {stamp}] "
+                + fleet_mod.format_fleet_line(snap["fleet"]),
+                flush=True,
+            )
+            for row in snap["replicas"]:
+                print(fleet_mod.format_replica_line(row), flush=True)
+        time.sleep(interval)
+    print("[tpu_watch] fleet deadline reached", flush=True)
+    return 0
+
+
 def main() -> int:
     interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", "45"))
     probe_timeout = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "75"))
@@ -338,6 +381,18 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--fleet" in sys.argv:
+        i = sys.argv.index("--fleet")
+        fleet_target = None
+        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
+            fleet_target = sys.argv[i + 1]
+        sys.exit(
+            fleet(
+                fleet_target,
+                float(os.environ.get("TPU_WATCH_FOLLOW_INTERVAL_S", "5")),
+                float(os.environ.get("TPU_WATCH_MAX_S", str(11 * 3600))),
+            )
+        )
     if "--follow" in sys.argv:
         i = sys.argv.index("--follow")
         if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):

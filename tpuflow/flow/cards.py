@@ -197,6 +197,7 @@ _TIMELINE_COLORS = {
     "data.": "#2e9960",
     "infer.": "#9268d4",
     "serve.": "#d08a3a",
+    "fleet.": "#3a9ec2",
     "device.": "#c2b33a",
 }
 
@@ -395,6 +396,37 @@ def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> N
             rows.append([label, val])
         if rows:
             buf.append(Table(rows, headers=["serving metric", "value"]))
+
+    # Fleet observatory (ISSUE 14): a run that polled a serving fleet
+    # (tpuflow.obs.fleet) gets a Fleet section — replica count/health,
+    # aggregate QPS, and the staleness evidence trail — mirroring the
+    # `fleet-summary` headline.
+    stale_events = [
+        e
+        for e in events
+        if e.get("kind") == "event" and e.get("name") == "fleet.replica_stale"
+    ]
+    if "fleet.size" in gauges or stale_events:
+        buf.append(Markdown("## Fleet"))
+        rows = []
+        g = gauges.get("fleet.size")
+        if g:
+            rows.append(
+                ["replicas tracked (last/max)",
+                 f"{g.get('last', 0.0):.0f} / {g.get('max', 0.0):.0f}"]
+            )
+        g = gauges.get("fleet.qps")
+        if g:
+            rows.append(["fleet QPS (last)", f"{g.get('last', 0.0):.3g}"])
+        if stale_events:
+            rows.append(["replica-stale events", f"{len(stale_events):,d}"])
+            culprits = sorted(
+                {str(e.get("replica")) for e in stale_events if e.get("replica")}
+            )
+            if culprits:
+                rows.append(["stale replicas", ", ".join(culprits[:8])])
+        if rows:
+            buf.append(Table(rows, headers=["fleet metric", "value"]))
 
     spans = [
         e for e in events if e.get("kind") == "span" and e.get("dur_s", 0) > 0

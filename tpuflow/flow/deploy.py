@@ -355,6 +355,13 @@ def serving_deployment(
         # The probe (and a cluster scraper) come in over the pod IP.
         {"name": "TPUFLOW_OBS_HTTP_HOST", "value": "0.0.0.0"},
         {"name": "TPUFLOW_PREEMPT_GRACE_S", "value": str(drain_grace_s)},
+        # Fleet identity (ISSUE 14): the pod name IS the replica id —
+        # stamped into /status and the registration file so a fleet
+        # snapshot names the pod an operator would kubectl into.
+        {
+            "name": "TPUFLOW_FLEET_REPLICA_ID",
+            "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+        },
     ]
     if max_slots is not None:
         penv.append(
@@ -427,7 +434,18 @@ def serving_deployment(
             "replicas": int(replicas),
             "selector": {"matchLabels": {"app": dep_name}},
             "template": {
-                "metadata": {"labels": {"app": dep_name}},
+                "metadata": {
+                    "labels": {"app": dep_name},
+                    # Scrape annotations (ISSUE 14): a cluster
+                    # Prometheus discovers every replica's /metrics —
+                    # including the mergeable TTFT/ITL histogram
+                    # buckets — without per-fleet scrape config.
+                    "annotations": {
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/port": str(metrics_port),
+                        "prometheus.io/path": "/metrics",
+                    },
+                },
                 "spec": {
                     "nodeSelector": node_selector,
                     "terminationGracePeriodSeconds": int(drain_grace_s),
@@ -459,11 +477,40 @@ def serving_service(name: str, *, metrics_port: int = 8080) -> dict:
     }
 
 
+def serving_headless_service(name: str, *, metrics_port: int = 8080) -> dict:
+    """HEADLESS Service (clusterIP: None) beside the ClusterIP one: its
+    DNS name resolves to EVERY ready pod's IP instead of one virtual IP,
+    which is the fleet observatory's k8s discovery mode (ISSUE 14) — put
+    ``http://<name>-fleet:<port>`` in ``TPUFLOW_FLEET_REPLICAS`` and
+    ``tpuflow.obs.fleet`` expands the A records into one replica per
+    pod. ``publishNotReadyAddresses`` keeps a draining/unready replica
+    visible so the observatory marks it degraded rather than losing it."""
+    dep_name = name.lower().replace("_", "-")
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{dep_name}-fleet"},
+        "spec": {
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,
+            "selector": {"app": dep_name},
+            "ports": [
+                {
+                    "name": "metrics",
+                    "port": metrics_port,
+                    "targetPort": metrics_port,
+                }
+            ],
+        },
+    }
+
+
 def materialize_serving(
     name: str, out_dir: str, *, image: str = "tpuflow:latest", **kw
 ) -> list[str]:
-    """Write the serving Deployment + Service YAML into ``out_dir``;
-    returns the files written (kubectl-apply shapes, like materialize)."""
+    """Write the serving Deployment + Service (ClusterIP + headless
+    fleet-discovery) YAML into ``out_dir``; returns the files written
+    (kubectl-apply shapes, like materialize)."""
     import yaml
 
     os.makedirs(out_dir, exist_ok=True)
@@ -478,6 +525,10 @@ def materialize_serving(
         (
             f"{dep_name}.service.yaml",
             serving_service(name, metrics_port=metrics_port),
+        ),
+        (
+            f"{dep_name}.headless.yaml",
+            serving_headless_service(name, metrics_port=metrics_port),
         ),
     ):
         path = os.path.join(out_dir, fname)

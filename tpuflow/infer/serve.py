@@ -1277,7 +1277,7 @@ class ServeEngine:
         obs.gauge("serve.ttft_s", round(req.ttft_s, 6))
         self._trace(req, "first_token", ttft_s=round(req.ttft_s, 6))
         self.ledger.note_ttft(req.group, req.ttft_s)
-        if self.ledger.check_ttft(req.ttft_s):
+        if self.ledger.check_ttft(req.ttft_s, group=req.group):
             self._slo_violation(
                 req, "ttft", req.ttft_s, self.ledger.slo_ttft_s
             )
@@ -1349,7 +1349,7 @@ class ServeEngine:
             slo_violations=req.slo_violations,
         )
         self._access_write(req, "complete")
-        obs.goodput_live().note_serve_complete()
+        obs.goodput_live().note_serve_complete(req.group)
 
     def _emit_state_gauges(self) -> None:
         """Queue-depth / occupancy / page-pool gauges on change (plus a
@@ -1403,6 +1403,7 @@ class ServeEngine:
             utilization=self.ledger.decode_utilization,
             masked_waste=self.ledger.masked_row_waste,
             slo_violations=self.ledger.slo_violations,
+            slo_by_group=self.ledger.slo_by_group,
         )
         if pool is not None:
             led.note_serve_pages(pool.free_pages, pool.usable_pages)
@@ -1540,7 +1541,9 @@ class ServeEngine:
                     )
                 else:
                     self._trace(req, "tick", tokens=n, spec=False)
-                if itl is not None and self.ledger.check_itl(itl):
+                if itl is not None and self.ledger.check_itl(
+                    itl, group=req.group
+                ):
                     self._slo_violation(
                         req, "itl", itl, self.ledger.slo_itl_s
                     )
@@ -1829,9 +1832,12 @@ def serve_forever(
     """Long-lived serving loop reusing the gang machinery: heartbeat
     stamps every iteration (the supervisor's stall detector works on a
     serving gang exactly as on a training gang), the live ``/metrics`` +
-    ``/status`` exporter starts when ``TPUFLOW_OBS_HTTP_PORT`` is set,
-    and a SIGTERM preemption drains — stops admitting, finishes the live
-    slots, exits — instead of killing requests mid-decode.
+    ``/status`` exporter starts when ``TPUFLOW_OBS_HTTP_PORT`` is set
+    (export start also stamps this replica into
+    ``TPUFLOW_FLEET_REGISTRATION_DIR`` when configured, so a fleet
+    observatory discovers it — ISSUE 14), and a SIGTERM preemption
+    drains — stops admitting, finishes the live slots, exits — instead
+    of killing requests mid-decode.
 
     ``max_s`` bounds the loop (tests / bounded jobs); ``should_stop`` is
     an optional callable polled each iteration.

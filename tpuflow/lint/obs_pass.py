@@ -115,6 +115,13 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("gauge", "serve.prefill_fraction"),
     ("gauge", "serve.decode_utilization"),
     ("gauge", "serve.masked_row_waste"),
+    # Fleet observatory (ISSUE 14): registration, the poll sweep, and
+    # the staleness evidence trail.
+    ("event", "fleet.register"),
+    ("span", "fleet.poll"),
+    ("gauge", "fleet.size"),
+    ("gauge", "fleet.qps"),
+    ("event", "fleet.replica_stale"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
     ("event", "ops.flash_bwd_fused"),

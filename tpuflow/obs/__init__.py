@@ -27,6 +27,13 @@ from tpuflow.obs.export import (
     MetricsServer,
     maybe_start_from_env as maybe_start_export,
 )
+from tpuflow.obs.fleet import (
+    FleetObservatory,
+    MergeableHistogram,
+    discover_replicas,
+    hist_pctl,
+    replica_identity,
+)
 from tpuflow.obs.flight import dump_flight, flight_path
 from tpuflow.obs.goodput import (
     BUCKETS as GOODPUT_BUCKETS,
@@ -75,9 +82,11 @@ __all__ = [
     "AccessLog",
     "Anomaly",
     "CATALOG",
+    "FleetObservatory",
     "GOODPUT_BUCKETS",
     "HealthConfig",
     "HealthMonitor",
+    "MergeableHistogram",
     "MetricsServer",
     "ProcessLedger",
     "ProfileWindow",
@@ -89,6 +98,7 @@ __all__ = [
     "compute_goodput",
     "configure",
     "counter",
+    "discover_replicas",
     "dump_flight",
     "enabled",
     "event",
@@ -97,6 +107,7 @@ __all__ = [
     "gauge",
     "goodput_live",
     "health_summary",
+    "hist_pctl",
     "histogram",
     "is_registered",
     "kind_of",
@@ -107,6 +118,7 @@ __all__ = [
     "obs_dir",
     "read_events",
     "recorder",
+    "replica_identity",
     "span",
     "summarize",
     "summarize_access",

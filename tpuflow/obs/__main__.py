@@ -1,16 +1,24 @@
-"""Operator CLI: ``python -m tpuflow.obs <command> <run_dir> [--json]``.
+"""Operator CLI: ``python -m tpuflow.obs <command> [target] [--json]``.
 
-Two commands, both jax-free and safe against a LIVE run from a login
+Three commands, all jax-free and safe against a LIVE run from a login
 shell:
 
-- ``summarize`` — the run's merged telemetry (the committed
+- ``summarize <run_dir>`` — the run's merged telemetry (the committed
   ``events.jsonl``, or the per-process fragments of a still-running/
   crashed run): headline metrics plus the goodput ledger.
-- ``serve-summary`` — the serving observatory (ISSUE 13): TTFT/ITL
-  percentiles split by traffic group, finish reasons, and SLO
+- ``serve-summary <run_dir>`` — the serving observatory (ISSUE 13):
+  TTFT/ITL percentiles split by traffic group, finish reasons, and SLO
   violations reproduced from the per-request ACCESS LOG alone (the same
   ``pctl`` math the live /metrics exporter uses), plus the engine-time
   ledger fractions when the event stream carries them.
+- ``fleet-summary [target]`` — the fleet observatory (ISSUE 14): poll
+  every replica's /status once and print the fleet headline (summed
+  load, occupancy-weighted utilization, fleet-exact TTFT/ITL
+  percentiles from merged histogram buckets, SLO rates by traffic
+  group) plus one line per replica with its health score. ``target``
+  is a registration directory or a comma URL list; omitted, the
+  ``TPUFLOW_FLEET_REPLICAS`` / ``TPUFLOW_FLEET_REGISTRATION_DIR``
+  knobs resolve it.
 
 ``--json`` dumps the full structure for CI and scripts.
 """
@@ -29,8 +37,10 @@ from tpuflow.obs.serve_ledger import (
 from tpuflow.obs.timeline import load_run_events, summarize
 
 _USAGE = (
-    "usage: python -m tpuflow.obs {summarize|serve-summary} "
-    "<run_dir> [--json]"
+    "usage: python -m tpuflow.obs {summarize|serve-summary} <run_dir> "
+    "[--json]\n"
+    "       python -m tpuflow.obs fleet-summary "
+    "[<registration_dir>|<url,url,...>] [--json]"
 )
 
 
@@ -139,14 +149,60 @@ def _serve_summary(run_dir: str, as_json: bool) -> int:
     return 0
 
 
+def _fleet_summary(target: str | None, as_json: bool) -> int:
+    from tpuflow.obs import fleet
+
+    obsy = fleet.FleetObservatory(target)
+    if not obsy.discover():
+        print(
+            "no fleet replicas found — pass a registration dir or a "
+            "comma URL list, or set TPUFLOW_FLEET_REPLICAS / "
+            "TPUFLOW_FLEET_REGISTRATION_DIR",
+            file=sys.stderr,
+        )
+        return 1
+    snap = obsy.poll()
+    if as_json:
+        json.dump(snap, sys.stdout, indent=2, sort_keys=True, default=str)
+        print()
+        return 0
+    print(fleet.format_fleet_line(snap["fleet"]))
+    for row in snap["replicas"]:
+        print(fleet.format_replica_line(row))
+    fl = snap["fleet"]
+    for which in ("ttft", "itl"):
+        p = fl.get(which)
+        if p:
+            print(
+                f"{which}: p50={p['p50']:.4g}s p95={p['p95']:.4g}s "
+                f"p99={p['p99']:.4g}s (n={p['count']}, fleet-exact from "
+                "merged histogram buckets)"
+            )
+    for g, rate in (fl.get("slo_rate_by_group") or {}).items():
+        print(
+            f"slo[{g}]: {100.0 * rate:.2f}% "
+            f"({fl['slo_by_group'].get(g, 0)} violations / "
+            f"{fl['requests_by_group'].get(g, 0)} requests)"
+        )
+    return 0
+
+
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("-")]
     flags = {a for a in argv if a.startswith("-")}
-    if (
-        flags - {"--json"}
-        or len(args) != 2
-        or args[0] not in ("summarize", "serve-summary")
-    ):
+    commands = ("summarize", "serve-summary", "fleet-summary")
+    if flags - {"--json"} or not args or args[0] not in commands:
+        print(_USAGE, file=sys.stderr)
+        return 2
+    if args[0] == "fleet-summary":
+        # The target is optional: the TPUFLOW_FLEET_* knobs resolve it.
+        if len(args) > 2:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        return _fleet_summary(
+            args[1] if len(args) == 2 else None, "--json" in flags
+        )
+    if len(args) != 2:
         print(_USAGE, file=sys.stderr)
         return 2
     if args[0] == "serve-summary":

@@ -313,6 +313,42 @@ CATALOG: dict[str, tuple[str, str]] = {
         "completed requests that decoded through the int8 path (subset "
         "of serve.requests)",
     ),
+    # --------------------------------------------------------------- fleet
+    # Fleet observatory (ISSUE 14): replica discovery/registration, the
+    # cross-replica poll sweep, and the staleness evidence trail —
+    # emitted by tpuflow.obs.fleet / tpuflow.obs.export, read by
+    # `python -m tpuflow.obs fleet-summary`, `tpu_watch --fleet`, and
+    # the timeline card's Fleet section.
+    "fleet.register": (
+        "event",
+        "this replica stamped its registration file into "
+        "TPUFLOW_FLEET_REGISTRATION_DIR at export start (url, replica "
+        "id, path) — how a fleet observatory discovers it without a "
+        "static URL list",
+    ),
+    "fleet.poll": (
+        "span",
+        "one fleet poll sweep: discover replicas, poll every /status "
+        "with per-replica timeout/backoff, aggregate one fleet snapshot",
+    ),
+    "fleet.size": (
+        "gauge",
+        "replicas the fleet observatory currently tracks (carries "
+        "healthy= — the count with health score >= 0.5 and fresh "
+        "/status)",
+    ),
+    "fleet.qps": (
+        "gauge",
+        "fleet aggregate completed-requests/s, summed from per-replica "
+        "completion-counter deltas between successful polls",
+    ),
+    "fleet.replica_stale": (
+        "event",
+        "a replica aged past TPUFLOW_FLEET_STALE_S without a successful "
+        "/status poll — unreachable, backing off, or answering "
+        "malformed/truncated JSON (replica, url, age_s, last error); "
+        "its health score pins to 0 until it answers again",
+    ),
     # --------------------------------------------------------------- quant
     "quant.decision": (
         "event",

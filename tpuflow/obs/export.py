@@ -24,9 +24,11 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tpuflow.obs import fleet as _fleet
 from tpuflow.obs import goodput as _goodput
 from tpuflow.obs import recorder as _rec
 from tpuflow.utils import knobs
@@ -83,6 +85,19 @@ _PROM_SPEC = (
 )
 
 
+# Cumulative TTFT/ITL histograms (ISSUE 14): Prometheus histogram
+# convention — per-bucket counts cumulated into le-labeled counts plus
+# _sum/_count. Unlike the pre-aggregated percentile GAUGES above (which
+# stay, for single-replica dashboards), bucket counts MERGE across
+# replicas by summation, which is what makes fleet-exact percentiles
+# possible (tpuflow.obs.fleet merges them; the fleet p99 from summed
+# buckets is bit-equal to bucketing the pooled raw observations).
+_PROM_HISTS = (
+    ("tpuflow_serve_ttft_seconds", "serve_ttft_hist"),
+    ("tpuflow_serve_itl_seconds", "serve_itl_hist"),
+)
+
+
 def prometheus_text(snapshot: dict) -> str:
     """Render a ledger snapshot as Prometheus text exposition (0.0.4).
     Keys absent from the snapshot (MFU off-TPU, rates before the second
@@ -94,19 +109,50 @@ def prometheus_text(snapshot: dict) -> str:
             continue
         lines.append(f"# TYPE {metric} {ptype}")
         lines.append(f"{metric} {float(v):.10g}")
+    for metric, key in _PROM_HISTS:
+        h = snapshot.get(key)
+        if not isinstance(h, dict) or not h.get("count"):
+            continue
+        try:
+            edges = list(h["edges"])
+            counts = [int(c) for c in h["counts"]]
+        except (TypeError, KeyError, ValueError):
+            continue
+        if len(counts) != len(edges) + 1:
+            continue
+        lines.append(f"# TYPE {metric} histogram")
+        acc = 0
+        for edge, c in zip(edges, counts):
+            acc += c
+            lines.append(f'{metric}_bucket{{le="{float(edge):.10g}"}} {acc}')
+        acc += counts[-1]
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {acc}')
+        lines.append(f"{metric}_sum {float(h.get('sum', 0.0)):.10g}")
+        lines.append(f"{metric}_count {int(h['count'])}")
     return "\n".join(lines) + "\n"
 
 
 class _Handler(BaseHTTPRequestHandler):
+    def _snapshot(self) -> dict:
+        """This server's snapshot source: the per-server override when
+        set (tests run several in-process replicas, each over its own
+        ledger), else the process's live goodput ledger."""
+        fn = getattr(self.server, "_tpuflow_snapshot", None)
+        return fn() if fn is not None else _goodput.live().snapshot()
+
     def do_GET(self):  # noqa: N802 (http.server API)
         try:
             route = self.path.split("?", 1)[0]
             if route == "/metrics":
-                body = prometheus_text(_goodput.live().snapshot()).encode()
+                body = prometheus_text(self._snapshot()).encode()
                 ctype = "text/plain; version=0.0.4"
             elif route in ("/status", "/"):
-                snap = _goodput.live().snapshot()
+                snap = self._snapshot()
                 snap["pid"] = os.getpid()
+                # Replica identity (ISSUE 14): fleet aggregation needs
+                # to know WHO answered — replica id, launch attempt,
+                # elastic mesh generation when known.
+                snap.setdefault("replica", _fleet.replica_identity())
                 body = (json.dumps(snap) + "\n").encode()
                 ctype = "application/json"
             else:
@@ -125,10 +171,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MetricsServer:
-    """One daemon-threaded HTTP server over the live ledger."""
+    """One daemon-threaded HTTP server over the live ledger (or, with
+    ``snapshot_fn``, over any snapshot source — the fleet tests run
+    several in-process replicas this way)."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(
+        self, port: int = 0, host: str = "127.0.0.1", snapshot_fn=None
+    ):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd._tpuflow_snapshot = snapshot_fn
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])
@@ -195,6 +246,14 @@ def maybe_start_from_env(proc: int | None = None) -> MetricsServer | None:
         )
         return None
     _rec.event("obs.export", port=_SERVER.port)
+    # Fleet registration (ISSUE 14): the moment /status answers, stamp
+    # this replica into the registration dir (if configured) so a fleet
+    # observatory discovers it without a static URL list. A wildcard
+    # bind advertises the host's name — 0.0.0.0 is not pollable.
+    reg_url = _SERVER.url
+    if host == "0.0.0.0":  # noqa: S104 (operator opted in via knob)
+        reg_url = f"http://{socket.gethostname()}:{_SERVER.port}"
+    _fleet.maybe_register(reg_url)
     print(
         f"[tpuflow] obs export serving /metrics + /status on {_SERVER.url}"
     )

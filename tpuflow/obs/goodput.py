@@ -57,6 +57,7 @@ import collections
 import time
 from typing import Any, Iterable
 
+from tpuflow.obs import fleet as _fleet
 from tpuflow.obs import recorder as _rec
 
 # Sweep priority, highest first: when labeled intervals overlap, each
@@ -328,6 +329,18 @@ class ProcessLedger:
         self.serve_decode_utilization: float | None = None
         self.serve_masked_row_waste: float | None = None
         self.serve_slo_violations = 0
+        # Fleet observatory (ISSUE 14): cumulative fixed-edge TTFT/ITL
+        # histograms beside the windowed percentile reservoirs — bucket
+        # counts are never dropped, so summing them across replicas
+        # reproduces the pooled distribution exactly (the windowed
+        # gauges below answer "now", the buckets answer "the fleet").
+        # Plus the per-traffic-group SLO/request splits the fleet SLO
+        # rates aggregate over.
+        _edges = _fleet.resolve_hist_edges()
+        self._serve_ttft_hist = _fleet.MergeableHistogram(_edges)
+        self._serve_itl_hist = _fleet.MergeableHistogram(_edges)
+        self.serve_slo_by_group: dict[str, int] = {}
+        self.serve_requests_by_group: dict[str, int] = {}
         self._serve_itls: collections.deque = collections.deque(maxlen=2048)
         self._serve_ttfts: collections.deque = collections.deque(maxlen=512)
         self._serve_recent: collections.deque = collections.deque(maxlen=128)
@@ -400,9 +413,14 @@ class ProcessLedger:
     def note_serve_ttft(self, ttft_s: float | None) -> None:
         if isinstance(ttft_s, (int, float)):
             self._serve_ttfts.append(float(ttft_s))
+            self._serve_ttft_hist.observe(float(ttft_s))
 
-    def note_serve_complete(self) -> None:
+    def note_serve_complete(self, group: str | None = None) -> None:
         self.serve_requests += 1
+        if group:
+            self.serve_requests_by_group[group] = (
+                self.serve_requests_by_group.get(group, 0) + 1
+            )
 
     def note_serve_pages(self, free: int, total: int) -> None:
         """Paged-KV pool headroom (free includes idle-evictable pages)."""
@@ -424,6 +442,7 @@ class ProcessLedger:
         tokens committed) for the live ITL percentiles."""
         if isinstance(itl_s, (int, float)):
             self._serve_itls.append(float(itl_s))
+            self._serve_itl_hist.observe(float(itl_s))
 
     def note_serve_ledger(
         self,
@@ -432,14 +451,18 @@ class ProcessLedger:
         utilization: float | None = None,
         masked_waste: float | None = None,
         slo_violations: int = 0,
+        slo_by_group: dict[str, int] | None = None,
     ) -> None:
         """The engine-time ledger's live view (tpuflow.obs.serve_ledger):
         bucket fractions of serve wall, decode utilization, masked-row
-        waste, and the SLO violation count."""
+        waste, and the SLO violation counts (total + per traffic group,
+        the split the fleet SLO rates aggregate)."""
         self.serve_ledger_fractions = dict(fractions)
         self.serve_decode_utilization = utilization
         self.serve_masked_row_waste = masked_waste
         self.serve_slo_violations = int(slo_violations)
+        if slo_by_group is not None:
+            self.serve_slo_by_group = dict(slo_by_group)
 
     def snapshot(self) -> dict[str, Any]:
         """Point-in-time view for the export endpoint. Rolling rates come
@@ -519,6 +542,22 @@ class ProcessLedger:
                     self.serve_masked_row_waste, 4
                 )
             out["serve_slo_violations"] = self.serve_slo_violations
+            # Mergeable histogram view (ISSUE 14): cumulative bucket
+            # counts /metrics renders in the Prometheus histogram
+            # convention and the fleet observatory SUMS across replicas
+            # — the per-replica percentile gauges above cannot merge.
+            if self._serve_ttft_hist.count:
+                out["serve_ttft_hist"] = self._serve_ttft_hist.to_dict()
+            if self._serve_itl_hist.count:
+                out["serve_itl_hist"] = self._serve_itl_hist.to_dict()
+            if self.serve_slo_by_group:
+                out["serve_slo_by_group"] = dict(
+                    sorted(self.serve_slo_by_group.items())
+                )
+            if self.serve_requests_by_group:
+                out["serve_requests_by_group"] = dict(
+                    sorted(self.serve_requests_by_group.items())
+                )
             if self.serve_pages_total:
                 out["serve_pages_free"] = self.serve_pages_free
                 if self.serve_prefix_lookups:

@@ -149,10 +149,12 @@ class ServeLedger:
         # Speculative economics.
         self.spec_drafted = 0      # draft tokens sent to verify blocks
         self.spec_accepted = 0     # draft tokens the model agreed with
-        # SLO accounting.
+        # SLO accounting (per-group split feeds the fleet SLO rates,
+        # ISSUE 14).
         self.slo_violations = 0
         self.slo_ttft_violations = 0
         self.slo_itl_violations = 0
+        self.slo_by_group: dict[str, int] = {}
         # Per-group latency reservoirs.
         self._ttft: dict[str, list[float]] = {}
         self._itl: dict[str, list[float]] = {}
@@ -228,24 +230,36 @@ class ServeLedger:
         if len(r) < _RESERVOIR:
             r.append(float(itl_s))
 
-    def check_ttft(self, ttft_s: float | None) -> bool:
-        """True (and counted) when the declared TTFT SLO is violated."""
+    def _count_group(self, group: str | None) -> None:
+        if group:
+            self.slo_by_group[group] = self.slo_by_group.get(group, 0) + 1
+
+    def check_ttft(
+        self, ttft_s: float | None, group: str | None = None
+    ) -> bool:
+        """True (and counted, split by traffic group when given) when
+        the declared TTFT SLO is violated."""
         if self.slo_ttft_s is None or ttft_s is None:
             return False
         if ttft_s > self.slo_ttft_s:
             self.slo_violations += 1
             self.slo_ttft_violations += 1
+            self._count_group(group)
             return True
         return False
 
-    def check_itl(self, itl_s: float | None) -> bool:
-        """True (and counted) when one decode tick's per-token latency
-        violated the declared ITL SLO."""
+    def check_itl(
+        self, itl_s: float | None, group: str | None = None
+    ) -> bool:
+        """True (and counted, split by traffic group when given) when
+        one decode tick's per-token latency violated the declared ITL
+        SLO."""
         if self.slo_itl_s is None or itl_s is None:
             return False
         if itl_s > self.slo_itl_s:
             self.slo_violations += 1
             self.slo_itl_violations += 1
+            self._count_group(group)
             return True
         return False
 
@@ -291,6 +305,7 @@ class ServeLedger:
             "slo_violations": self.slo_violations,
             "slo_ttft_violations": self.slo_ttft_violations,
             "slo_itl_violations": self.slo_itl_violations,
+            "slo_by_group": dict(sorted(self.slo_by_group.items())),
             "ttft": {
                 g: percentiles(r) for g, r in sorted(self._ttft.items())
             },

@@ -185,6 +185,10 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
         headline["serve_slo_violations"] = int(
             counters["serve.slo_violations"]
         )
+    # Fleet observatory (ISSUE 14): how many replicas the fleet poller
+    # tracked, so run.json says "this was a fleet run" at a glance.
+    if "fleet.size" in gauges:
+        headline["fleet_replicas"] = int(gauges["fleet.size"]["last"])
 
     # Training-health view (ISSUE 3): anomaly/rollback/profile events +
     # last numerics gauges, with headline counts so a glance at run.json

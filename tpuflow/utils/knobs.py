@@ -49,6 +49,7 @@ _A_CKPT_SUB = "checkpoint-subsystem"
 _A_HEALTH = "training-health-runbook"
 _A_STEP = "step-pipeline--performance-runbook"
 _A_SERVE = "serving-runbook"
+_A_FLEET = "fleet-observability-runbook"
 _A_QUANT = "quantization-runbook"
 _A_OBS = "goodput--live-monitoring-runbook"
 _A_OBS_BASE = "observability"
@@ -408,6 +409,36 @@ REGISTRY: dict[str, Knob] = dict(
            "declared inter-token-latency SLO in ms, checked per decode "
            "tick (tick wall / tokens committed)", "serve", _A_SERVE,
            default_doc="off"),
+        # ---------------------------------------------------------- fleet
+        _k("TPUFLOW_FLEET_REPLICAS", "list", None,
+           "comma list of replica /status base URLs the fleet "
+           "observatory polls; a hostname resolving to multiple A "
+           "records (headless Service) expands to one replica per pod",
+           "fleet", _A_FLEET, default_doc="unset"),
+        _k("TPUFLOW_FLEET_REGISTRATION_DIR", "path", None,
+           "file-based replica registry: every exporting process stamps "
+           "replica-<id>.json here at export start, and the fleet "
+           "observatory discovers the fleet from the directory",
+           "fleet", _A_FLEET),
+        _k("TPUFLOW_FLEET_POLL_S", "float", 5.0,
+           "fleet poll cadence (also the base of the per-replica "
+           "failure backoff)", "fleet", _A_FLEET),
+        _k("TPUFLOW_FLEET_STALE_S", "float", 15.0,
+           "seconds without a successful /status poll before a replica "
+           "is marked stale (health score 0; fleet.replica_stale event)",
+           "fleet", _A_FLEET),
+        _k("TPUFLOW_FLEET_HIST_BUCKETS", "list", None,
+           "comma TTFT/ITL histogram bucket upper edges in seconds "
+           "(strictly increasing); every replica of a fleet must agree "
+           "or its buckets cannot merge", "fleet", _A_FLEET,
+           default_doc="1ms..10s ladder"),
+        _k("TPUFLOW_FLEET_SNAPSHOT_PATH", "path", None,
+           "append one fleet-snapshot JSON line per poll here "
+           "(post-hoc analysis trail)", "fleet", _A_FLEET),
+        _k("TPUFLOW_FLEET_REPLICA_ID", "str", None,
+           "replica identity stamped into /status and the registration "
+           "file (the serving Deployment sets it from the pod name; "
+           "default host-pid)", "fleet", _A_FLEET, internal=True),
         # -------------------------------------------------------- testing
         _k("TPUFLOW_FAULT", "str", None,
            "comma-separated fault-injection specs (chaos suite)",
@@ -476,6 +507,7 @@ _SUBSYSTEM_TITLES = (
     ("ops", "Kernels & dispatch"),
     ("quant", "Quantization"),
     ("serve", "Serving"),
+    ("fleet", "Fleet observatory"),
     ("testing", "Fault injection & testing"),
     ("bench", "Benchmark"),
     ("e2e", "On-chip e2e"),
