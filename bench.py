@@ -84,6 +84,32 @@ def _record_fleet_snapshot(rec: dict, leg: str) -> None:
         rec["fleet_snapshot_error"] = repr(e)[:200]
 
 
+def _record_device_ledger(rec: dict, engine, leg: str) -> None:
+    """Persist this serving leg's per-program compile/memory ledger
+    (ISSUE 15) beside the bench records and stamp ``hbm_peak_frac`` so
+    the next chip window's evidence carries device residency, not just
+    tokens/s. AOT collection never touches the jit dispatch cache, so
+    the leg's compile_stats record stays truthful."""
+    try:
+        from tpuflow.obs import device as _device
+
+        out_dir = knobs.raw("TPUFLOW_BENCH_DIR") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "tpuflow_bench"
+        )
+        path = os.path.join(
+            out_dir, f"programs_{leg.replace('.', '_')}.json"
+        )
+        ledger = engine.collect_program_ledger(path=path)
+        rec["programs_ledger_path"] = path
+        if ledger.budget and "resident_frac" in ledger.budget:
+            rec["program_resident_frac"] = ledger.budget["resident_frac"]
+        snap = _device.hbm_snapshot()
+        if snap and snap.get("peak") and snap.get("limit"):
+            rec["hbm_peak_frac"] = round(snap["peak"] / snap["limit"], 4)
+    except Exception as e:  # evidence trail must not erase the leg
+        rec["device_ledger_error"] = repr(e)[:200]
+
+
 # On-TPU evidence ledger (committed to the repo): every bench leg that
 # actually executed on the TPU platform persists its record here the moment
 # it succeeds, so a tunnel that is healthy mid-round but dead at round-end
@@ -739,6 +765,7 @@ def bench_serving(model, params, cfg, on_tpu: bool) -> dict:
         "compile_stats": engine.compile_stats(),
     }
     _record_fleet_snapshot(rec, "serving")
+    _record_device_ledger(rec, engine, "serving")
     try:
         rec["paged"] = bench_serving_paged(model, params, cfg, on_tpu)
     except Exception as e:  # the paged sub-leg must not erase the record
@@ -905,6 +932,7 @@ def bench_serving_paged(model, params, cfg, on_tpu: bool) -> dict:
         "compile_stats": paged_eng.compile_stats(),
     }
     _record_fleet_snapshot(rec, "serving.paged")
+    _record_device_ledger(rec, paged_eng, "serving.paged")
     _log(f"[bench] serving.paged: {rec}")
     return rec
 
@@ -2357,6 +2385,15 @@ def _compact_summary(record: dict, train) -> dict:
             "decode_fraction": warm.get("decode_fraction"),
             "idle_fraction": warm.get("idle_fraction"),
         }
+        # Device observatory (ISSUE 15): residency evidence rides the
+        # digest so a chip window's record says how close to the HBM
+        # limit the serving leg lived (keys absent off-TPU).
+        if isinstance(serving.get("hbm_peak_frac"), (int, float)):
+            digest["serving"]["hbm_peak_frac"] = serving["hbm_peak_frac"]
+        if serving.get("programs_ledger_path"):
+            digest["serving"]["programs_ledger"] = serving[
+                "programs_ledger_path"
+            ]
     # Paged-KV serving verdicts (ISSUE 11): equal-HBM paged-vs-slot
     # tokens/s, residency efficiency, prefix-cache hit rate, and the
     # engine-speculative acceptance + exactness the exit-3/6 gates read.
@@ -2378,6 +2415,14 @@ def _compact_summary(record: dict, train) -> dict:
             "idle_fraction": paged.get("paged", {}).get("idle_fraction"),
             "itl_p99_s": paged.get("paged", {}).get("itl_p99_s"),
         }
+        if isinstance(paged.get("hbm_peak_frac"), (int, float)):
+            digest["serving_paged"]["hbm_peak_frac"] = paged[
+                "hbm_peak_frac"
+            ]
+        if paged.get("programs_ledger_path"):
+            digest["serving_paged"]["programs_ledger"] = paged[
+                "programs_ledger_path"
+            ]
     int8 = ev_train.get("decode", {}).get("int8", {})
     for mode in ("weight_only", "fused_native", "weight", "mxu"):
         # Current sub-leg names first; the legacy r5 names keep older

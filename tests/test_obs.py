@@ -215,14 +215,28 @@ def test_disabled_overhead_unmeasurable_per_step(monkeypatch):
     None`` for the health monitor); bound it at ~5µs/call (two orders of
     magnitude above its real cost, far below any train step) so the
     guard never flakes."""
+    from tpuflow.obs import device as device_mod
+    from tpuflow.obs import profcap as profcap_mod
     from tpuflow.obs.health import HealthMonitor
     from tpuflow.train.step import StepClock
 
     monkeypatch.setenv("TPUFLOW_HEALTH", "0")
+    monkeypatch.delenv("TPUFLOW_PROF_TRIGGER", raising=False)
     monitor = HealthMonitor.from_env()
     assert monitor is None  # TPUFLOW_HEALTH=0 removes the monitor
+    # Device observatory (ISSUE 15) disarmed paths: the capturer is
+    # None without TPUFLOW_PROF_TRIGGER (StepClock pays one `is not
+    # None` per fence) and the HBM poller self-disables after the first
+    # off-TPU probe (one module-bool check thereafter) — both inside
+    # the same µs/call bound as the rest of the hot-path hooks.
+    profcap_mod._reset_for_tests()
+    assert profcap_mod.maybe_from_env() is None
+    device_mod._reset_for_tests()
+    device_mod.maybe_emit_hbm(force=True)  # CPU probe → self-disable
+    assert device_mod._POLL_OFF
     clock = StepClock()
     assert clock.recording is False
+    assert clock._cap is None  # disarmed detector: the one-check path
     n = 10_000
     t0 = time.perf_counter()
     for _ in range(n):
@@ -230,6 +244,7 @@ def test_disabled_overhead_unmeasurable_per_step(monkeypatch):
             pass
         clock.step_done(tokens=64)
         obs.counter("train.tokens", 64)
+        device_mod.maybe_emit_hbm()  # disarmed: one bool check
         # The loops' per-step health gate when both knobs are off: one
         # None check + one bool — they never host-copy the numerics.
         if monitor is not None or clock.recording:
@@ -364,6 +379,15 @@ def test_obs_catalog_lint():
         ("gauge", "fleet.size"),
         ("gauge", "fleet.qps"),
         ("event", "fleet.replica_stale"),
+        # Device observatory (ISSUE 15) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
+        # program ledger, HBM gauges, budget verdicts, triggered capture.
+        ("event", "device.program"),
+        ("gauge", "device.hbm_used"),
+        ("gauge", "device.hbm_peak"),
+        ("gauge", "device.hbm_limit"),
+        ("event", "device.hbm_budget"),
+        ("event", "prof.capture"),
         # Native int8 decode (ISSUE 9) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
         ("span", "serve.quant_decode"),

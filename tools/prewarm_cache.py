@@ -135,6 +135,14 @@ def prewarm(args) -> dict:
             pass
 
     from tpuflow.models.gpt2 import GPT2, GPT2Config
+    from tpuflow.obs import device as device_mod
+
+    # Device observatory (ISSUE 15): the prewarm pass holds every
+    # compiled executable anyway — record the same per-program
+    # compile/cost/memory ledger a live run writes, so an operator sees
+    # program footprints (and the static HBM budget verdict) BEFORE any
+    # gang launches.
+    ledger = device_mod.ProgramLedger(source="prewarm")
 
     t0 = time.monotonic()
     cfg = GPT2Config.from_preset(args.preset, seq_len=args.seq_len)
@@ -161,7 +169,12 @@ def prewarm(args) -> dict:
         # lower().compile() goes through the same backend compile path
         # the hot loop's first step would — the executable lands in the
         # persistent cache without executing anything.
-        step.lower(state, batch, rng).compile()
+        t_step = time.monotonic()
+        ledger.note_compiled(
+            "train.step",
+            step.lower(state, batch, rng).compile(),
+            compile_s=time.monotonic() - t_step,
+        )
         programs += 1
         if args.accum_steps > 1:
             # The comm-overlapped accumulation signature (ISSUE 10):
@@ -213,7 +226,12 @@ def prewarm(args) -> dict:
                     )
                     for k in ("x", "y")
                 }
-                ostep.lower(sstate, obatch, rng).compile()
+                t_step = time.monotonic()
+                ledger.note_compiled(
+                    "train.step.overlap",
+                    ostep.lower(sstate, obatch, rng).compile(),
+                    compile_s=time.monotonic() - t_step,
+                )
                 programs += 1
             del sstate
 
@@ -240,7 +258,15 @@ def prewarm(args) -> dict:
         # tool can never drift from the programs the scheduler replays
         # — ISSUE 11 moved the per-signature lowering into
         # ServeEngine.aot_lower when the paged/spec programs landed.
-        programs += engine.aot_lower(max_new_tokens=args.max_new)
+        programs += engine.aot_lower(
+            max_new_tokens=args.max_new, ledger=ledger
+        )
+
+    # Program ledger + static HBM budget verdict beside the cache (the
+    # operator's pre-launch footprint view; budget ratios absent off-TPU
+    # where memory_stats is None).
+    ledger.budget_check()
+    ledger_path = ledger.write(os.path.join(cache_dir, "programs.json"))
 
     try:
         entries = len([
@@ -249,7 +275,7 @@ def prewarm(args) -> dict:
         ])
     except OSError:
         entries = 0
-    return {
+    rec = {
         "cache_dir": cache_dir,
         "programs_compiled": programs,
         "cache_entries": entries,
@@ -257,6 +283,11 @@ def prewarm(args) -> dict:
         "backend": jax.default_backend(),
         "preset": args.preset,
     }
+    if ledger_path:
+        rec["programs_ledger_path"] = ledger_path
+        if ledger.budget:
+            rec["resident_bytes"] = ledger.budget.get("resident_bytes")
+    return rec
 
 
 def main(argv=None) -> int:

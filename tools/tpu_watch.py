@@ -224,6 +224,17 @@ def follow(url: str, interval: float, max_s: float) -> int:
                 flush=True,
             )
         else:
+            hbm = ""
+            if "hbm_used_frac" in st or "hbm_used_bytes" in st:
+                # Device observatory (ISSUE 15): HBM residency of the
+                # busiest local device — keys only exported when the
+                # backend reports memory_stats, so the segment simply
+                # disappears off-TPU.
+                hbm = (
+                    f" hbm={fmt(st, 'hbm_used_frac', '{:.2f}')}"
+                    f"/{fmt(st, 'hbm_peak_frac', '{:.2f}')}pk"
+                    f" ({fmt(st, 'hbm_used_bytes', '{:.2e}')}B)"
+                )
             serving = ""
             if "serve_slot_occupancy" in st:
                 # A serving process (tpuflow.infer.serve feeds these):
@@ -251,7 +262,7 @@ def follow(url: str, interval: float, max_s: float) -> int:
                 f"mfu={fmt(st, 'mfu', '{:.4f}')} "
                 f"goodput={fmt(st, 'goodput_fraction', '{:.3f}')} "
                 f"loss={fmt(st, 'loss', '{:.4f}')} "
-                f"up={fmt(st, 'uptime_s', '{:.0f}')}s" + serving,
+                f"up={fmt(st, 'uptime_s', '{:.0f}')}s" + hbm + serving,
                 flush=True,
             )
         time.sleep(interval)

@@ -428,6 +428,72 @@ def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> N
         if rows:
             buf.append(Table(rows, headers=["fleet metric", "value"]))
 
+    # Device observatory (ISSUE 15): a run whose device reported — HBM
+    # gauges, the compiled-program ledger, a static budget verdict, or
+    # an anomaly-triggered capture — gets a Device section mirroring
+    # `python -m tpuflow.obs device-summary`.
+    prog_events = [
+        e for e in events
+        if e.get("kind") == "event" and e.get("name") == "device.program"
+    ]
+    cap_events = [
+        e for e in events
+        if e.get("kind") == "event" and e.get("name") == "prof.capture"
+    ]
+    budget_events = [
+        e for e in events
+        if e.get("kind") == "event" and e.get("name") == "device.hbm_budget"
+    ]
+    if "device.hbm_used" in gauges or prog_events or cap_events:
+        buf.append(Markdown("## Device"))
+        rows = []
+        for name, label in (
+            ("device.hbm_used", "HBM used (last/max)"),
+            ("device.hbm_peak", "HBM peak (max)"),
+            ("device.hbm_limit", "HBM limit"),
+        ):
+            g = gauges.get(name)
+            if not g:
+                continue
+            val = f"{g.get('last', 0.0) / 2**30:.3f} GiB"
+            if "last/max" in label:
+                val += f" / {g.get('max', 0.0) / 2**30:.3f} GiB"
+            elif "(max)" in label:
+                val = f"{g.get('max', 0.0) / 2**30:.3f} GiB"
+            rows.append([label, val])
+        used_g = gauges.get("device.hbm_peak") or gauges.get(
+            "device.hbm_used"
+        )
+        limit_g = gauges.get("device.hbm_limit")
+        if used_g and limit_g and limit_g.get("last"):
+            rows.append([
+                "HBM peak fraction",
+                f"{used_g.get('max', 0.0) / limit_g['last']:.3f}",
+            ])
+        if prog_events:
+            programs = sorted(
+                {str(e.get("program")) for e in prog_events if e.get("program")}
+            )
+            rows.append(["compiled programs in ledger", f"{len(programs)}"])
+            rows.append(["programs", ", ".join(programs[:12])])
+        if budget_events:
+            b = budget_events[-1]
+            verdict = f"{float(b.get('resident_bytes', 0.0)) / 2**30:.3f} GiB resident"
+            if b.get("resident_frac") is not None:
+                verdict += (
+                    f" = {100.0 * float(b['resident_frac']):.1f}% of limit"
+                )
+            if b.get("over"):
+                verdict += " [OVER]"
+            rows.append(["static HBM budget", verdict])
+        if cap_events:
+            rows.append(["triggered captures", f"{len(cap_events):,d}"])
+            reasons = [str(e.get("reason")) for e in cap_events if e.get("reason")]
+            if reasons:
+                rows.append(["capture reasons", ", ".join(reasons[:8])])
+        if rows:
+            buf.append(Table(rows, headers=["device metric", "value"]))
+
     spans = [
         e for e in events if e.get("kind") == "span" and e.get("dur_s", 0) > 0
     ]

@@ -157,6 +157,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpuflow import obs
+from tpuflow.obs import device as _device
+from tpuflow.obs import profcap as _profcap
 from tpuflow.obs import serve_ledger as _ledger
 from tpuflow.infer.generate import (
     chunked_prefill,
@@ -653,6 +655,10 @@ class ServeEngine:
             slo_ttft_s=_ledger.resolve_slo_s("TPUFLOW_SERVE_SLO_TTFT_MS"),
             slo_itl_s=_ledger.resolve_slo_s("TPUFLOW_SERVE_SLO_ITL_MS"),
         )
+        # Device observatory (ISSUE 15): the anomaly-armed profiler
+        # capturer (None unless TPUFLOW_PROF_TRIGGER — the disarmed
+        # path is one `is not None` check per decode tick).
+        self._profcap = _profcap.maybe_from_env()
 
         S = self.max_slots
         # Paged KV (ISSUE 11): the pool geometry + the per-slot page
@@ -1162,6 +1168,10 @@ class ServeEngine:
             value=round(value, 6), limit_s=limit_s, group=req.group,
         )
         obs.counter("serve.slo_violations", 1)
+        if self._profcap is not None:
+            # Direct capture trigger (ISSUE 15): a declared-SLO breach
+            # is exactly the moment a device trace answers "why".
+            self._profcap.note_slo_breach(kind)
 
     def _access_write(self, req: ServeRequest, terminal: str) -> None:
         """One access-log line at the request's terminal transition
@@ -1363,6 +1373,14 @@ class ServeEngine:
             None if pool is None else pool.prefix_hits,
         )
         fr = self.ledger.fractions()
+        if self._iters % 64 == 0:
+            # Device observatory (ISSUE 15): throttled HBM poll on the
+            # fence the scheduler already pays (self-disabling off-TPU;
+            # one bool check thereafter), and the capture governor's
+            # wall-deadline check for traces armed between decode ticks.
+            _device.maybe_emit_hbm()
+            if self._profcap is not None:
+                self._profcap.poll()
         if state != self._last_gauges or self._iters % 64 == 0:
             self._last_gauges = state
             obs.gauge("serve.queue_depth", state[0])
@@ -1533,6 +1551,10 @@ class ServeEngine:
                     req.itl_s.append(itl)
                     self.ledger.note_itl(req.group, itl)
                     led.note_serve_itl(itl)
+                    if self._profcap is not None:
+                        # Median+MAD ITL spike detector (ISSUE 15); the
+                        # same call advances a live capture's bound.
+                        self._profcap.observe_itl(itl)
                 req.t_last_tick = now
                 if spec:
                     self._trace(
@@ -1674,6 +1696,17 @@ class ServeEngine:
         from tpuflow.dist import maybe_enable_compile_cache
 
         maybe_enable_compile_cache(run_dir)
+        # Per-program compile fences (ISSUE 15): each first execution
+        # below IS that program's trace+compile(-or-cache-load) wall, so
+        # a couple of monotonic reads per program give the device
+        # ledger its warmup-side compile_s entries for free. The AOT
+        # path (collect_program_ledger / prewarm) later enriches the
+        # same names with cost/memory analysis.
+        marks: list[tuple[str, float]] = []
+
+        def _fence(name: str, t0: float):
+            marks.append((name, time.monotonic() - t0))
+
         with obs.span(
             "serve.warmup", buckets=len(self.buckets),
             quant=self.quant_mode or "off", paged=self.paged,
@@ -1682,30 +1715,38 @@ class ServeEngine:
             row_cache = None
             for w in self.buckets:
                 chunk = normalize_prefill_chunk(self.prefill_chunk, w)
+                t0 = time.monotonic()
                 _, row_cache = self._prefill(
                     self.params,
                     jnp.zeros((1, w), jnp.int32),
                     prompt_lens_to_pad_lens([w], 1, w),
                     chunk=chunk,
                 )
+                _fence(f"prefill@{w}", t0)
                 if self.quant_mode is not None:
                     # The int8 prefill ladder compiles beside the fp one
                     # — a quantize=True admission must be a cache hit.
+                    t0 = time.monotonic()
                     _, row_cache = self._prefill_q(
                         self._qparams,
                         jnp.zeros((1, w), jnp.int32),
                         prompt_lens_to_pad_lens([w], 1, w),
                         chunk=chunk,
                     )
+                    _fence(f"prefill_q@{w}", t0)
             if row_cache is not None:
                 # First insert: the fresh (uncommitted) init cache.
+                t0 = time.monotonic()
                 self._cache = self._insert(
                     self._cache, row_cache, *self._insert_warm_args()
                 )
+                _fence("insert", t0)
+            t0 = time.monotonic()
             out = self._decode(
                 self.params, self._cache, *self._decode_warm_args()
             )
             self._cache = out[0]
+            _fence("decode", t0)
             if self.spec_draft:
                 # The verify block (and below, its int8 twin): dead-slot
                 # drafts of zeros exercise the exact (S, K+1) signature
@@ -1713,6 +1754,7 @@ class ServeEngine:
                 zdraft = jnp.zeros(
                     (self.max_slots, self.spec_draft), jnp.int32
                 )
+                t0 = time.monotonic()
                 out = self._verify(
                     self.params, self._cache,
                     jnp.asarray(self._page_table), self._tok, zdraft,
@@ -1720,14 +1762,18 @@ class ServeEngine:
                     self._live, self._eos,
                 )
                 self._cache = out[0]
+                _fence("verify", t0)
             if self.quant_mode is not None:
                 # The int8 decode block on the decode-committed cache —
                 # the exact signature the mixed-traffic scheduler replays.
+                t0 = time.monotonic()
                 out = self._decode_q(
                     self._qparams, self._cache, *self._decode_warm_args()
                 )
                 self._cache = out[0]
+                _fence("decode_q", t0)
                 if self.spec_draft:
+                    t0 = time.monotonic()
                     out = self._verify_q(
                         self._qparams, self._cache,
                         jnp.asarray(self._page_table), self._tok, zdraft,
@@ -1735,6 +1781,7 @@ class ServeEngine:
                         self._live, self._eos,
                     )
                     self._cache = out[0]
+                    _fence("verify_q", t0)
             if row_cache is not None:
                 # Second insert: the steady-state signature — a cache
                 # COMMITTED by the decode program (with sharded params
@@ -1757,9 +1804,29 @@ class ServeEngine:
             jax.block_until_ready(self._cache)
             stats = self.compile_stats()
             sp.set(**stats)
+        if obs.recorder() is not None and knobs.get_bool(
+            "TPUFLOW_DEVICE_LEDGER"
+        ):
+            # Warmup-side device ledger (ISSUE 15): per-program compile
+            # wall into programs.json — a few buffered events and one
+            # small JSON write, nothing on the serving hot path.
+            try:
+                ledger = _device.ProgramLedger(source="warmup")
+                for name, dt in marks:
+                    ledger.note_entry(
+                        {"name": name, "compile_s": round(dt, 4)}
+                    )
+                ledger.write()
+            except Exception as e:
+                print(
+                    f"[tpuflow] warmup device ledger failed (ignored): "
+                    f"{e!r}"
+                )
         return stats
 
-    def aot_lower(self, max_new_tokens: int = 128) -> int:
+    def aot_lower(
+        self, max_new_tokens: int = 128, ledger=None
+    ) -> int:
         """AOT-lower (``jit(...).lower(...).compile()``) every program
         signature this engine replays — decode block, speculative verify,
         page/slot insert, and each admittable bucket's prefill, plus the
@@ -1769,28 +1836,52 @@ class ServeEngine:
         ``tools/prewarm_cache.py``'s whole job; the engine owns the
         signature list so the tool can't drift from the programs the
         scheduler actually runs. ``max_new_tokens`` prunes buckets the
-        run could never admit into. Returns the program count."""
-        pairs = [(self._prefill, self._decode, self._verify, self.params)]
+        run could never admit into. Returns the program count.
+
+        ``ledger`` (a ``tpuflow.obs.device.ProgramLedger``) records each
+        compiled program's wall-s + cost/memory analysis as it lands —
+        the AOT path holds the only object carrying both analyses, and
+        lowering here never touches the jit dispatch cache, so
+        ``compile_stats()`` is bitwise unchanged by ledger collection."""
+
+        def _compile(name, lowered):
+            t0 = time.monotonic()
+            compiled = lowered.compile()
+            if ledger is not None:
+                ledger.note_compiled(
+                    name, compiled, compile_s=time.monotonic() - t0
+                )
+            return compiled
+
+        pairs = [
+            ("", self._prefill, self._decode, self._verify, self.params)
+        ]
         if self.quant_mode is not None:
             pairs.append(
-                (self._prefill_q, self._decode_q, self._verify_q,
+                ("_q", self._prefill_q, self._decode_q, self._verify_q,
                  self._qparams)
             )
         programs = 0
         row_shape = None
-        for prefill, decode, verify, prm in pairs:
-            decode.lower(
-                prm, self._cache, *self._decode_warm_args()
-            ).compile()
+        for suffix, prefill, decode, verify, prm in pairs:
+            _compile(
+                f"decode{suffix}",
+                decode.lower(prm, self._cache, *self._decode_warm_args()),
+            )
             programs += 1
             if verify is not None:
-                verify.lower(
-                    prm, self._cache, jnp.asarray(self._page_table),
-                    self._tok,
-                    jnp.zeros((self.max_slots, self.spec_draft), jnp.int32),
-                    self._lengths, self._pads, self._remaining,
-                    self._live, self._eos,
-                ).compile()
+                _compile(
+                    f"verify{suffix}",
+                    verify.lower(
+                        prm, self._cache, jnp.asarray(self._page_table),
+                        self._tok,
+                        jnp.zeros(
+                            (self.max_slots, self.spec_draft), jnp.int32
+                        ),
+                        self._lengths, self._pads, self._remaining,
+                        self._live, self._eos,
+                    ),
+                )
                 programs += 1
             for w in self.buckets:
                 # Contiguous rows admit on the PADDED width, so buckets
@@ -1805,7 +1896,10 @@ class ServeEngine:
                     jnp.zeros((1, w), jnp.int32),
                     prompt_lens_to_pad_lens([w], 1, w),
                 )
-                prefill.lower(*pf_args, chunk=chunk).compile()
+                _compile(
+                    f"prefill{suffix}@{w}",
+                    prefill.lower(*pf_args, chunk=chunk),
+                )
                 programs += 1
                 row_shape = jax.eval_shape(
                     functools.partial(prefill, chunk=chunk), *pf_args
@@ -1815,11 +1909,31 @@ class ServeEngine:
             # no prefill ever executes). The decode-committed second
             # signature only diverges under sharded params; the engine's
             # own warmup() covers it at server start.
-            self._insert.lower(
-                self._cache, row_shape, *self._insert_warm_args()
-            ).compile()
+            _compile(
+                "insert",
+                self._insert.lower(
+                    self._cache, row_shape, *self._insert_warm_args()
+                ),
+            )
             programs += 1
         return programs
+
+    def collect_program_ledger(
+        self, max_new_tokens: int = 128, path: str | None = None
+    ):
+        """The engine's device ledger (ISSUE 15): AOT-compile every
+        signature through :meth:`aot_lower` with a recording ledger,
+        run the static HBM budget check, and persist ``programs.json``
+        (default: beside the recorder's event fragments). With the
+        persistent compile cache enabled the recompiles are cache
+        loads. The AOT path never touches the jit dispatch cache, so
+        ``compile_stats()`` is identical before and after — pinned by
+        tests/test_serve.py. Returns the ledger."""
+        ledger = _device.ProgramLedger(source="serve")
+        self.aot_lower(max_new_tokens=max_new_tokens, ledger=ledger)
+        ledger.budget_check()
+        ledger.write(path)
+        return ledger
 
 
 def serve_forever(
@@ -1845,6 +1959,21 @@ def serve_forever(
     from tpuflow.utils import heartbeat, preempt
 
     obs.maybe_start_export()
+    if obs.recorder() is not None and knobs.get_bool(
+        "TPUFLOW_DEVICE_LEDGER"
+    ):
+        # Device observatory (ISSUE 15): the full per-program
+        # cost/memory ledger at server start — with the persistent
+        # compile cache warm (warmup() just enabled it) the AOT
+        # recompiles are cache loads, and an operator sees every
+        # program's HBM footprint (plus the static budget verdict)
+        # BEFORE traffic arrives.
+        try:
+            engine.collect_program_ledger()
+        except Exception as e:
+            print(
+                f"[tpuflow] device program ledger failed (ignored): {e!r}"
+            )
     preempt.install_sigterm_handler()
     deadline = None if max_s is None else time.monotonic() + max_s
     draining = False

@@ -122,6 +122,14 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("gauge", "fleet.size"),
     ("gauge", "fleet.qps"),
     ("event", "fleet.replica_stale"),
+    # Device observatory (ISSUE 15): the per-program ledger, the HBM
+    # gauges, the static budget check, and triggered capture.
+    ("event", "device.program"),
+    ("gauge", "device.hbm_used"),
+    ("gauge", "device.hbm_peak"),
+    ("gauge", "device.hbm_limit"),
+    ("event", "device.hbm_budget"),
+    ("event", "prof.capture"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
     ("event", "ops.flash_bwd_fused"),

@@ -23,6 +23,12 @@ flush on a background thread (see ``recorder``).
 """
 
 from tpuflow.obs.catalog import CATALOG, is_registered, kind_of
+from tpuflow.obs.device import (
+    ProgramLedger,
+    device_summary,
+    hbm_snapshot,
+    maybe_emit_hbm,
+)
 from tpuflow.obs.export import (
     MetricsServer,
     maybe_start_from_env as maybe_start_export,
@@ -49,6 +55,7 @@ from tpuflow.obs.health import (
     TrainingDiverged,
     health_summary,
 )
+from tpuflow.obs.profcap import AnomalyCapturer, CaptureConfig
 from tpuflow.obs.serve_ledger import (
     GROUPS as SERVE_GROUPS,
     SERVE_BUCKETS,
@@ -81,7 +88,9 @@ from tpuflow.obs.timeline import (
 __all__ = [
     "AccessLog",
     "Anomaly",
+    "AnomalyCapturer",
     "CATALOG",
+    "CaptureConfig",
     "FleetObservatory",
     "GOODPUT_BUCKETS",
     "HealthConfig",
@@ -90,6 +99,7 @@ __all__ = [
     "MetricsServer",
     "ProcessLedger",
     "ProfileWindow",
+    "ProgramLedger",
     "Recorder",
     "SERVE_BUCKETS",
     "SERVE_GROUPS",
@@ -98,6 +108,7 @@ __all__ = [
     "compute_goodput",
     "configure",
     "counter",
+    "device_summary",
     "discover_replicas",
     "dump_flight",
     "enabled",
@@ -106,6 +117,7 @@ __all__ = [
     "flush",
     "gauge",
     "goodput_live",
+    "hbm_snapshot",
     "health_summary",
     "hist_pctl",
     "histogram",
@@ -113,6 +125,7 @@ __all__ = [
     "kind_of",
     "load_access_log",
     "load_run_events",
+    "maybe_emit_hbm",
     "maybe_start_export",
     "merge_run_events",
     "obs_dir",

@@ -1,6 +1,6 @@
 """Operator CLI: ``python -m tpuflow.obs <command> [target] [--json]``.
 
-Three commands, all jax-free and safe against a LIVE run from a login
+Four commands, all jax-free and safe against a LIVE run from a login
 shell:
 
 - ``summarize <run_dir>`` — the run's merged telemetry (the committed
@@ -11,6 +11,11 @@ shell:
   violations reproduced from the per-request ACCESS LOG alone (the same
   ``pctl`` math the live /metrics exporter uses), plus the engine-time
   ledger fractions when the event stream carries them.
+- ``device-summary <run_dir>`` — the device observatory (ISSUE 15):
+  the per-program compile/memory ledger reproduced from the
+  ``programs.json`` run artifact alone, the last HBM gauges, the static
+  budget verdict, and any anomaly-triggered ``prof.capture`` artifacts
+  — all file reads, no jax import.
 - ``fleet-summary [target]`` — the fleet observatory (ISSUE 14): poll
   every replica's /status once and print the fleet headline (summed
   load, occupancy-weighted utilization, fleet-exact TTFT/ITL
@@ -37,8 +42,8 @@ from tpuflow.obs.serve_ledger import (
 from tpuflow.obs.timeline import load_run_events, summarize
 
 _USAGE = (
-    "usage: python -m tpuflow.obs {summarize|serve-summary} <run_dir> "
-    "[--json]\n"
+    "usage: python -m tpuflow.obs "
+    "{summarize|serve-summary|device-summary} <run_dir> [--json]\n"
     "       python -m tpuflow.obs fleet-summary "
     "[<registration_dir>|<url,url,...>] [--json]"
 )
@@ -149,6 +154,73 @@ def _serve_summary(run_dir: str, as_json: bool) -> int:
     return 0
 
 
+def _device_summary(run_dir: str, as_json: bool) -> int:
+    from tpuflow.obs.device import device_summary, summarize_entry
+
+    s = device_summary(run_dir)
+    if not s:
+        print(
+            f"no device telemetry found under {run_dir} "
+            "(obs/programs.json, device.* gauges, prof.capture events "
+            "— armed by the device observatory, see the README "
+            "runbook)",
+            file=sys.stderr,
+        )
+        return 1
+    if as_json:
+        json.dump(s, sys.stdout, indent=2, sort_keys=True, default=str)
+        print()
+        return 0
+    programs = s.get("programs") or []
+    if programs:
+        print(f"programs: {len(programs)} ({s.get('programs_path')})")
+        print(
+            "  name             compile_s       flops    arg MiB"
+            "    out MiB   temp MiB"
+        )
+        for e in programs:
+            print(summarize_entry(e))
+    budget = s.get("budget") or {}
+    if budget:
+        line = (
+            f"budget: resident {budget.get('resident_bytes', 0) / 2**30:.3f}"
+            f" GiB over {budget.get('programs', len(programs))} programs"
+        )
+        if "resident_frac" in budget:
+            line += (
+                f" = {100.0 * budget['resident_frac']:.1f}% of "
+                f"{budget.get('bytes_limit', 0) / 2**30:.2f} GiB limit"
+                + (" [OVER]" if budget.get("over") else "")
+            )
+        print(line)
+    hbm = s.get("hbm") or {}
+    if hbm:
+        def gib(*keys):
+            for k in keys:
+                v = hbm.get(k)
+                if v is not None:
+                    return f"{v / 2**30:.3f}"
+            return "-"
+
+        print(
+            f"hbm: used {gib('hbm_used')} GiB "
+            f"(max {gib('hbm_used_max')})"
+            f"  peak {gib('hbm_peak_max', 'hbm_peak')}"
+            f"  limit {gib('hbm_limit')} GiB"
+        )
+    for cap in s.get("captures") or []:
+        print(
+            f"capture[{cap.get('capture', '?')}]: {cap.get('reason')} "
+            f"-> {cap.get('dir')}"
+            + (
+                f" (+{cap.get('memory_profile')})"
+                if cap.get("memory_profile")
+                else ""
+            )
+        )
+    return 0
+
+
 def _fleet_summary(target: str | None, as_json: bool) -> int:
     from tpuflow.obs import fleet
 
@@ -190,7 +262,9 @@ def _fleet_summary(target: str | None, as_json: bool) -> int:
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("-")]
     flags = {a for a in argv if a.startswith("-")}
-    commands = ("summarize", "serve-summary", "fleet-summary")
+    commands = (
+        "summarize", "serve-summary", "device-summary", "fleet-summary"
+    )
     if flags - {"--json"} or not args or args[0] not in commands:
         print(_USAGE, file=sys.stderr)
         return 2
@@ -207,6 +281,8 @@ def main(argv: list[str]) -> int:
         return 2
     if args[0] == "serve-summary":
         return _serve_summary(args[1], "--json" in flags)
+    if args[0] == "device-summary":
+        return _device_summary(args[1], "--json" in flags)
     return _summarize(args[1], "--json" in flags)
 
 

@@ -382,6 +382,50 @@ CATALOG: dict[str, tuple[str, str]] = {
     # -------------------------------------------------------------- device
     "device.bytes_in_use": ("gauge", "sampled per-device HBM bytes in use"),
     "device.peak_bytes_in_use": ("gauge", "per-device peak HBM bytes"),
+    # Device observatory (ISSUE 15): the per-program compile/memory
+    # ledger, the throttled HBM gauges the StepClock/ServeEngine fences
+    # feed, and the static budget check — emitted by tpuflow.obs.device,
+    # read by `python -m tpuflow.obs device-summary`, the timeline
+    # card's Device section, and the tpu_watch HBM segments.
+    "device.program": (
+        "event",
+        "one compiled XLA program's ledger entry (program, compile_s, "
+        "cost_analysis flops/bytes-accessed, memory_analysis argument/"
+        "output/temp/generated-code bytes — absent keys where the "
+        "backend can't report); the same record lands in the "
+        "programs.json run artifact",
+    ),
+    "device.hbm_used": (
+        "gauge",
+        "HBM bytes in use on the busiest local device "
+        "(memory_stats()['bytes_in_use'] max over devices), polled at "
+        "the fences the hot loops already pay (TPUFLOW_DEVICE_POLL_S)",
+    ),
+    "device.hbm_peak": (
+        "gauge",
+        "peak HBM bytes on the busiest local device since process "
+        "start (memory_stats()['peak_bytes_in_use'] max over devices)",
+    ),
+    "device.hbm_limit": (
+        "gauge",
+        "allocatable HBM bytes of the tightest local device "
+        "(memory_stats()['bytes_limit'] min over devices — the device "
+        "that OOMs first)",
+    ),
+    "device.hbm_budget": (
+        "event",
+        "static HBM budget verdict: resident program temp+argument "
+        "bytes summed over the compiled inventory vs bytes_limit "
+        "(over=True warns BEFORE an OOM; ratio keys absent off-TPU)",
+    ),
+    # -------------------------------------------------------------- prof
+    "prof.capture": (
+        "event",
+        "one anomaly-triggered bounded profiler capture committed "
+        "(reason=step_time|itl|slo_ttft|slo_itl|nonfinite, trace dir, "
+        "device-memory dump path, governor counters) — tpuflow.obs."
+        "profcap, armed by TPUFLOW_PROF_TRIGGER",
+    ),
     # -------------------------------------------------------------- health
     # Training-health observatory (ISSUE 3): per-step numerics computed
     # inside the jitted train step, emitted through the StepClock fences,

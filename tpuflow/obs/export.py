@@ -52,6 +52,14 @@ _PROM_SPEC = (
     ("tpuflow_loss", "loss", "gauge"),
     ("tpuflow_grad_norm", "grad_norm", "gauge"),
     ("tpuflow_nonfinite_steps_total", "nonfinite_steps", "counter"),
+    # Device observatory (ISSUE 15): HBM residency of the busiest local
+    # device (limit = the tightest device's); keys only present when
+    # the backend reports memory_stats — absent off-TPU, never zeroed.
+    ("tpuflow_hbm_used_bytes", "hbm_used_bytes", "gauge"),
+    ("tpuflow_hbm_peak_bytes", "hbm_peak_bytes", "gauge"),
+    ("tpuflow_hbm_limit_bytes", "hbm_limit_bytes", "gauge"),
+    ("tpuflow_hbm_used_frac", "hbm_used_frac", "gauge"),
+    ("tpuflow_hbm_peak_frac", "hbm_peak_frac", "gauge"),
     # Serving engine (tpuflow.infer.serve): keys only present when an
     # engine feeds this process's ledger, omitted on training runs.
     ("tpuflow_serve_requests_total", "serve_requests", "counter"),

@@ -497,6 +497,25 @@ def aggregate(statuses: list[dict]) -> dict[str, Any]:
     ]
     if occs:
         out["slot_occupancy"] = round(sum(occs) / len(occs), 4)
+    # HBM headroom (ISSUE 15): the router's admission constraint is the
+    # TIGHTEST replica, so the fleet view carries the max used/peak
+    # fraction and the min headroom — a fleet-mean would hide the one
+    # replica about to OOM. Keys absent when no replica reports (CPU).
+    hbm_fracs = [
+        s["hbm_used_frac"] for s in statuses
+        if isinstance(s.get("hbm_used_frac"), (int, float))
+    ]
+    if hbm_fracs:
+        out["hbm_used_frac_max"] = round(max(hbm_fracs), 4)
+        out["hbm_min_headroom_frac"] = round(
+            min(1.0 - f for f in hbm_fracs), 4
+        )
+    hbm_peaks = [
+        s["hbm_peak_frac"] for s in statuses
+        if isinstance(s.get("hbm_peak_frac"), (int, float))
+    ]
+    if hbm_peaks:
+        out["hbm_peak_frac_max"] = round(max(hbm_peaks), 4)
     # Fleet-exact latency percentiles: merged histogram counts are the
     # counts of the pooled observations, bit for bit.
     for which in ("ttft", "itl"):
@@ -749,6 +768,7 @@ class FleetObservatory:
                     "serve_decode_utilization", "serve_idle_fraction",
                     "serve_decode_fraction", "serve_ttft_p99_s",
                     "serve_itl_p99_s", "uptime_s", "step", "mfu",
+                    "hbm_used_frac", "hbm_peak_frac",
                 ):
                     if key in rep.status:
                         row[key] = rep.status[key]
@@ -781,7 +801,7 @@ def format_fleet_line(fleet: dict) -> str:
     share."""
     t = (fleet.get("ttft") or {})
     i = (fleet.get("itl") or {})
-    return (
+    line = (
         f"fleet n={fleet.get('replicas', 0)} "
         f"healthy={fleet.get('healthy', 0)} "
         f"stale={fleet.get('stale', 0)} "
@@ -793,6 +813,13 @@ def format_fleet_line(fleet: dict) -> str:
         f"itl99={_fmt(i.get('p99'), '{:.4f}')}s "
         f"slo={_fmt(fleet.get('slo_violations'), '{:.0f}')}"
     )
+    if fleet.get("hbm_used_frac_max") is not None:
+        # Device observatory (ISSUE 15): the tightest replica's HBM.
+        line += (
+            f" hbm={_fmt(fleet.get('hbm_used_frac_max'), '{:.2f}')}"
+            f"/{_fmt(fleet.get('hbm_peak_frac_max'), '{:.2f}')}pk"
+        )
+    return line
 
 
 def format_replica_line(row: dict) -> str:
@@ -805,7 +832,7 @@ def format_replica_line(row: dict) -> str:
     if row.get("stale"):
         err = f" [{row['error']}]" if row.get("error") else ""
         return base + f" STALE age={_fmt(row.get('age_s'), '{:.1f}')}s" + err
-    return base + (
+    line = base + (
         f" q={_fmt(row.get('serve_queue_depth'), '{:.0f}')} "
         f"occ={_fmt(row.get('serve_slot_occupancy'), '{:.2f}')} "
         f"tok/s={_fmt(row.get('serve_tokens_per_s'), '{:.0f}')} "
@@ -813,3 +840,6 @@ def format_replica_line(row: dict) -> str:
         f"slo={_fmt(row.get('serve_slo_violations'), '{:.0f}')} "
         f"done={_fmt(row.get('serve_requests'), '{:.0f}')}"
     )
+    if row.get("hbm_used_frac") is not None:
+        line += f" hbm={_fmt(row.get('hbm_used_frac'), '{:.2f}')}"
+    return line

@@ -304,6 +304,13 @@ class ProcessLedger:
         self.flops_per_token: float | None = None
         self.health: dict[str, float] = {}
         self.nonfinite_steps = 0
+        # Device observatory (ISSUE 15): the latest throttled HBM poll
+        # (tpuflow.obs.device.maybe_emit_hbm feeds these at the fences
+        # the loops already pay). None = no device has reported — the
+        # snapshot omits the hbm_* keys entirely (CPU backends).
+        self.hbm_used_bytes: int | None = None
+        self.hbm_peak_bytes: int | None = None
+        self.hbm_limit_bytes: int | None = None
         # Serving view (tpuflow.infer.serve feeds these each scheduler
         # iteration); zero serve_max_slots = no engine in this process,
         # and the snapshot omits the serve_* keys entirely.
@@ -387,6 +394,23 @@ class ProcessLedger:
         if isinstance(loss, (int, float)):
             self.health["loss"] = float(loss)
         self._mark()
+
+    def note_device_hbm(
+        self,
+        used: int | None,
+        peak: int | None,
+        limit: int | None,
+    ) -> None:
+        """One HBM poll (tpuflow.obs.device): bytes in use / peak on the
+        busiest local device, limit of the tightest. Peak is kept as a
+        running max so a between-polls spike the runtime reported once
+        is never lost from the snapshot."""
+        if used is not None:
+            self.hbm_used_bytes = int(used)
+        if peak is not None:
+            self.hbm_peak_bytes = max(int(peak), self.hbm_peak_bytes or 0)
+        if limit is not None:
+            self.hbm_limit_bytes = int(limit)
 
     def note_health(
         self, loss: float, grad_norm: float, nonfinite: bool
@@ -500,6 +524,22 @@ class ProcessLedger:
             "goodput_fraction": round(self.productive_s / wall, 4),
             "nonfinite_steps": self.nonfinite_steps,
         }
+        # Device observatory (ISSUE 15): HBM residency keys only when a
+        # device has reported memory_stats — absent off-TPU, never 0.
+        if self.hbm_used_bytes is not None:
+            out["hbm_used_bytes"] = self.hbm_used_bytes
+        if self.hbm_peak_bytes is not None:
+            out["hbm_peak_bytes"] = self.hbm_peak_bytes
+        if self.hbm_limit_bytes is not None:
+            out["hbm_limit_bytes"] = self.hbm_limit_bytes
+            if self.hbm_used_bytes is not None:
+                out["hbm_used_frac"] = round(
+                    self.hbm_used_bytes / self.hbm_limit_bytes, 4
+                )
+            if self.hbm_peak_bytes is not None:
+                out["hbm_peak_frac"] = round(
+                    self.hbm_peak_bytes / self.hbm_limit_bytes, 4
+                )
         if self.serve_max_slots:
             out["serve_requests"] = self.serve_requests
             out["serve_tokens"] = self.serve_tokens

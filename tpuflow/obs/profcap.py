@@ -1,0 +1,325 @@
+"""Anomaly-triggered profiler capture (ISSUE 15): promote the static
+``TPUFLOW_PROFILE=start:stop`` window (PR 3's ``ProfileWindow``) into a
+detector-armed flight recorder for the device.
+
+The static window answers "profile steps 120-121" when an operator
+already knows where the stall is. Production anomalies don't announce
+their step numbers — so :class:`AnomalyCapturer` watches the
+observations the loops already produce and arms a **bounded**
+``jax.profiler`` trace + device-memory dump the moment one spikes:
+
+- **Step-time detector** — rolling median+MAD spike test over fenced
+  train-step wall times (mirrors ``HealthMonitor``'s loss-spike test:
+  robust statistics, the spike is never appended to its own baseline).
+- **ITL detector** — the same test over the serving engine's per-token
+  decode latencies.
+- **Direct triggers** — a declared-SLO breach (``serve.slo_violation``
+  path) and a nonfinite train step each arm a capture immediately.
+
+**Governor** (flight-recorder discipline): at most one capture in
+flight; a cooldown (``TPUFLOW_PROF_COOLDOWN_S``) between captures; a
+per-run cap (``TPUFLOW_PROF_MAX_CAPTURES``). A trace spans the next
+``TPUFLOW_PROF_TRACE_STEPS`` observations (with a hard wall-clock
+bound), then stops and dumps ``device_memory.prof`` beside it — whole-
+run traces are huge and skew steady-state timing; two anomalous steps
+are what a babysitter actually opens. Every capture is recorded as a
+``prof.capture`` event referencing the artifact directory.
+
+Trigger paths run inside the step/decode loops, so every profiler call
+is fenced by try/except and a failing backend disables capture for the
+run with one printed note — **never fatal, never raising into the hot
+loop**. The capture backend and clock are injectable so the governor is
+unit-testable without jax or real sleeps.
+
+Disarmed (``TPUFLOW_PROF_TRIGGER`` unset, the default) the whole module
+costs the loops one ``is not None`` check per observation — pinned by
+the tests/test_obs.py overhead guard.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import statistics
+import time
+from typing import Any
+
+from tpuflow.obs import recorder as _rec
+from tpuflow.utils import knobs
+
+
+@dataclasses.dataclass
+class CaptureConfig:
+    """Env-tunable capture policy (``TPUFLOW_PROF_*`` knobs)."""
+
+    z_mads: float = 8.0
+    cooldown_s: float = 300.0
+    max_captures: int = 3
+    trace_steps: int = 2
+    window: int = 64
+    warmup: int = 16
+    # Hard wall bound on one capture: a trigger fired from a path that
+    # stops producing observations (a draining server) must not leave
+    # the process-wide profiler running for the rest of the run.
+    max_trace_s: float = 60.0
+
+    @classmethod
+    def from_env(cls) -> "CaptureConfig":
+        return cls(
+            z_mads=knobs.get_float_lenient("TPUFLOW_PROF_ZMADS"),
+            cooldown_s=knobs.get_float_lenient("TPUFLOW_PROF_COOLDOWN_S"),
+            max_captures=max(
+                0, knobs.get_int_lenient("TPUFLOW_PROF_MAX_CAPTURES")
+            ),
+            trace_steps=max(
+                1, knobs.get_int_lenient("TPUFLOW_PROF_TRACE_STEPS")
+            ),
+        )
+
+
+class _JaxTracer:
+    """The real capture backend, isolated so tests inject a fake."""
+
+    def start(self, out_dir: str) -> None:
+        import jax
+
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+
+    def stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+
+    def memdump(self, path: str) -> None:
+        import jax
+
+        jax.profiler.save_device_memory_profile(path)
+
+
+class AnomalyCapturer:
+    """Detector + governor + bounded capture, one instance per process.
+
+    Feed it observations (``observe_step`` / ``observe_itl``) and direct
+    triggers (``note_slo_breach`` / ``note_nonfinite``); it decides when
+    a bounded profiler capture is warranted and owns its lifecycle. All
+    methods are exception-fenced — see the module docstring."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        cfg: CaptureConfig | None = None,
+        *,
+        tracer=None,
+        clock=time.monotonic,
+    ):
+        self.cfg = cfg or CaptureConfig.from_env()
+        self.out_dir = out_dir
+        self._tracer = tracer or _JaxTracer()
+        self._clock = clock
+        self._step_window: collections.deque = collections.deque(
+            maxlen=self.cfg.window
+        )
+        self._itl_window: collections.deque = collections.deque(
+            maxlen=self.cfg.window
+        )
+        self.captures = 0
+        self.suppressed = 0
+        self._last_capture_t: float | None = None
+        self._active: dict[str, Any] | None = None
+        self._broken = False
+
+    # ----------------------------------------------------------- detectors
+    def _judge(self, window, v: float) -> float | None:
+        """Median+MAD spike test: the threshold when ``v`` spikes, None
+        otherwise. Sigma floor mirrors HealthMonitor — a flat window
+        must not brand jitter an anomaly."""
+        if len(window) < self.cfg.warmup:
+            return None
+        med = statistics.median(window)
+        mad = statistics.median(abs(x - med) for x in window)
+        sigma = max(1.4826 * mad, 0.01 * abs(med), 1e-9)
+        threshold = med + self.cfg.z_mads * sigma
+        return threshold if v > threshold else None
+
+    def observe_step(self, dur_s: float, step: int | None = None) -> None:
+        """One fenced train step's wall time. While a capture is live
+        this only advances its bound (an anomalous window must not judge
+        or re-trigger against itself)."""
+        if self._active is not None:
+            self._advance()
+            return
+        threshold = self._judge(self._step_window, dur_s)
+        if threshold is not None:
+            # The spike is NOT appended — the window stays a pre-spike
+            # baseline for any follow-up judgment.
+            self.trigger(
+                "step_time", value=round(float(dur_s), 6),
+                threshold=round(threshold, 6), step=step,
+            )
+        else:
+            self._step_window.append(float(dur_s))
+
+    def observe_itl(self, itl_s: float) -> None:
+        """One decode tick's per-token latency (the serving twin)."""
+        if self._active is not None:
+            self._advance()
+            return
+        threshold = self._judge(self._itl_window, itl_s)
+        if threshold is not None:
+            self.trigger(
+                "itl", value=round(float(itl_s), 6),
+                threshold=round(threshold, 6),
+            )
+        else:
+            self._itl_window.append(float(itl_s))
+
+    def note_slo_breach(self, kind: str) -> None:
+        """A declared-SLO violation (ttft | itl) — direct trigger."""
+        if self._active is not None:
+            return
+        self.trigger(f"slo_{kind}")
+
+    def note_nonfinite(self, step: int | None = None) -> None:
+        """A nonfinite train step — direct trigger (the numerics went
+        bad; the trace shows what the device was doing when they did)."""
+        if self._active is not None:
+            return
+        self.trigger("nonfinite", step=step)
+
+    # ------------------------------------------------------------ governor
+    def trigger(self, reason: str, **detail) -> bool:
+        """Arm one bounded capture, subject to the governor: no
+        concurrent capture, the cooldown since the last, the per-run
+        cap. Suppressions are counted, never silent. Returns whether a
+        capture started."""
+        if self._broken:
+            return False
+        if self._active is not None:
+            self.suppressed += 1
+            return False
+        if self.captures >= self.cfg.max_captures:
+            self.suppressed += 1
+            return False
+        now = self._clock()
+        if (
+            self._last_capture_t is not None
+            and now - self._last_capture_t < self.cfg.cooldown_s
+        ):
+            self.suppressed += 1
+            return False
+        cap_dir = os.path.join(
+            self.out_dir, f"capture_{self.captures + 1:02d}_{reason}"
+        )
+        try:
+            self._tracer.start(cap_dir)
+        except Exception as e:
+            # A broken profiler backend must not re-fail every later
+            # trigger (and must never fail the run): disable for good.
+            self._broken = True
+            print(
+                "[tpuflow] triggered profiler capture failed to start "
+                f"(capture disabled for this run): {e!r}"
+            )
+            return False
+        self.captures += 1
+        self._last_capture_t = now
+        self._active = {
+            "reason": reason,
+            "dir": cap_dir,
+            "remaining": max(1, self.cfg.trace_steps),
+            "deadline": now + self.cfg.max_trace_s,
+            "detail": {k: v for k, v in detail.items() if v is not None},
+            "t0": now,
+        }
+        return True
+
+    def _advance(self) -> None:
+        a = self._active
+        a["remaining"] -= 1
+        if a["remaining"] <= 0 or self._clock() > a["deadline"]:
+            self._finish()
+
+    def poll(self) -> None:
+        """Deadline check for loops between observations (the serving
+        scheduler's periodic fence): finishes a wall-expired capture."""
+        if (
+            self._active is not None
+            and self._clock() > self._active["deadline"]
+        ):
+            self._finish()
+
+    def _finish(self) -> None:
+        a, self._active = self._active, None
+        try:
+            self._tracer.stop()
+        except Exception as e:
+            self._broken = True
+            print(f"[tpuflow] profiler trace stop failed (ignored): {e!r}")
+        mem_path: str | None = os.path.join(a["dir"], "device_memory.prof")
+        try:
+            self._tracer.memdump(mem_path)
+        except Exception:
+            # CPU backends have no device memory profile — the trace
+            # alone is still the artifact.
+            mem_path = None
+        _rec.event(
+            "prof.capture",
+            reason=a["reason"],
+            dir=a["dir"],
+            capture=self.captures,
+            dur_s=round(self._clock() - a["t0"], 4),
+            memory_profile=mem_path,
+            suppressed_so_far=self.suppressed,
+            **a["detail"],
+        )
+
+    def close(self) -> None:
+        """End-of-run safety net: an in-flight capture must not leave
+        the process-wide profiler running."""
+        if self._active is not None:
+            self._finish()
+
+
+# ------------------------------------------------------ process singleton
+_CAPTURER: AnomalyCapturer | None = None
+_CHECKED = False
+
+
+def maybe_from_env() -> AnomalyCapturer | None:
+    """The process's capturer, created once when ``TPUFLOW_PROF_TRIGGER``
+    is armed and an output dir resolves (the recorder's ``obs/profile``
+    dir, else ``TPUFLOW_PROF_DIR``). None otherwise — callers hold the
+    result and pay one ``is not None`` check per observation."""
+    global _CAPTURER, _CHECKED
+    if _CHECKED:
+        return _CAPTURER
+    _CHECKED = True
+    if not knobs.get_bool("TPUFLOW_PROF_TRIGGER"):
+        return None
+    out_dir = knobs.raw("TPUFLOW_PROF_DIR")
+    if not out_dir:
+        rec = _rec.recorder()
+        if rec is not None:
+            out_dir = os.path.join(rec.directory, "profile")
+    if not out_dir:
+        print(
+            "[tpuflow] TPUFLOW_PROF_TRIGGER set but telemetry is "
+            "disabled and TPUFLOW_PROF_DIR is unset; anomaly capture "
+            "disabled"
+        )
+        return None
+    _CAPTURER = AnomalyCapturer(out_dir)
+    return _CAPTURER
+
+
+def _reset_for_tests() -> None:
+    global _CAPTURER, _CHECKED
+    if _CAPTURER is not None:
+        try:
+            _CAPTURER.close()
+        except Exception:
+            pass
+    _CAPTURER = None
+    _CHECKED = False

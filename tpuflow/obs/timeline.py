@@ -189,6 +189,16 @@ def summarize(events: Iterable[dict]) -> dict[str, Any]:
     # tracked, so run.json says "this was a fleet run" at a glance.
     if "fleet.size" in gauges:
         headline["fleet_replicas"] = int(gauges["fleet.size"]["last"])
+    # Device observatory (ISSUE 15): peak HBM residency fraction, so a
+    # glance at run.json answers "how close to OOM did this run live".
+    hbm_peak = gauges.get("device.hbm_peak") or gauges.get(
+        "device.hbm_used"
+    )
+    hbm_limit = gauges.get("device.hbm_limit")
+    if hbm_peak and hbm_limit and hbm_limit.get("last"):
+        headline["hbm_peak_frac"] = round(
+            hbm_peak["max"] / hbm_limit["last"], 4
+        )
 
     # Training-health view (ISSUE 3): anomaly/rollback/profile events +
     # last numerics gauges, with headline counts so a glance at run.json

@@ -742,7 +742,22 @@ def _run_fsdp_generation(
                             jax.block_until_ready(metrics["loss"])
                             t_epoch = time.monotonic()
                             ts_epoch = time.time()
-                            clock.compile_done(preset=cfg.preset)
+                            compile_s = clock.compile_done(
+                                preset=cfg.preset
+                            )
+                            if compile_s is not None:
+                                # Device ledger compile-fence entry
+                                # (ISSUE 15): re-lowering is trace-only
+                                # (no XLA compile) — cost analysis +
+                                # compile wall for the step program.
+                                from tpuflow.obs import device as _devmod
+
+                                _devmod.note_jit_program(
+                                    "train.step",
+                                    train_step,
+                                    (state, batch, rng),
+                                    compile_s=compile_s,
+                                )
                             cold = False
                             opt_step += 1
                             settle((opt_step, metrics, 0, False))
@@ -1325,7 +1340,19 @@ def _train_pipeline(
                         global_step += 1
                         if first:
                             jax.block_until_ready(loss)
-                            clock.compile_done(mode="pipeline")
+                            compile_s = clock.compile_done(
+                                mode="pipeline"
+                            )
+                            if compile_s is not None:
+                                from tpuflow.obs import device as _devmod
+
+                                _devmod.note_jit_program(
+                                    "train.pp_step",
+                                    pp_step,
+                                    (params, opt_state, batch["x"],
+                                     batch["y"]),
+                                    compile_s=compile_s,
+                                )
                             first = False
                             settle((global_step, loss, hstats, 0, False))
                         else:

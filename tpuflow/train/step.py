@@ -23,7 +23,9 @@ from flax.training import train_state
 
 from tpuflow import obs
 from tpuflow.models.losses import accuracy, cross_entropy_loss
+from tpuflow.obs import device as _device
 from tpuflow.obs import goodput as _goodput
+from tpuflow.obs import profcap as _profcap
 from tpuflow.utils.heartbeat import beat as _heartbeat
 
 # Preemption surface of the train layer (ISSUE 2): gang_exec installs the
@@ -149,7 +151,13 @@ class StepClock:
 
     def __init__(self):
         self._on = obs.enabled()
-        self._last = time.monotonic() if self._on else 0.0
+        # Anomaly-triggered profiler capture (ISSUE 15): None unless
+        # TPUFLOW_PROF_TRIGGER — the disarmed hot path is one
+        # `is not None` check per fenced step (pinned by the
+        # tests/test_obs.py overhead guard).
+        self._cap = _profcap.maybe_from_env()
+        track = self._on or self._cap is not None
+        self._last = time.monotonic() if track else 0.0
         self._t0 = self._last
         self._ts0 = time.time() if self._on else 0.0
         self._steps = 0
@@ -162,23 +170,34 @@ class StepClock:
 
     def reset(self) -> None:
         """Restart the clock (epoch boundary / after the compile fence)."""
-        if self._on:
+        if self._on or self._cap is not None:
             self._last = time.monotonic()
 
-    def compile_done(self, **attrs) -> None:
-        """The cold first step just fenced: record it as train.compile."""
+    def compile_done(self, **attrs) -> float | None:
+        """The cold first step just fenced: record it as train.compile.
+        Returns the compile wall seconds when recording (the train legs
+        hand it to the device ledger's compile-fence entry), else None."""
         _heartbeat()
-        if self._on:
-            now = time.monotonic()
-            rec = obs.recorder()
-            if rec is not None:
-                rec.record(
-                    "span", "train.compile", ts=self._ts0,
-                    dur_s=now - self._t0, **attrs,
-                )
-            self._last = now
-            _goodput.live().note_compile(now - self._t0)
-            _goodput.emit_gauges()
+        if not (self._on or self._cap is not None):
+            return None
+        now = time.monotonic()
+        dur = now - self._t0
+        self._last = now
+        if not self._on:
+            return None
+        rec = obs.recorder()
+        if rec is not None:
+            rec.record(
+                "span", "train.compile", ts=self._ts0,
+                dur_s=dur, **attrs,
+            )
+        _goodput.live().note_compile(dur)
+        _goodput.emit_gauges()
+        # First post-compile HBM reading (ISSUE 15): the compiled
+        # programs' buffers just landed — the most informative poll of
+        # the run (self-disabling off-TPU).
+        _device.maybe_emit_hbm(force=True)
+        return dur
 
     def step_done(self, tokens: int = 0, step: int | None = None) -> None:
         """A steady-state step just fenced: record its wall time. Also
@@ -187,26 +206,40 @@ class StepClock:
         supervised gang), now carrying the CURRENT step number so a stall
         report can say where the member stopped."""
         _heartbeat(step)
-        if self._on:
-            now = time.monotonic()
-            dur = now - self._last
-            obs.histogram("train.step_s", dur)
-            if tokens:
-                obs.counter("train.tokens", tokens)
-            self._last = now
-            _goodput.live().note_step(dur, tokens=tokens, step=step)
-            self._steps += 1
-            if self._steps % 32 == 0:
-                # Periodic goodput-so-far gauges: cheap (three buffered
-                # records), and the event stream then carries the
-                # incremental ledger even for runs that die mid-epoch.
-                _goodput.emit_gauges()
+        cap = self._cap
+        if not self._on:
+            if cap is not None:
+                now = time.monotonic()
+                cap.observe_step(now - self._last, step)
+                self._last = now
+            return
+        now = time.monotonic()
+        dur = now - self._last
+        obs.histogram("train.step_s", dur)
+        if tokens:
+            obs.counter("train.tokens", tokens)
+        self._last = now
+        _goodput.live().note_step(dur, tokens=tokens, step=step)
+        if cap is not None:
+            # Median+MAD step-time spike detector (ISSUE 15); the same
+            # call advances a live capture's bound.
+            cap.observe_step(dur, step)
+        self._steps += 1
+        if self._steps % 32 == 0:
+            # Periodic goodput-so-far gauges: cheap (three buffered
+            # records), and the event stream then carries the
+            # incremental ledger even for runs that die mid-epoch.
+            _goodput.emit_gauges()
+            # HBM gauges ride the same cadence, throttled further by
+            # TPUFLOW_DEVICE_POLL_S (one bool check off-TPU).
+            _device.maybe_emit_hbm()
 
     def goodput_mark(self) -> None:
         """Epoch-fence hook: flush the goodput-so-far gauges so every
         epoch boundary has a fresh incremental ledger reading."""
         if self._on:
             _goodput.emit_gauges()
+            _device.maybe_emit_hbm()
 
     @property
     def recording(self) -> bool:
@@ -228,6 +261,10 @@ class StepClock:
         scalars were computed inside the jitted step and materialized by
         the fence the loop already paid — this only copies four floats
         into the event buffer. No-op when telemetry is disabled."""
+        if nonfinite and self._cap is not None:
+            # Direct capture trigger (ISSUE 15): the numerics went bad;
+            # the trace shows what the device was doing when they did.
+            self._cap.note_nonfinite()
         if not self._on:
             return
         obs.gauge("health.loss", loss)

@@ -50,6 +50,7 @@ _A_HEALTH = "training-health-runbook"
 _A_STEP = "step-pipeline--performance-runbook"
 _A_SERVE = "serving-runbook"
 _A_FLEET = "fleet-observability-runbook"
+_A_DEVICE = "device-observatory-runbook"
 _A_QUANT = "quantization-runbook"
 _A_OBS = "goodput--live-monitoring-runbook"
 _A_OBS_BASE = "observability"
@@ -439,6 +440,37 @@ REGISTRY: dict[str, Knob] = dict(
            "replica identity stamped into /status and the registration "
            "file (the serving Deployment sets it from the pod name; "
            "default host-pid)", "fleet", _A_FLEET, internal=True),
+        # --------------------------------------------------------- device
+        _k("TPUFLOW_DEVICE_POLL_S", "float", 10.0,
+           "HBM gauge poll cadence (s) at the fences the hot loops "
+           "already pay (0 disables; backends without memory_stats "
+           "disable themselves after the first probe)", "device",
+           _A_DEVICE),
+        _k("TPUFLOW_DEVICE_LEDGER", "bool", True,
+           "0 = skip per-program compile/memory ledger collection "
+           "(programs.json + device.program events) at the warmup/"
+           "compile fences and serve start", "device", _A_DEVICE),
+        _k("TPUFLOW_PROF_TRIGGER", "bool", False,
+           "1 = arm anomaly-triggered profiler capture: step-time/ITL "
+           "median+MAD spikes, SLO breaches, and nonfinite steps arm a "
+           "bounded jax.profiler trace + device memory dump", "device",
+           _A_DEVICE),
+        _k("TPUFLOW_PROF_ZMADS", "float", 8.0,
+           "anomaly trigger threshold in robust MADs above the rolling "
+           "median (step-time and ITL detectors)", "device", _A_DEVICE),
+        _k("TPUFLOW_PROF_COOLDOWN_S", "float", 300.0,
+           "minimum seconds between triggered captures (the governor's "
+           "rate bound)", "device", _A_DEVICE),
+        _k("TPUFLOW_PROF_MAX_CAPTURES", "int", 3,
+           "per-run triggered-capture cap; past it triggers are counted "
+           "but suppressed", "device", _A_DEVICE),
+        _k("TPUFLOW_PROF_TRACE_STEPS", "int", 2,
+           "observations (train steps / decode ticks) one triggered "
+           "trace spans before it stops — the capture's size bound",
+           "device", _A_DEVICE),
+        _k("TPUFLOW_PROF_DIR", "path", None,
+           "triggered-capture output dir when telemetry is disabled "
+           "(default <obs_dir>/profile)", "device", _A_DEVICE),
         # -------------------------------------------------------- testing
         _k("TPUFLOW_FAULT", "str", None,
            "comma-separated fault-injection specs (chaos suite)",
@@ -508,6 +540,7 @@ _SUBSYSTEM_TITLES = (
     ("quant", "Quantization"),
     ("serve", "Serving"),
     ("fleet", "Fleet observatory"),
+    ("device", "Device observatory"),
     ("testing", "Fault injection & testing"),
     ("bench", "Benchmark"),
     ("e2e", "On-chip e2e"),
