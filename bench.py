@@ -2221,7 +2221,22 @@ def main() -> None:
     # MFU / platform headline in well under that budget. It carries the
     # same metric/value/unit/vs_baseline fields, so a driver parsing
     # the last JSON line still reads the headline metric.
-    print(json.dumps(_compact_summary(record, train)))
+    compact = _compact_summary(record, train)
+    print(json.dumps(compact))
+    # Run registry (ISSUE 16): every bench invocation appends its
+    # compact digest to the registry (TPUFLOW_REGISTRY_PATH, default
+    # TPU_REGISTRY.jsonl beside the BENCH records) and renders the
+    # "vs last N runs" verdict table against the trailing median+MAD
+    # window. Advisory by design — the exit gates below stay the only
+    # hard failures; a broken registry must never fail a bench.
+    try:
+        from tpuflow.obs import registry as _registry
+
+        _registry.bench_append_and_verdict(
+            compact, os.path.dirname(os.path.abspath(__file__)), log=_log
+        )
+    except Exception as e:
+        _log(f"[bench] registry append skipped: {e!r}")
     # Numerics gate (ISSUE 4 satellite): a FRESH on-chip speculative leg
     # that is not token-exact fails the whole bench loudly — exactness
     # IS the feature, so "numerics_ok: false with a withheld speedup"

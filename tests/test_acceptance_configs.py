@@ -173,6 +173,7 @@ def test_gpt_eval_flow_consumes_train_run(env):
     assert len(erun.data.samples) == 3
 
 
+@pytest.mark.slow
 def test_gpt2_ema_resume_direct_state(env):
     """EMA resume through the flow CLI: the resume path constructs
     TrainState DIRECTLY from restored leaves (no init materialization —

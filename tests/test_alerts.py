@@ -1,0 +1,309 @@
+"""Alert engine (ISSUE 16), jax-free units: the two-window burn-rate
+AND-gate (property-tested against an independent brute-force oracle —
+fires iff BOTH windows exceed the budget; empty/short windows never
+fire), the fired/dedup/cooldown/resolved lifecycle driven by injected
+snapshots and an injected clock, the event-stream evidence, the fleet
+rules, and the MetricsServer ``/alerts`` endpoint."""
+
+import json
+import os
+import random
+import urllib.request
+
+from tpuflow.obs import alerts
+from tpuflow.obs.alerts import AlertEngine, burn_gate, window_rate
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(clock, **kw):
+    defaults = dict(
+        slo_budget=0.01, fast_window_s=300.0, slow_window_s=3600.0,
+        hbm_headroom=0.08, goodput_min=0.5, min_health=0.5,
+        cooldown_s=60.0,
+    )
+    defaults.update(kw)
+    return AlertEngine(clock=clock, **defaults)
+
+
+# -------------------------------------------------- burn-rate math
+def test_window_rate_short_and_empty_windows_never_judge():
+    assert window_rate([], 100.0, 300.0) is None
+    assert window_rate([(100.0, 10, 1)], 100.0, 300.0) is None
+    # Both samples inside the window but no request flowed.
+    s = [(90.0, 10, 1), (100.0, 10, 1)]
+    assert window_rate(s, 100.0, 300.0) is None
+    # Samples aged out of the window.
+    s = [(0.0, 0, 0), (10.0, 100, 5)]
+    assert window_rate(s, 1000.0, 300.0) is None
+    # Counter reset (replica restart) clamps to 0, never negative.
+    s = [(90.0, 100, 50), (100.0, 200, 0)]
+    assert window_rate(s, 100.0, 300.0) == 0.0
+
+
+def test_burn_gate_requires_both_windows():
+    budget = 0.01
+    # Violations confined to the distant past: slow window burns,
+    # fast window is clean -> no fire (recovered an hour ago).
+    s = [(0.0, 0, 0), (600.0, 1000, 900), (3300.0, 2000, 900),
+         (3590.0, 3000, 900)]
+    fired, d = burn_gate(s, 3600.0, 300.0, 3600.0, budget)
+    assert not fired and d["slow_rate"] > budget
+    assert d["fast_rate"] == 0.0
+    # A fresh burst only: fast burns, slow (diluted) does not -> no
+    # fire (one bad minute must not page).
+    s = [(0.0, 0, 0), (3400.0, 1_000_000, 0), (3590.0, 1_000_100, 90)]
+    fired, d = burn_gate(s, 3600.0, 300.0, 3600.0, budget)
+    assert not fired and d["fast_rate"] > budget
+    assert d["slow_rate"] < budget
+    # Sustained burn: both windows exceed -> fires.
+    s = [(0.0, 0, 0), (1800.0, 1000, 50), (3400.0, 2000, 100),
+         (3590.0, 2100, 106)]
+    fired, d = burn_gate(s, 3600.0, 300.0, 3600.0, budget)
+    assert fired and d["fast_burn"] > 1 and d["slow_burn"] > 1
+    # Zero/negative budget never fires.
+    assert not burn_gate(s, 3600.0, 300.0, 3600.0, 0.0)[0]
+
+
+def test_burn_gate_property_vs_oracle():
+    """Seeded property sweep: the gate must equal the brute-force
+    oracle (both trailing window rates independently recomputed exceed
+    budget) on random monotone counter histories, and must never fire
+    when either window is empty/short."""
+
+    def oracle_rate(samples, now, win):
+        inside = [s for s in samples if s[0] >= now - win]
+        if len(inside) < 2:
+            return None
+        dr = inside[-1][1] - inside[0][1]
+        dv = inside[-1][2] - inside[0][2]
+        return None if dr <= 0 else max(dv, 0.0) / dr
+
+    rng = random.Random(16)
+    for _ in range(300):
+        n = rng.randrange(0, 8)
+        t = req = vio = 0.0
+        samples = []
+        for _ in range(n):
+            t += rng.uniform(1.0, 2000.0)
+            dr = rng.choice([0, 0, rng.randrange(1, 500)])
+            req += dr
+            vio += rng.randrange(0, dr + 1) if dr else 0
+            samples.append((t, req, vio))
+        now = t + rng.uniform(0.0, 500.0)
+        fast_s = rng.choice([60.0, 300.0, 900.0])
+        slow_s = rng.choice([900.0, 3600.0])
+        budget = rng.choice([0.001, 0.01, 0.1])
+        fired, d = burn_gate(samples, now, fast_s, slow_s, budget)
+        f, s = oracle_rate(samples, now, fast_s), oracle_rate(
+            samples, now, slow_s
+        )
+        expect = f is not None and s is not None and f > budget \
+            and s > budget
+        assert fired == expect, (samples, now, fast_s, slow_s, budget)
+        assert d["fast_rate"] == f and d["slow_rate"] == s
+        if f is None or s is None:
+            assert not fired
+
+
+# ---------------------------------------------------------- lifecycle
+def test_lifecycle_fired_dedup_cooldown_resolved():
+    """The exact fired/resolved sequence from injected snapshots:
+    rising edge fires once, staying bad is silent (dedup), a clear
+    inside the cooldown holds the alert active (anti-flap), a clear
+    past the cooldown resolves once."""
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=60.0)
+    bad = {"goodput_fraction": 0.2, "steps": 100}
+    good = {"goodput_fraction": 0.9, "steps": 100}
+    seq = []
+    for dt, snap in (
+        (0.0, good), (10.0, bad), (10.0, bad), (10.0, good),
+        (10.0, bad), (40.0, good), (10.0, good),
+    ):
+        clock.t += dt
+        for t in eng.observe(status=snap):
+            seq.append((round(clock.t, 1), t["rule"], t["state"]))
+    # t=10 fired; t=20/30 dedup'd / flap-held (the t=30 clear is 20s
+    # into the 60s cooldown, and the t=40 re-fire re-enters the SAME
+    # active alert); the t=80 clear is 70s after the fire -> resolved;
+    # t=90 stays quiet.
+    assert seq == [(10.0, "goodput_drop", "fired"),
+                   (80.0, "goodput_drop", "resolved")]
+    assert eng.active() == []
+
+
+def test_goodput_rule_needs_settled_run():
+    """goodput_fraction ~0 during the compile fence must not page:
+    the rule arms only once steps > 0."""
+    eng = _engine(FakeClock())
+    assert eng.observe(status={"goodput_fraction": 0.0, "steps": 0}) == []
+    fired = eng.observe(status={"goodput_fraction": 0.1, "steps": 1})
+    assert [t["rule"] for t in fired] == ["goodput_drop"]
+
+
+def test_hbm_and_fleet_rules_with_severity_and_runbook():
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=0.0)
+    fleet = {
+        "replicas": 3, "stale": 1, "min_health": 0.25,
+        "hbm_used_frac_max": 0.95,
+    }
+    fired = {t["rule"]: t for t in eng.observe(fleet=fleet)}
+    assert set(fired) == {
+        "hbm_headroom", "health_collapse", "stale_replicas",
+    }
+    assert fired["hbm_headroom"]["severity"] == "page"
+    assert fired["hbm_headroom"]["runbook"] == "device-observatory-runbook"
+    assert fired["health_collapse"]["severity"] == "page"
+    assert fired["stale_replicas"]["severity"] == "ticket"
+    assert fired["stale_replicas"]["value"] == 1
+    # active() is severity-major for the /alerts endpoint.
+    assert [a["severity"] for a in eng.active()] == [
+        "page", "page", "ticket",
+    ]
+    # Everything healthy next sweep (cooldown 0): all three resolve.
+    clock.t += 1.0
+    ok = {"replicas": 3, "stale": 0, "min_health": 1.0,
+          "hbm_used_frac_max": 0.5}
+    assert sorted(t["state"] for t in eng.observe(fleet=ok)) == [
+        "resolved", "resolved", "resolved",
+    ]
+
+
+def test_slo_burn_fires_through_engine_and_emits_events(tmp_path):
+    from tpuflow import obs
+
+    clock = FakeClock()
+    eng = _engine(
+        clock, fast_window_s=300.0, slow_window_s=3600.0,
+        slo_budget=0.01, cooldown_s=0.0,
+    )
+    obs.configure(str(tmp_path / "obs"), proc=0)
+    try:
+        # Sustained 5% violation rate across an hour of samples.
+        transitions = []
+        for i in range(13):
+            clock.t = 300.0 * i
+            st = {"serve_requests": 1000 * i,
+                  "serve_slo_violations": 50 * i}
+            transitions += eng.observe(status=st)
+        assert [t["rule"] for t in transitions] == ["slo_burn_rate"]
+        assert transitions[0]["severity"] == "page"
+        # Recovery: violations stop; the fast window clears first and
+        # the AND-gate releases the alert.
+        for i in range(13, 26):
+            clock.t = 300.0 * i
+            st = {"serve_requests": 1000 * i,
+                  "serve_slo_violations": 50 * 12}
+            transitions += eng.observe(status=st)
+        assert [(t["rule"], t["state"]) for t in transitions] == [
+            ("slo_burn_rate", "fired"), ("slo_burn_rate", "resolved"),
+        ]
+        obs.flush()
+    finally:
+        obs.configure(None)
+    events = []
+    d = str(tmp_path / "obs")
+    for name in os.listdir(d):
+        if name.startswith("events."):
+            events.extend(obs.read_events(os.path.join(d, name)))
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    (fired,) = by_name["alert.fired"]
+    assert fired["rule"] == "slo_burn_rate"
+    assert fired["severity"] == "page"
+    assert fired["runbook"] == "regression--alerting-runbook"
+    (res,) = by_name["alert.resolved"]
+    assert res["rule"] == "slo_burn_rate" and res["active_s"] > 0
+
+
+# ------------------------------------------------------------ endpoint
+def test_alerts_endpoint_serves_active_and_rules(tmp_path):
+    from tpuflow.obs.export import MetricsServer
+
+    snap = {"goodput_fraction": 0.1, "steps": 50}
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=0.0)
+    srv = MetricsServer(
+        port=0, snapshot_fn=lambda: dict(snap), alert_engine=eng
+    )
+    try:
+        def get(path):
+            with urllib.request.urlopen(srv.url + path, timeout=5) as r:
+                return json.loads(r.read().decode())
+
+        body = get("/alerts")
+        assert [a["rule"] for a in body["active"]] == ["goodput_drop"]
+        assert body["active"][0]["severity"] == "ticket"
+        assert {r["rule"] for r in body["rules"]} == set(eng.rules)
+        # Dedup across scrapes: still one active alert.
+        clock.t += 1.0
+        assert len(get("/alerts")["active"]) == 1
+        # Recovery: the endpoint evaluation resolves it.
+        snap.update(goodput_fraction=0.95)
+        clock.t += 1.0
+        assert get("/alerts")["active"] == []
+        # /status and /metrics still answer beside /alerts.
+        with urllib.request.urlopen(srv.url + "/status", timeout=5) as r:
+            assert json.loads(r.read().decode())["steps"] == 50
+    finally:
+        srv.close()
+
+
+def test_timeline_card_alerts_section():
+    """A run whose event stream carries alert lifecycle events gets an
+    Alerts section on the timeline card: severity, runbook anchor, and
+    resolved vs still-active state per fired alert."""
+    from tpuflow.flow.cards import CardBuffer, timeline_card
+
+    events = [
+        {"kind": "event", "name": "alert.fired", "ts": 1.0,
+         "rule": "hbm_headroom", "severity": "page",
+         "message": "HBM headroom 0.05 under the 0.08 budget line",
+         "runbook": "device-observatory-runbook"},
+        {"kind": "event", "name": "alert.fired", "ts": 2.0,
+         "rule": "stale_replicas", "severity": "ticket",
+         "message": "1 replica(s) stale (of 3)",
+         "runbook": "fleet-observability-runbook"},
+        {"kind": "event", "name": "alert.resolved", "ts": 3.0,
+         "rule": "hbm_headroom", "severity": "page", "active_s": 2.0},
+    ]
+    buf = CardBuffer()
+    timeline_card(buf, events)
+    html = buf.render_html()
+    assert "Alerts" in html
+    assert "hbm_headroom" in html and "resolved" in html
+    assert "stale_replicas" in html and "STILL ACTIVE" in html
+    assert "#fleet-observability-runbook" in html
+    # No alert events -> no Alerts section.
+    buf2 = CardBuffer()
+    timeline_card(buf2, [e for e in events if "goodput" in e["name"]])
+    assert "Alerts" not in buf2.render_html()
+
+
+def test_module_engine_singleton_and_reset():
+    alerts.reset()
+    try:
+        assert alerts.engine() is alerts.engine()
+    finally:
+        alerts.reset()
+
+
+def test_format_transition_lines():
+    fired = {"state": "fired", "rule": "hbm_headroom",
+             "severity": "page", "runbook": "device-observatory-runbook",
+             "message": "HBM headroom 0.050 under the 0.080 budget line"}
+    line = alerts.format_transition(fired)
+    assert line.startswith("ALERT [page] hbm_headroom FIRED:")
+    assert "#device-observatory-runbook" in line
+    res = {"state": "resolved", "rule": "hbm_headroom",
+           "severity": "page", "active_s": 12.34}
+    assert "RESOLVED after 12.3s" in alerts.format_transition(res)

@@ -225,6 +225,7 @@ def _flash_grads(q, k, v, mode, causal, monkeypatch, lse=None):
     return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
 
+@pytest.mark.slow
 def test_flash_bwd_fused_bit_identical_to_split(monkeypatch):
     """ISSUE 10 tentpole gate: the fused two-kernel backward (row-delta
     folded into the dq kernel's first block visit + the lane-packed

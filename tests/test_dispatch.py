@@ -77,6 +77,7 @@ def _run_gpt(tmp_path, tag, monkeypatch, prefetch, dispatch):
     return result
 
 
+@pytest.mark.slow
 def test_prefetch_and_dispatch_ahead_losses_bit_identical(
     tmp_path, monkeypatch
 ):

@@ -409,6 +409,7 @@ def test_trainer_save_failures_do_not_kill_run(tmp_path, monkeypatch):
     )
 
 
+@pytest.mark.slow
 def test_gpt_preempt_emergency_save_and_midepoch_resume(tmp_path, monkeypatch):
     """Preemption with a closing grace window on the GPT leg: the drain
     writes a LOCAL-tier emergency checkpoint (no persistent upload, no

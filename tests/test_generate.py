@@ -55,7 +55,10 @@ def test_greedy_matches_with_scan_layers():
     np.testing.assert_array_equal(got, want)
 
 
-@pytest.mark.parametrize("scan_layers", [False, True])
+@pytest.mark.parametrize(
+    "scan_layers",
+    [pytest.param(False, marks=pytest.mark.slow), True],
+)
 def test_generate_with_remat(scan_layers):
     """Regression (ISSUE 1 satellite): remat'd blocks must keep pad_lens
     DYNAMIC. nn.remat static_argnums=(2, 3, 4) marked pad_lens (arg 4)
@@ -488,6 +491,7 @@ def test_best_of_n_eos_aware_scoring():
         assert float(score[b]) == pytest.approx(float(scores[b, k]), rel=1e-5)
 
 
+@pytest.mark.slow
 def test_generation_predictor_map_batches_ragged_rows():
     """Engine-level ragged parity: map_batches over ragged token rows
     (the reference engine's ragged-rows contract, eval_flow.py:85-90)
@@ -543,6 +547,7 @@ def test_generation_predictor_pad_to_single_program():
         )({"tokens": [np.arange(5), np.arange(3)]})
 
 
+@pytest.mark.slow
 def test_prefill_chunking_token_exact():
     """Chunked prefill (long-context memory bound) produces exactly the
     unchunked tokens — dense and ragged, even when the chunk width doesn't
@@ -635,6 +640,7 @@ def test_beam_search_scores_match_independent_rescoring():
         np.testing.assert_array_equal(best[b], all_t[b, int(all_s[b].argmax())])
 
 
+@pytest.mark.slow
 def test_beam_search_ragged_matches_per_row():
     from tpuflow.infer import beam_search, pad_ragged
 

@@ -366,6 +366,7 @@ def _gpt_cfg(**kw):
     return GptTrainConfig(**base)
 
 
+@pytest.mark.slow
 def test_chaos_nan_grad_rollback_continuous_history(tmp_path, monkeypatch):
     """THE acceptance chaos test: a NaN gradient injected at step 3 of a
     real train_gpt run trips the fused nonfinite detector, auto-rolls-back
@@ -433,6 +434,7 @@ def test_chaos_nan_grad_halts_when_rollback_disabled(tmp_path, monkeypatch):
     assert "TPUFLOW_HEALTH_ROLLBACK=0" in msg
 
 
+@pytest.mark.slow
 def test_chaos_loss_spike_rollback(tmp_path, monkeypatch):
     """The finite-spike injection (params ×1e3) trips the median+MAD
     detector once the window has warmed up, and rolls back like the NaN
@@ -499,6 +501,7 @@ def test_chaos_lr_backoff_on_rollback(tmp_path, monkeypatch):
     assert rb["lr_scale"] == 0.5
 
 
+@pytest.mark.slow
 def test_chaos_nan_grad_rollback_with_deep_dispatch_window(
     tmp_path, monkeypatch
 ):

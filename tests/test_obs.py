@@ -388,6 +388,12 @@ def test_obs_catalog_lint():
         ("gauge", "device.hbm_limit"),
         ("event", "device.hbm_budget"),
         ("event", "prof.capture"),
+        # Decision observatory (ISSUE 16) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
+        # the registry's append audit, the alert lifecycle edges.
+        ("event", "registry.append"),
+        ("event", "alert.fired"),
+        ("event", "alert.resolved"),
         # Native int8 decode (ISSUE 9) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
         ("span", "serve.quant_decode"),
@@ -886,7 +892,7 @@ def test_tier1_duration_guard(tmp_path):
     write({"duration_s": 860.0, "markexpr": "not slow",
            "testscollected": 300})
     err = mod.tier1_duration_guard(str(tmp_path))
-    assert err and "860" in err and "820" in err
+    assert err and "860" in err and "800" in err
     # The slow suite and partial runs are exempt — their durations say
     # nothing about the tier-1 budget.
     write({"duration_s": 9000.0, "markexpr": "slow",

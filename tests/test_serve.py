@@ -445,6 +445,81 @@ def test_fleet_registration_histogram_export_never_recompile(
         obs_export.stop()
 
 
+def test_alert_engine_and_registry_never_recompile(
+    engine, model_params, monkeypatch, tmp_path
+):
+    """Decision observatory (ISSUE 16) through the SHARED warmed
+    engine: the alert engine consumes this engine's REAL live
+    snapshots (forced-SLO traffic so the burn-rate counters actually
+    move) and walks the exact fired -> resolved lifecycle — dedup'd in
+    between — then the run-end registry hook appends this replica's
+    headline (TTFT p99 from the mergeable buckets) from the same
+    snapshot, all host-side with compile_stats() unchanged (the
+    acceptance's never-recompile clause with everything armed)."""
+    from tpuflow import obs
+    from tpuflow.obs import alerts as alerts_mod
+    from tpuflow.obs import registry as registry_mod
+
+    model, params = model_params
+    base = engine.compile_stats()
+    reg_path = str(tmp_path / "reg.jsonl")
+    monkeypatch.setenv("TPUFLOW_REGISTRY_PATH", reg_path)
+
+    t = {"now": 0.0}
+    eng = alerts_mod.AlertEngine(
+        clock=lambda: t["now"], slo_budget=0.01, fast_window_s=300.0,
+        slow_window_s=600.0, cooldown_s=0.0,
+    )
+    seq = []
+
+    def sweep():
+        snap = obs.goodput_live().snapshot()
+        seq.extend(
+            (x["rule"], x["state"]) for x in eng.observe(status=snap)
+        )
+
+    sweep()  # single baseline sample: windows cannot judge, no fire
+    assert seq == []
+    engine.ledger.slo_ttft_s = 1e-9  # every request violates
+    try:
+        for _ in range(2):
+            t["now"] += 150.0
+            p = np.arange(1, 6, dtype=np.int32)
+            r = engine.submit(p, max_new_tokens=4)
+            engine.run_until_idle(max_iters=200)
+            np.testing.assert_array_equal(
+                r.result(), _solo(model, params, p, 4)
+            )
+            sweep()
+    finally:
+        engine.ledger.slo_ttft_s = None
+    # Fired on the first judgeable burning sweep, then dedup'd.
+    assert seq == [("slo_burn_rate", "fired")]
+    # Clean traffic after the windows age the burn out: the AND-gate
+    # releases and (cooldown 0) the alert resolves exactly once.
+    t["now"] += 10_000.0
+    for _ in range(2):
+        t["now"] += 100.0
+        p = np.arange(1, 6, dtype=np.int32)
+        r = engine.submit(p, max_new_tokens=4)
+        engine.run_until_idle(max_iters=200)
+        sweep()
+    assert seq == [
+        ("slo_burn_rate", "fired"), ("slo_burn_rate", "resolved"),
+    ]
+    assert eng.active() == []
+    # The serve_forever run-end hook's append, from the live snapshot.
+    snap = obs.goodput_live().snapshot()
+    assert registry_mod.maybe_append_live("serve", snap) is True
+    (rec,) = registry_mod.read_registry(reg_path)
+    assert rec["kind"] == "serve"
+    assert rec["metrics"]["serve_requests"] >= 1
+    assert "serve_ttft_p99_s" in rec["metrics"]
+    assert engine.compile_stats() == base, (
+        "alert engine / registry armed recompiled"
+    )
+
+
 def test_serve_trace_disarmed_is_one_bool_check(engine):
     """TPUFLOW_SERVE_TRACE=0 semantics: with _trace_on False the trace
     hook records nothing — no list growth, no events — and the engine

@@ -184,6 +184,7 @@ def test_speculative_with_fsdp_sharded_params(mesh8):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_token_exact_bf16_long_decode():
     """The r4 on-chip failure mode, reproduced and fixed: bf16 rounding of
     layer outputs is WIDTH-DEPENDENT (a (K+1)-chunk verify forward and

@@ -209,6 +209,7 @@ def test_grad_accumulation_matches_full_batch():
     )
 
 
+@pytest.mark.slow
 def test_grad_accumulation_threads_batchnorm_stats():
     """With BatchNorm models the scan threads batch_stats microbatch to
     microbatch and the final stats land in the new state."""

@@ -44,6 +44,13 @@ registration dir or comma URL list; omitted, the TPUFLOW_FLEET_*
 knobs resolve it. A replica answering garbage (a /status read
 mid-write) or nothing at all is marked STALE — the watcher never
 crashes on a dying replica; that is the event it exists to report.
+
+Both live modes run the declarative alert engine (ISSUE 16,
+``tpuflow.obs.alerts``) over every poll and print ``ALERT ...
+FIRED/RESOLVED`` lines on the lifecycle edges — SLO burn rate
+(two-window AND-gate), HBM headroom, goodput drop, health collapse,
+stale replicas — deduplicated in between, thresholds from the
+``TPUFLOW_ALERT_*`` knobs.
 """
 
 from __future__ import annotations
@@ -205,10 +212,17 @@ def follow(url: str, interval: float, max_s: float) -> int:
     training and vanishes across requeues, both routine mid-watch."""
     import urllib.request
 
+    from tpuflow.obs import alerts as alerts_mod
+
     def fmt(st: dict, key: str, spec: str = "{:.3g}") -> str:
         v = st.get(key)
         return spec.format(v) if isinstance(v, (int, float)) else "-"
 
+    # Alert engine (ISSUE 16): the same declarative rules the /alerts
+    # endpoint serves, evaluated over each poll — a babysitter session
+    # prints ALERT lines on the fired/resolved edges, deduplicated
+    # in between.
+    eng = alerts_mod.AlertEngine()
     deadline = time.time() + max_s
     while time.time() < deadline:
         stamp = time.strftime("%H:%M:%S")
@@ -265,6 +279,12 @@ def follow(url: str, interval: float, max_s: float) -> int:
                 f"up={fmt(st, 'uptime_s', '{:.0f}')}s" + hbm + serving,
                 flush=True,
             )
+            for t in eng.observe(status=st):
+                print(
+                    f"[tpu_watch {stamp}] "
+                    + alerts_mod.format_transition(t),
+                    flush=True,
+                )
         time.sleep(interval)
     print("[tpu_watch] follow deadline reached", flush=True)
     return 0
@@ -274,9 +294,14 @@ def fleet(target: str | None, interval: float, max_s: float) -> int:
     """Poll the serving fleet and print one headline + one line per
     replica per interval (tpuflow.obs.fleet does discovery, per-replica
     timeout/backoff, staleness marking, and the histogram merge)."""
+    from tpuflow.obs import alerts as alerts_mod
     from tpuflow.obs import fleet as fleet_mod
 
     obsy = fleet_mod.FleetObservatory(target)
+    # Fleet-scope alerting (ISSUE 16): burn-rate over the fleet's summed
+    # violation counters, HBM headroom of the tightest replica, health
+    # collapse, stale replicas.
+    eng = alerts_mod.AlertEngine()
     deadline = time.time() + max_s
     while time.time() < deadline:
         stamp = time.strftime("%H:%M:%S")
@@ -297,6 +322,12 @@ def fleet(target: str | None, interval: float, max_s: float) -> int:
             )
             for row in snap["replicas"]:
                 print(fleet_mod.format_replica_line(row), flush=True)
+            for t in eng.observe(fleet=snap["fleet"]):
+                print(
+                    f"[tpu_watch {stamp}] "
+                    + alerts_mod.format_transition(t),
+                    flush=True,
+                )
         time.sleep(interval)
     print("[tpu_watch] fleet deadline reached", flush=True)
     return 0

@@ -494,6 +494,43 @@ def timeline_card(buf, events: Sequence[dict], summary: dict | None = None) -> N
         if rows:
             buf.append(Table(rows, headers=["device metric", "value"]))
 
+    # Alert engine (ISSUE 16): a run during which any declarative rule
+    # fired gets an Alerts section — one row per fired event with its
+    # severity, runbook anchor, and whether it resolved before run end.
+    fired_events = [
+        e for e in events
+        if e.get("kind") == "event" and e.get("name") == "alert.fired"
+    ]
+    resolved_events = [
+        e for e in events
+        if e.get("kind") == "event" and e.get("name") == "alert.resolved"
+    ]
+    if fired_events or resolved_events:
+        buf.append(Markdown("## Alerts"))
+        resolved_count: dict[str, int] = {}
+        for e in resolved_events:
+            rule = str(e.get("rule"))
+            resolved_count[rule] = resolved_count.get(rule, 0) + 1
+        rows = []
+        for e in fired_events:
+            rule = str(e.get("rule"))
+            if resolved_count.get(rule, 0) > 0:
+                resolved_count[rule] -= 1
+                state = "resolved"
+            else:
+                state = "STILL ACTIVE at run end"
+            rows.append([
+                rule,
+                str(e.get("severity", "?")),
+                str(e.get("message", ""))[:80],
+                state,
+                f"#{e.get('runbook', '')}",
+            ])
+        buf.append(Table(
+            rows,
+            headers=["alert", "severity", "message", "state", "runbook"],
+        ))
+
     spans = [
         e for e in events if e.get("kind") == "span" and e.get("dur_s", 0) > 0
     ]

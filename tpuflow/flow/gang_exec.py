@@ -255,6 +255,14 @@ def main(argv: list[str]) -> None:
         flight_mod.dump_flight("unhandled_exception", e)
         obs.flush()
         raise
+    # Run registry (ISSUE 16): member 0 appends this leg's headline
+    # (goodput fraction, tokens/s, HBM peak) to the cross-run registry —
+    # a single knob read when TPUFLOW_REGISTRY_PATH is unarmed, and
+    # never a run failure when it is.
+    if jax.process_index() == 0:
+        from tpuflow.obs import registry as registry_mod
+
+        registry_mod.maybe_append_live("train")
     obs.flush()
 
     # Every member persists its own artifacts; the head's land at the gang

@@ -248,6 +248,7 @@ class FlowRunner:
             obs.configure(self._obs_dir, proc=0)
         run_span = obs.span("flow.run", flow=self.flow_name, run=str(run_id))
         run_span.__enter__()
+        ran_gang = False
         try:
             while True:
                 fn = steps[step_name]
@@ -277,6 +278,7 @@ class FlowRunner:
                             attempt=attempt, num_parallel=num_parallel,
                         ):
                             if num_parallel > 1:
+                                ran_gang = True
                                 gang_inputs = self._exec_gang(
                                     flow, step_name, run_id, task_id,
                                     num_parallel,
@@ -366,6 +368,14 @@ class FlowRunner:
         meta["finished"] = time.time()
         run_span.set(status="success")
         run_span.__exit__(None, None, None)
+        # Run registry (ISSUE 16): in-process runs append their headline
+        # here, while the recorder is still open so the registry.append
+        # event merges into events.jsonl; gang runs already appended
+        # from member 0 (gang_exec) and must not double-record.
+        if not ran_gang:
+            from tpuflow.obs import registry as registry_mod
+
+            registry_mod.maybe_append_live("train")
         self._finalize_obs(rdir, pathspec, meta)
         store.write_run_meta(self.flow_name, run_id, meta)
         store.append_event(

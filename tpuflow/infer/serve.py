@@ -1977,24 +1977,35 @@ def serve_forever(
     preempt.install_sigterm_handler()
     deadline = None if max_s is None else time.monotonic() + max_s
     draining = False
-    while True:
-        if preempt.preemption_requested():
-            draining = True
-        did = engine.step(admit=not draining)
-        heartbeat.beat(step=engine._iters)
-        if draining and not engine._live.any():
-            # Queued requests ride the requeue; their traces reach the
-            # drained terminal so no submitted request vanishes from
-            # the access log (ISSUE 13).
-            engine.drain_queued()
-            return
-        if should_stop is not None and should_stop():
-            return
-        if deadline is not None and time.monotonic() > deadline:
-            return
-        if not did:
-            if draining:
+    try:
+        while True:
+            if preempt.preemption_requested():
+                draining = True
+            did = engine.step(admit=not draining)
+            heartbeat.beat(step=engine._iters)
+            if draining and not engine._live.any():
+                # Queued requests ride the requeue; their traces reach
+                # the drained terminal so no submitted request vanishes
+                # from the access log (ISSUE 13).
                 engine.drain_queued()
                 return
-            with engine.ledger.bucket("idle"):
-                time.sleep(idle_sleep_s)
+            if should_stop is not None and should_stop():
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                return
+            if not did:
+                if draining:
+                    engine.drain_queued()
+                    return
+                with engine.ledger.bucket("idle"):
+                    time.sleep(idle_sleep_s)
+    finally:
+        # Run registry (ISSUE 16): whatever ended the loop — drain,
+        # stop callable, deadline, or an exception on its way out —
+        # this replica's headline (requests, TTFT/ITL percentiles from
+        # the mergeable buckets, SLO count) lands in the cross-run
+        # registry when TPUFLOW_REGISTRY_PATH is armed. One knob read
+        # when it is not; never masks the in-flight exception.
+        from tpuflow.obs import registry as registry_mod
+
+        registry_mod.maybe_append_live("serve")

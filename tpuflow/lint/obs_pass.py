@@ -130,6 +130,11 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("gauge", "device.hbm_limit"),
     ("event", "device.hbm_budget"),
     ("event", "prof.capture"),
+    # Decision observatory (ISSUE 16): the run registry's append audit
+    # and the alert engine's deduplicated lifecycle events.
+    ("event", "registry.append"),
+    ("event", "alert.fired"),
+    ("event", "alert.resolved"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
     ("event", "ops.flash_bwd_fused"),
@@ -147,8 +152,11 @@ UNEMITTED_GRANDFATHER: frozenset[str] = frozenset()
 # Tier-1 duration guard (ISSUE 6 satellite): tests/conftest.py records
 # every full 'not slow' session's wall time; exceeding the guard fails
 # the lint BEFORE CI starts getting killed by the hard timeout.
+# ISSUE 16 slow-mark audit: the suite had crept to ~1170s; marking the
+# 14 biggest call-time outliers brought a clean run to 767s, and the
+# guard is pinned at 800 so that headroom can't silently erode back.
 TIER1_BUDGET_S = 870.0
-TIER1_GUARD_S = 820.0
+TIER1_GUARD_S = 800.0
 TIER1_DURATION_FILE = ".tier1_duration.json"
 _TIER1_MIN_TESTS = 100
 

@@ -22,6 +22,13 @@ call is a single boolean check; enabled, events buffer in memory and
 flush on a background thread (see ``recorder``).
 """
 
+from tpuflow.obs.alerts import (
+    RULES as ALERT_RULES,
+    AlertEngine,
+    burn_gate,
+    format_transition,
+)
+from tpuflow.obs.alerts import engine as alert_engine
 from tpuflow.obs.catalog import CATALOG, is_registered, kind_of
 from tpuflow.obs.device import (
     ProgramLedger,
@@ -64,6 +71,17 @@ from tpuflow.obs.serve_ledger import (
     load_access_log,
     summarize_access,
 )
+from tpuflow.obs.registry import (
+    append_record,
+    backfill_bench,
+    compare_rows,
+    make_record,
+    maybe_append_live,
+    read_registry,
+    registry_path,
+    trend_rows,
+    verdict_rows,
+)
 from tpuflow.obs.recorder import (
     Recorder,
     configure,
@@ -86,7 +104,9 @@ from tpuflow.obs.timeline import (
 )
 
 __all__ = [
+    "ALERT_RULES",
     "AccessLog",
+    "AlertEngine",
     "Anomaly",
     "AnomalyCapturer",
     "CATALOG",
@@ -105,6 +125,11 @@ __all__ = [
     "SERVE_GROUPS",
     "ServeLedger",
     "TrainingDiverged",
+    "alert_engine",
+    "append_record",
+    "backfill_bench",
+    "burn_gate",
+    "compare_rows",
     "compute_goodput",
     "configure",
     "counter",
@@ -115,6 +140,7 @@ __all__ = [
     "event",
     "flight_path",
     "flush",
+    "format_transition",
     "gauge",
     "goodput_live",
     "hbm_snapshot",
@@ -125,15 +151,21 @@ __all__ = [
     "kind_of",
     "load_access_log",
     "load_run_events",
+    "make_record",
+    "maybe_append_live",
     "maybe_emit_hbm",
     "maybe_start_export",
     "merge_run_events",
     "obs_dir",
     "read_events",
+    "read_registry",
     "recorder",
+    "registry_path",
     "replica_identity",
     "span",
     "summarize",
     "summarize_access",
     "timed_iter",
+    "trend_rows",
+    "verdict_rows",
 ]

@@ -1,6 +1,6 @@
 """Operator CLI: ``python -m tpuflow.obs <command> [target] [--json]``.
 
-Four commands, all jax-free and safe against a LIVE run from a login
+Seven commands, all jax-free and safe against a LIVE run from a login
 shell:
 
 - ``summarize <run_dir>`` — the run's merged telemetry (the committed
@@ -24,13 +24,25 @@ shell:
   is a registration directory or a comma URL list; omitted, the
   ``TPUFLOW_FLEET_REPLICAS`` / ``TPUFLOW_FLEET_REGISTRATION_DIR``
   knobs resolve it.
+- ``trend [--metric=M ...] [--window=N]`` — the regression ledger
+  (ISSUE 16): the registry's newest record judged against its trailing
+  median+MAD window, one verdict row per metric.
+- ``compare <runA> <runB>`` — per-metric deltas between two registry
+  records (run-id exact or prefix match); a side missing a metric
+  reads "absent", never an error.
+- ``registry-backfill [<dir>]`` — one-shot idempotent import of the
+  driver's ``BENCH_r*.json`` captures into the registry.
 
-``--json`` dumps the full structure for CI and scripts.
+The registry commands resolve the registry file from
+``TPUFLOW_REGISTRY_PATH`` (override per-call with
+``--registry=PATH``). ``--json`` dumps the full structure for CI and
+scripts.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 from tpuflow.obs.goodput import BUCKETS
@@ -45,7 +57,13 @@ _USAGE = (
     "usage: python -m tpuflow.obs "
     "{summarize|serve-summary|device-summary} <run_dir> [--json]\n"
     "       python -m tpuflow.obs fleet-summary "
-    "[<registration_dir>|<url,url,...>] [--json]"
+    "[<registration_dir>|<url,url,...>] [--json]\n"
+    "       python -m tpuflow.obs trend [--metric=M ...] [--window=N] "
+    "[--registry=PATH] [--json]\n"
+    "       python -m tpuflow.obs compare <runA> <runB> "
+    "[--registry=PATH] [--json]\n"
+    "       python -m tpuflow.obs registry-backfill [<bench_dir>] "
+    "[--registry=PATH]"
 )
 
 
@@ -259,7 +277,130 @@ def _fleet_summary(target: str | None, as_json: bool) -> int:
     return 0
 
 
+def _find_record(records: list[dict], token: str) -> dict | None:
+    """The newest record whose run_id matches ``token`` exactly, else
+    the newest run-id *prefix* match (so ``bench-17...`` abbreviates)."""
+    exact = [r for r in records if r.get("run_id") == token]
+    if exact:
+        return exact[-1]
+    pref = [
+        r for r in records if str(r.get("run_id", "")).startswith(token)
+    ]
+    return pref[-1] if pref else None
+
+
+def _registry_cli(argv: list[str]) -> int:
+    """trend / compare / registry-backfill — the regression ledger
+    (ISSUE 16). Jax-free: only the registry module and file reads."""
+    from tpuflow.obs import registry as reg
+
+    cmd = argv[0]
+    args: list[str] = []
+    metrics: list[str] = []
+    override = None
+    window = None
+    as_json = False
+    for a in argv[1:]:
+        if a == "--json":
+            as_json = True
+        elif a.startswith("--metric="):
+            metrics.append(a.split("=", 1)[1])
+        elif a.startswith("--registry="):
+            override = a.split("=", 1)[1]
+        elif a.startswith("--window="):
+            try:
+                window = int(a.split("=", 1)[1])
+            except ValueError:
+                print(_USAGE, file=sys.stderr)
+                return 2
+        elif a.startswith("-"):
+            print(_USAGE, file=sys.stderr)
+            return 2
+        else:
+            args.append(a)
+    path = override or reg.registry_path()
+
+    if cmd == "registry-backfill":
+        if len(args) > 1:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        bench_dir = args[0] if args else repo
+        if not path:
+            path = os.path.join(bench_dir, reg.DEFAULT_BASENAME)
+        n = reg.backfill_bench(bench_dir, path)
+        print(f"imported {n} bench record(s) from {bench_dir} -> {path}")
+        return 0
+
+    records = reg.read_registry(path) if path else []
+    if not records:
+        print(
+            "empty registry "
+            f"({path or 'TPUFLOW_REGISTRY_PATH unset'}) — arm "
+            "TPUFLOW_REGISTRY_PATH (or pass --registry=PATH) and run "
+            "`python -m tpuflow.obs registry-backfill` to import the "
+            "BENCH history",
+            file=sys.stderr,
+        )
+        return 1
+
+    if cmd == "compare":
+        if len(args) != 2:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        recs = []
+        for token in args:
+            rec = _find_record(records, token)
+            if rec is None:
+                print(
+                    f"run {token!r} not found in {path} "
+                    f"({len(records)} records)",
+                    file=sys.stderr,
+                )
+                return 1
+            recs.append(rec)
+        rows = reg.compare_rows(recs[0], recs[1])
+        if as_json:
+            json.dump(rows, sys.stdout, indent=2, sort_keys=True)
+            print()
+            return 0
+        print(
+            f"compare {recs[0].get('run_id')} -> {recs[1].get('run_id')}"
+            f" ({path})"
+        )
+        print(reg.format_rows(
+            rows, ("metric", "a", "b", "delta", "delta_pct", "verdict")
+        ))
+        return 0
+
+    # trend
+    if args:
+        print(_USAGE, file=sys.stderr)
+        return 2
+    rows = reg.trend_rows(records, metrics=metrics or None, window=window)
+    if as_json:
+        json.dump(rows, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    newest = records[-1]
+    print(
+        f"registry: {path} ({len(records)} records) — newest "
+        f"{newest.get('run_id')} vs trailing window"
+    )
+    print(reg.format_rows(
+        rows, ("metric", "n", "last", "median", "delta", "z", "verdict")
+    ))
+    regressed = [r["metric"] for r in rows if r.get("verdict") == "REGRESSED"]
+    if regressed:
+        print("REGRESSED: " + ", ".join(regressed))
+    return 0
+
+
 def main(argv: list[str]) -> int:
+    if argv and argv[0] in ("trend", "compare", "registry-backfill"):
+        return _registry_cli(argv)
     args = [a for a in argv if not a.startswith("-")]
     flags = {a for a in argv if a.startswith("-")}
     commands = (

@@ -1,0 +1,385 @@
+"""Declarative SLO/health alert engine (ISSUE 16).
+
+Five rules evaluated against live ``/status`` snapshots and fleet
+aggregation — the "actuator" side of the PR 13–15 sensors:
+
+- ``slo_burn_rate``   — multi-window burn rate over the violation-rate
+  counters PR 14 already exports: the fast (5 m) AND slow (1 h)
+  trailing windows must BOTH exceed the SLO budget before the page
+  fires. The AND-gate is the standard two-window construction: the
+  slow window proves the burn is sustained (no page on one bad
+  minute), the fast window proves it is still happening (no page an
+  hour after recovery). An empty or single-sample window never fires.
+- ``hbm_headroom``    — the tightest replica's free-HBM fraction shrank
+  under the PR 15 budget line.
+- ``goodput_drop``    — the run's goodput-so-far fraction fell under
+  the floor after the run settled (steps > 0).
+- ``health_collapse`` — the fleet's worst replica health score fell
+  under the floor.
+- ``stale_replicas``  — replicas stopped answering /status.
+
+Lifecycle: a rule entering its firing condition emits ONE
+``alert.fired`` event (severity + runbook anchor + message); while it
+stays firing, nothing more is emitted (dedup). When the condition
+clears, ``alert.resolved`` is emitted — but only after the alert has
+been active for ``TPUFLOW_ALERT_COOLDOWN_S`` (anti-flap hold: a
+condition oscillating faster than the cooldown stays one alert).
+
+Everything here is host-pure and jax-free: the engine consumes snapshot
+dicts, the clock is injectable, and tests drive exact fired/resolved
+sequences with zero device work (``compile_stats()`` unchanged on the
+shared warmed engine is pinned in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tpuflow.obs import recorder as _rec
+from tpuflow.utils import knobs
+
+# Severity ladder (ordered): page > ticket > info.
+SEVERITIES = ("page", "ticket", "info")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One declarative alert rule: identity, severity, and the README
+    runbook anchor an operator lands on. The firing conditions live in
+    ``AlertEngine._evaluate`` — they need engine state (counter
+    windows), the rows here are the operator-facing contract."""
+
+    name: str
+    severity: str
+    runbook: str
+    doc: str
+
+
+RULES: tuple[Rule, ...] = (
+    Rule(
+        "slo_burn_rate", "page", "regression--alerting-runbook",
+        "fast AND slow trailing windows both burning the SLO "
+        "violation-rate budget",
+    ),
+    Rule(
+        "hbm_headroom", "page", "device-observatory-runbook",
+        "tightest replica's free-HBM fraction under the budget line",
+    ),
+    Rule(
+        "goodput_drop", "ticket", "goodput--live-monitoring-runbook",
+        "goodput-so-far fraction under the floor after the run settled",
+    ),
+    Rule(
+        "health_collapse", "page", "fleet-observability-runbook",
+        "worst replica health score under the floor",
+    ),
+    Rule(
+        "stale_replicas", "ticket", "fleet-observability-runbook",
+        "one or more replicas stopped answering /status",
+    ),
+)
+
+
+# ------------------------------------------------- burn-rate math (pure)
+def window_rate(
+    samples: list[tuple[float, float, float]],
+    now: float,
+    window_s: float,
+) -> float | None:
+    """Violation rate over the trailing window of cumulative
+    ``(ts, requests, violations)`` counter samples. None — "cannot
+    judge", which never fires — when the window holds fewer than two
+    samples or no request flowed across it. Counter resets (a replica
+    restart) clamp to 0 instead of going negative."""
+    if window_s <= 0:
+        return None
+    cut = now - window_s
+    win = [s for s in samples if s[0] >= cut]
+    if len(win) < 2:
+        return None
+    d_req = win[-1][1] - win[0][1]
+    d_vio = win[-1][2] - win[0][2]
+    if d_req <= 0:
+        return None
+    return max(d_vio, 0.0) / d_req
+
+
+def burn_gate(
+    samples: list[tuple[float, float, float]],
+    now: float,
+    fast_s: float,
+    slow_s: float,
+    budget: float,
+) -> tuple[bool, dict[str, Any]]:
+    """The two-window AND-gate: fires iff BOTH the fast and the slow
+    trailing windows' violation rates exceed ``budget``. Either window
+    empty/short → never fires (property-tested)."""
+    fast = window_rate(samples, now, fast_s)
+    slow = window_rate(samples, now, slow_s)
+    detail: dict[str, Any] = {
+        "fast_rate": fast, "slow_rate": slow, "budget": budget,
+    }
+    if fast is None or slow is None or budget <= 0:
+        return False, detail
+    detail["fast_burn"] = round(fast / budget, 3)
+    detail["slow_burn"] = round(slow / budget, 3)
+    return fast > budget and slow > budget, detail
+
+
+class AlertEngine:
+    """Evaluate the declarative rules against snapshots, with
+    deduplicated fired/resolved lifecycle events.
+
+    ``clock`` is injectable (tests pin exact sequences); thresholds
+    default from the ``TPUFLOW_ALERT_*`` knobs. ``observe()`` is safe
+    from the export server's handler threads."""
+
+    def __init__(
+        self,
+        *,
+        rules: tuple[Rule, ...] = RULES,
+        clock: Callable[[], float] = time.monotonic,
+        slo_budget: float | None = None,
+        fast_window_s: float | None = None,
+        slow_window_s: float | None = None,
+        hbm_headroom: float | None = None,
+        goodput_min: float | None = None,
+        min_health: float | None = None,
+        cooldown_s: float | None = None,
+    ):
+        self.rules = {r.name: r for r in rules}
+        self._clock = clock
+        if slo_budget is None:
+            slo_budget = knobs.get_float("TPUFLOW_ALERT_SLO_BUDGET")
+        if fast_window_s is None:
+            fast_window_s = knobs.get_float("TPUFLOW_ALERT_FAST_WINDOW_S")
+        if slow_window_s is None:
+            slow_window_s = knobs.get_float("TPUFLOW_ALERT_SLOW_WINDOW_S")
+        if hbm_headroom is None:
+            hbm_headroom = knobs.get_float("TPUFLOW_ALERT_HBM_HEADROOM")
+        if goodput_min is None:
+            goodput_min = knobs.get_float("TPUFLOW_ALERT_GOODPUT_MIN")
+        if min_health is None:
+            min_health = knobs.get_float("TPUFLOW_ALERT_MIN_HEALTH")
+        if cooldown_s is None:
+            cooldown_s = knobs.get_float("TPUFLOW_ALERT_COOLDOWN_S")
+        self.slo_budget = float(slo_budget)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.hbm_headroom = float(hbm_headroom)
+        self.goodput_min = float(goodput_min)
+        self.min_health = float(min_health)
+        self.cooldown_s = float(cooldown_s)
+        self._samples: deque[tuple[float, float, float]] = deque()
+        self._active: dict[str, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ evaluation
+    def _feed_counters(
+        self, now: float, status: dict | None, fleet: dict | None
+    ) -> None:
+        req = vio = None
+        if status is not None:
+            req = status.get("serve_requests")
+            vio = status.get("serve_slo_violations")
+        if req is None and fleet is not None:
+            req = fleet.get("requests")
+            vio = fleet.get("slo_violations")
+        if isinstance(req, (int, float)) and isinstance(
+            vio, (int, float)
+        ):
+            self._samples.append((now, float(req), float(vio)))
+            cut = now - self.slow_window_s
+            while self._samples and self._samples[0][0] < cut:
+                self._samples.popleft()
+
+    def _evaluate(
+        self, now: float, status: dict | None, fleet: dict | None
+    ) -> dict[str, tuple[str, Any]]:
+        """rule name -> (message, value) for every rule firing NOW."""
+        firing: dict[str, tuple[str, Any]] = {}
+        burns, detail = burn_gate(
+            list(self._samples), now, self.fast_window_s,
+            self.slow_window_s, self.slo_budget,
+        )
+        if burns:
+            firing["slo_burn_rate"] = (
+                f"SLO burn: fast {detail['fast_burn']}x / slow "
+                f"{detail['slow_burn']}x the "
+                f"{self.slo_budget:.4g} violation-rate budget",
+                detail.get("fast_rate"),
+            )
+        frac = None
+        if status is not None and isinstance(
+            status.get("hbm_used_frac"), (int, float)
+        ):
+            frac = float(status["hbm_used_frac"])
+        if frac is None and fleet is not None and isinstance(
+            fleet.get("hbm_used_frac_max"), (int, float)
+        ):
+            frac = float(fleet["hbm_used_frac_max"])
+        if frac is not None and (1.0 - frac) < self.hbm_headroom:
+            firing["hbm_headroom"] = (
+                f"HBM headroom {1.0 - frac:.3f} under the "
+                f"{self.hbm_headroom:.3f} budget line "
+                f"(used {frac:.3f} of the tightest device)",
+                round(1.0 - frac, 4),
+            )
+        if status is not None:
+            gp = status.get("goodput_fraction")
+            steps = status.get("steps", 0)
+            if (
+                isinstance(gp, (int, float))
+                and isinstance(steps, (int, float))
+                and steps > 0
+                and gp < self.goodput_min
+            ):
+                firing["goodput_drop"] = (
+                    f"goodput fraction {gp:.3f} under the "
+                    f"{self.goodput_min:.2f} floor",
+                    float(gp),
+                )
+        if fleet is not None:
+            mh = fleet.get("min_health")
+            if (
+                isinstance(mh, (int, float))
+                and fleet.get("replicas", 0)
+                and mh < self.min_health
+            ):
+                firing["health_collapse"] = (
+                    f"worst replica health {mh:.2f} under the "
+                    f"{self.min_health:.2f} floor",
+                    float(mh),
+                )
+            stale = fleet.get("stale")
+            if isinstance(stale, (int, float)) and stale > 0:
+                firing["stale_replicas"] = (
+                    f"{int(stale)} replica(s) stale "
+                    f"(of {fleet.get('replicas', '?')})",
+                    int(stale),
+                )
+        return {k: v for k, v in firing.items() if k in self.rules}
+
+    # ------------------------------------------------------- lifecycle
+    def observe(
+        self,
+        status: dict | None = None,
+        fleet: dict | None = None,
+    ) -> list[dict[str, Any]]:
+        """One evaluation sweep. Returns the lifecycle transitions THIS
+        sweep caused — ``{"state": "fired"|"resolved", ...}`` — each
+        also emitted to the event stream. A rule already active stays
+        silent while it keeps firing (dedup); a clearing rule resolves
+        only after ``cooldown_s`` of activity (anti-flap)."""
+        with self._lock:
+            now = self._clock()
+            self._feed_counters(now, status, fleet)
+            firing = self._evaluate(now, status, fleet)
+            transitions: list[dict[str, Any]] = []
+            for name, rule in self.rules.items():
+                hit = firing.get(name)
+                st = self._active.get(name)
+                if hit is not None and st is None:
+                    message, value = hit
+                    st = {
+                        "rule": name,
+                        "severity": rule.severity,
+                        "runbook": rule.runbook,
+                        "message": message,
+                        "value": value,
+                        "since": now,
+                    }
+                    self._active[name] = st
+                    _rec.event(
+                        "alert.fired",
+                        rule=name,
+                        severity=rule.severity,
+                        runbook=rule.runbook,
+                        message=message,
+                        value=value,
+                    )
+                    transitions.append({"state": "fired", **st})
+                elif hit is not None:
+                    st["message"], st["value"] = hit
+                elif st is not None:
+                    if now - st["since"] >= self.cooldown_s:
+                        del self._active[name]
+                        _rec.event(
+                            "alert.resolved",
+                            rule=name,
+                            severity=rule.severity,
+                            runbook=rule.runbook,
+                            active_s=round(now - st["since"], 3),
+                        )
+                        transitions.append(
+                            {
+                                "state": "resolved",
+                                "active_s": round(now - st["since"], 3),
+                                **st,
+                            }
+                        )
+            return transitions
+
+    def active(self) -> list[dict[str, Any]]:
+        """Current active alerts (the /alerts endpoint body), severity-
+        major order."""
+        with self._lock:
+            sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
+            return sorted(
+                (dict(a) for a in self._active.values()),
+                key=lambda a: (
+                    sev_rank.get(a["severity"], len(SEVERITIES)),
+                    a["rule"],
+                ),
+            )
+
+    def describe(self) -> list[dict[str, str]]:
+        """The rule table (the /alerts endpoint's ``rules`` section and
+        the README runbook's source of truth)."""
+        return [
+            {
+                "rule": r.name,
+                "severity": r.severity,
+                "runbook": r.runbook,
+                "doc": r.doc,
+            }
+            for r in self.rules.values()
+        ]
+
+
+def format_transition(t: dict[str, Any]) -> str:
+    """One ALERT line for tpu_watch --follow/--fleet."""
+    if t["state"] == "fired":
+        return (
+            f"ALERT [{t['severity']}] {t['rule']} FIRED: {t['message']} "
+            f"(runbook #{t['runbook']})"
+        )
+    return (
+        f"ALERT [{t['severity']}] {t['rule']} RESOLVED "
+        f"after {t.get('active_s', 0.0):.1f}s"
+    )
+
+
+_ENGINE: AlertEngine | None = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def engine() -> AlertEngine:
+    """The process's shared alert engine (the export server's /alerts
+    route evaluates it against each /status snapshot it serves)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = AlertEngine()
+        return _ENGINE
+
+
+def reset() -> None:
+    """Drop the shared engine (tests)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = None

@@ -418,6 +418,31 @@ CATALOG: dict[str, tuple[str, str]] = {
         "bytes summed over the compiled inventory vs bytes_limit "
         "(over=True warns BEFORE an OOM; ratio keys absent off-TPU)",
     ),
+    # -------------------------------------------------------------- alerts
+    # Decision observatory (ISSUE 16): the run registry's append audit
+    # trail and the alert engine's deduplicated lifecycle — emitted by
+    # tpuflow.obs.registry / tpuflow.obs.alerts, read by the timeline
+    # card's Alerts section, the /alerts endpoint, and the tpu_watch
+    # ALERT lines.
+    "registry.append": (
+        "event",
+        "one schema-versioned headline record appended to the "
+        "TPUFLOW_REGISTRY_PATH run registry (path, run_id, kind, "
+        "metric count) — the cross-run regression ledger's write "
+        "audit",
+    ),
+    "alert.fired": (
+        "event",
+        "a declarative alert rule entered its firing condition "
+        "(rule, severity, runbook anchor, message, value) — emitted "
+        "ONCE per activation; the condition persisting is deduplicated",
+    ),
+    "alert.resolved": (
+        "event",
+        "an active alert's condition cleared after at least "
+        "TPUFLOW_ALERT_COOLDOWN_S of activity (rule, severity, "
+        "runbook, active_s) — flaps inside the cooldown never emit",
+    ),
     # -------------------------------------------------------------- prof
     "prof.capture": (
         "event",

@@ -1,4 +1,4 @@
-"""Live metrics export: /metrics (Prometheus) + /status (JSON) mid-run.
+"""Live export: /metrics (Prometheus), /status (JSON), /alerts mid-run.
 
 Opt-in via ``TPUFLOW_OBS_HTTP_PORT``: gang member 0 (or the training
 process itself, outside a gang) starts one daemon-threaded HTTP server
@@ -163,6 +163,23 @@ class _Handler(BaseHTTPRequestHandler):
                 snap.setdefault("replica", _fleet.replica_identity())
                 body = (json.dumps(snap) + "\n").encode()
                 ctype = "application/json"
+            elif route == "/alerts":
+                # Alert engine (ISSUE 16): every scrape evaluates the
+                # rules against a fresh snapshot, so firing/resolving
+                # needs no separate poller thread — the scraper IS the
+                # sweep. Serialized: handler threads share one engine.
+                eng = getattr(self.server, "_tpuflow_alerts", None)
+                if eng is None:
+                    self.send_error(404)
+                    return
+                with self.server._tpuflow_alerts_lock:
+                    eng.observe(status=self._snapshot())
+                    payload = {
+                        "active": eng.active(),
+                        "rules": eng.describe(),
+                    }
+                body = (json.dumps(payload) + "\n").encode()
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return
@@ -184,10 +201,13 @@ class MetricsServer:
     several in-process replicas this way)."""
 
     def __init__(
-        self, port: int = 0, host: str = "127.0.0.1", snapshot_fn=None
+        self, port: int = 0, host: str = "127.0.0.1", snapshot_fn=None,
+        alert_engine=None,
     ):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._tpuflow_snapshot = snapshot_fn
+        self._httpd._tpuflow_alerts = alert_engine
+        self._httpd._tpuflow_alerts_lock = threading.Lock()
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])
@@ -245,8 +265,14 @@ def maybe_start_from_env(proc: int | None = None) -> MetricsServer | None:
     if proc != 0:
         return None  # one endpoint per gang: member 0 owns it
     host = knobs.raw("TPUFLOW_OBS_HTTP_HOST", "127.0.0.1")
+    # Alert engine (ISSUE 16): the live endpoint always carries
+    # /alerts — the process singleton engine, evaluated per scrape.
+    from tpuflow.obs import alerts as _alerts
+
     try:
-        _SERVER = MetricsServer(port, host=host)
+        _SERVER = MetricsServer(
+            port, host=host, alert_engine=_alerts.engine()
+        )
     except OSError as e:
         print(
             f"[tpuflow] obs export failed to bind {host}:{port} "

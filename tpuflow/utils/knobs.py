@@ -52,6 +52,7 @@ _A_SERVE = "serving-runbook"
 _A_FLEET = "fleet-observability-runbook"
 _A_DEVICE = "device-observatory-runbook"
 _A_QUANT = "quantization-runbook"
+_A_ALERTS = "regression--alerting-runbook"
 _A_OBS = "goodput--live-monitoring-runbook"
 _A_OBS_BASE = "observability"
 _A_SETUP = "setup"
@@ -471,6 +472,42 @@ REGISTRY: dict[str, Knob] = dict(
         _k("TPUFLOW_PROF_DIR", "path", None,
            "triggered-capture output dir when telemetry is disabled "
            "(default <obs_dir>/profile)", "device", _A_DEVICE),
+        # --------------------------------------------------------- alerts
+        _k("TPUFLOW_REGISTRY_PATH", "path", None,
+           "run-registry JSONL: every training run, serving run, and "
+           "bench.py invocation appends one schema-versioned headline "
+           "record here (unset = implicit run-end appends off; "
+           "bench.py defaults to TPU_REGISTRY.jsonl beside its "
+           "records)", "alerts", _A_ALERTS, default_doc="unset"),
+        _k("TPUFLOW_REGISTRY_WINDOW", "int", 5,
+           "trailing runs the trend/verdict median+MAD window spans",
+           "alerts", _A_ALERTS),
+        _k("TPUFLOW_REGISTRY_ZMADS", "float", 8.0,
+           "regression threshold in robust MADs from the trailing "
+           "median (the PR 15 detector idiom, host-side)",
+           "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_SLO_BUDGET", "float", 0.01,
+           "SLO violation-rate budget the burn-rate windows are "
+           "measured against (violations / requests)", "alerts",
+           _A_ALERTS),
+        _k("TPUFLOW_ALERT_FAST_WINDOW_S", "float", 300.0,
+           "fast burn-rate window (s); the page needs the fast AND "
+           "slow windows both over budget", "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_SLOW_WINDOW_S", "float", 3600.0,
+           "slow burn-rate window (s) — proves the burn is sustained, "
+           "not one bad minute", "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_HBM_HEADROOM", "float", 0.08,
+           "free-HBM fraction floor (tightest device/replica); "
+           "headroom under it fires hbm_headroom", "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_GOODPUT_MIN", "float", 0.5,
+           "goodput-fraction floor; a settled run (steps > 0) under it "
+           "fires goodput_drop", "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_MIN_HEALTH", "float", 0.5,
+           "worst-replica health-score floor; a fleet under it fires "
+           "health_collapse", "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_COOLDOWN_S", "float", 60.0,
+           "minimum seconds an alert stays active before it may "
+           "resolve (anti-flap hold)", "alerts", _A_ALERTS),
         # -------------------------------------------------------- testing
         _k("TPUFLOW_FAULT", "str", None,
            "comma-separated fault-injection specs (chaos suite)",
@@ -541,6 +578,7 @@ _SUBSYSTEM_TITLES = (
     ("serve", "Serving"),
     ("fleet", "Fleet observatory"),
     ("device", "Device observatory"),
+    ("alerts", "Run registry & alerting"),
     ("testing", "Fault injection & testing"),
     ("bench", "Benchmark"),
     ("e2e", "On-chip e2e"),
