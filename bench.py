@@ -770,8 +770,134 @@ def bench_serving(model, params, cfg, on_tpu: bool) -> dict:
         rec["paged"] = bench_serving_paged(model, params, cfg, on_tpu)
     except Exception as e:  # the paged sub-leg must not erase the record
         rec["paged"] = {"error": repr(e)[:300]}
+    if knobs.raw("TPUFLOW_BENCH_ROUTER") != "0":
+        try:
+            rec["router"] = bench_serving_router(model, params, cfg, on_tpu)
+        except Exception as e:  # the router sub-leg must not erase it
+            rec["router"] = {"error": repr(e)[:300]}
     _log(f"[bench] serving: {rec}")
     return rec
+
+
+def bench_serving_router(model, params, cfg, on_tpu: bool) -> dict:
+    """serving.router sub-leg (ISSUE 17): Poisson load through the
+    front-door router against THREE live in-process replicas, with one
+    replica killed mid-drive.
+
+    The record the regression ledger watches is ``dropped_requests`` —
+    accepted work that got neither an answer nor an explicit 503 — and
+    it MUST be 0: the kill is absorbed by re-dispatch (``reroutes`` > 0
+    is the evidence the fault actually landed on in-flight work), and
+    the routed p50/p99 bound what failover costs the tail. Everything
+    runs over real HTTP: gateway /generate forwards, /status polls
+    through a registration dir, a real FleetObservatory snapshot chain.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from tpuflow.infer.frontdoor import http_forward
+    from tpuflow.infer.router import Router
+    from tpuflow.infer.serve import ServeEngine
+    from tpuflow.obs import fleet as obs_fleet
+    from tpuflow.testing.chaos import (
+        LocalReplica,
+        apply_replica_plan,
+        run_poisson,
+    )
+
+    rng = np.random.default_rng(7)
+    if on_tpu:
+        R, M, rate_qps, kill_at = 24, 16, 40.0, 0.25
+    else:
+        R, M, rate_qps, kill_at = 10, 8, 20.0, 0.15
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=int(L)).astype(np.int32)
+        for L in rng.integers(4, 24, size=R)
+    ]
+    reg = tempfile.mkdtemp(prefix="tpuflow-router-bench-")
+    dev_lock = threading.Lock()
+    replicas: dict[str, LocalReplica] = {}
+    poller = None
+    try:
+        for i in range(3):
+            eng = ServeEngine(
+                model, params, max_slots=4, decode_block=4,
+                buckets=[32], page_size=8,
+            )
+            with dev_lock:
+                eng.warmup()  # serial: chaos starts post-compile
+            rep = LocalReplica(
+                f"bench-{i}", eng,
+                registration_dir=reg, device_lock=dev_lock,
+            )
+            replicas[rep.id] = rep
+        obsy = obs_fleet.FleetObservatory(
+            reg, timeout_s=0.5, stale_s=2.0, poll_interval_s=0.02,
+        )
+        # The HTTP sweep runs on the poller's thread; the router reads
+        # only its cached snapshot (the cheap-snapshot_fn contract).
+        poller = obs_fleet.FleetPoller(obsy, interval_s=0.02)
+        router = Router(
+            poller.snapshot, http_forward,
+            page_size=8, timeout_s=15.0, retries=4, backoff_s=0.02,
+            queue_timeout_s=60.0, refresh_s=0.05,
+        )
+        router.refresh(force=True)
+        reqs = [
+            {
+                "id": f"bench-req-{k}",
+                "prompt": [int(t) for t in prompts[k]],
+                "max_new_tokens": M,
+            }
+            for k in range(R)
+        ]
+        chaos = apply_replica_plan(
+            replicas, [("replica_kill", "bench-1", kill_at)],
+            t0=_time.monotonic(),
+        )
+        results = run_poisson(
+            router.route, reqs, rate_qps=rate_qps, rng=rng
+        )
+        chaos.join(timeout=10.0)
+        stats = router.stats()
+        lat = sorted(
+            r["latency_s"] for r in results if r["outcome"] == "ok"
+        )
+        errors = [r for r in results if r["outcome"] == "error"]
+        return {
+            "requests": R,
+            "new_tokens": M,
+            "replicas": 3,
+            "killed": "bench-1",
+            "kill_at_s": kill_at,
+            # The headline number — the zero-drop contract.
+            "dropped_requests": len(errors) + stats["router_dropped"],
+            "ok": sum(1 for r in results if r["outcome"] == "ok"),
+            "rejected": stats["router_rejected"],
+            "reroutes": stats["router_reroutes"],
+            "retries": stats["router_retries"],
+            "affinity_hits": stats["router_affinity_hits"],
+            "routed_p50_s": (
+                round(lat[len(lat) // 2], 4) if lat else None
+            ),
+            "routed_p99_s": (
+                round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4)
+                if lat else None
+            ),
+        }
+    finally:
+        if poller is not None:
+            poller.close()
+        for rep in replicas.values():
+            try:
+                rep.close()
+            except OSError:
+                pass
+        shutil.rmtree(reg, ignore_errors=True)
 
 
 def bench_serving_paged(model, params, cfg, on_tpu: bool) -> dict:

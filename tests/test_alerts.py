@@ -177,6 +177,66 @@ def test_hbm_and_fleet_rules_with_severity_and_runbook():
     ]
 
 
+def test_reroute_spike_rate_threshold_and_lifecycle():
+    """ISSUE 17 satellite: the front-door reroute rate rides the same
+    cumulative-counter window_rate construction as the burn gate —
+    fed from ``router_requests``/``router_reroutes`` in /status — and
+    fires the ticket-severity ``reroute_spike`` only past the
+    threshold, never on a single sample or a flowless window."""
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=0.0, reroute_rate=0.1)
+    # One sample: window_rate has nothing to difference -> silent.
+    assert eng.observe(
+        status={"router_requests": 0, "router_reroutes": 0}
+    ) == []
+    # Healthy flow: 1 reroute per 100 requests (0.01 < 0.1) -> silent.
+    clock.t += 10.0
+    assert eng.observe(
+        status={"router_requests": 100, "router_reroutes": 1}
+    ) == []
+    # Replicas dying faster than the fleet absorbs: 31 reroutes over
+    # 200 requests in the fast window (0.155 > 0.1) -> fires once,
+    # with the router runbook anchor on the transition.
+    clock.t += 10.0
+    fired = eng.observe(
+        status={"router_requests": 200, "router_reroutes": 31}
+    )
+    assert [t["rule"] for t in fired] == ["reroute_spike"]
+    assert fired[0]["severity"] == "ticket"
+    assert fired[0]["runbook"] == "router--failover-runbook"
+    assert fired[0]["value"] == round(31 / 200, 4)
+    # Still burning next sweep: dedup, no second transition.
+    clock.t += 1.0
+    assert eng.observe(
+        status={"router_requests": 210, "router_reroutes": 32}
+    ) == []
+    # Traffic recovers (rate diluted under the threshold): resolves.
+    clock.t += 10.0
+    resolved = eng.observe(
+        status={"router_requests": 2000, "router_reroutes": 33}
+    )
+    assert [(t["rule"], t["state"]) for t in resolved] == [
+        ("reroute_spike", "resolved")
+    ]
+    assert eng.active() == []
+
+
+def test_reroute_spike_never_fires_without_request_flow():
+    """Counters present but no request flowed between sweeps: the
+    window rate is undefined (None), and undefined never pages —
+    an idle router with a scary past is not an incident."""
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=0.0, reroute_rate=0.0)
+    for _ in range(3):
+        clock.t += 10.0
+        assert eng.observe(
+            status={"router_requests": 500, "router_reroutes": 499}
+        ) == []
+    # Statuses missing the router counters feed nothing either.
+    clock.t += 10.0
+    assert eng.observe(status={"goodput_fraction": 0.9, "steps": 5}) == []
+
+
 def test_slo_burn_fires_through_engine_and_emits_events(tmp_path):
     from tpuflow import obs
 

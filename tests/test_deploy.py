@@ -164,6 +164,58 @@ def test_deploy_cli_writes_manifests(isolated_home, tmp_path):
     assert any(f.endswith(".cronjob.yaml") for f in files)
 
 
+def test_router_deployment_manifest(tmp_path):
+    """Front-door router Deployment (ISSUE 17): a HOST deployment — no
+    TPU resource request, no accelerator node selector — fronting the
+    serving fleet. The TPUFLOW_ROUTER_* shape rides the pod env (bind
+    0.0.0.0, the fleet's headless Service as the discovery target), the
+    readiness probe hits the router's own /healthz, and the ClusterIP
+    Service is the client-facing address."""
+    from tpuflow.flow.deploy import materialize_router
+
+    files = materialize_router(
+        "gpt2_router",
+        str(tmp_path / "m"),
+        replicas=2,
+        port=8900,
+        fleet_target="http://gpt2-serve-fleet:9100",
+        timeout_s=30.0,
+        retries=4,
+        queue_timeout_s=45.0,
+        autoscale=True,
+        env={"TPUFLOW_ROUTER_MIN_HEALTH": "0.5"},
+    )
+    assert sorted(os.path.basename(f) for f in files) == [
+        "gpt2-router.deployment.yaml",
+        "gpt2-router.service.yaml",
+    ]
+    with open(tmp_path / "m" / "gpt2-router.deployment.yaml") as f:
+        dep = yaml.safe_load(f)
+    assert dep["kind"] == "Deployment"
+    assert dep["spec"]["replicas"] == 2
+    pod = dep["spec"]["template"]["spec"]
+    (container,) = pod["containers"]
+    # Host-side: the router never touches a device.
+    assert "resources" not in container
+    assert "nodeSelector" not in pod
+    probe = container["readinessProbe"]["httpGet"]
+    assert probe == {"path": "/healthz", "port": 8900}
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TPUFLOW_ROUTER_PORT"] == "8900"
+    assert env["TPUFLOW_ROUTER_HOST"] == "0.0.0.0"
+    assert env["TPUFLOW_ROUTER_TARGET"] == "http://gpt2-serve-fleet:9100"
+    assert env["TPUFLOW_ROUTER_TIMEOUT_S"] == "30.0"
+    assert env["TPUFLOW_ROUTER_RETRIES"] == "4"
+    assert env["TPUFLOW_ROUTER_QUEUE_TIMEOUT_S"] == "45.0"
+    assert env["TPUFLOW_ROUTER_AUTOSCALE"] == "1"
+    assert env["TPUFLOW_ROUTER_MIN_HEALTH"] == "0.5"
+    with open(tmp_path / "m" / "gpt2-router.service.yaml") as f:
+        svc = yaml.safe_load(f)
+    assert svc["kind"] == "Service"
+    assert svc["spec"]["selector"] == {"app": "gpt2-router"}
+    assert svc["spec"]["ports"][0]["port"] == 8900
+
+
 def test_serving_deployment_manifest(tmp_path):
     """Serving Deployment (ISSUE 8 + fleet wiring, ISSUE 14): long-lived
     replicas with TPU node selectors, the /status readiness probe on the

@@ -106,6 +106,42 @@ def test_fault_spec_parsing():
         faults.parse("ckpt_partial_commit:1")
 
 
+def test_replica_fault_specs_and_plan(monkeypatch):
+    """Serving-chaos vocabulary (ISSUE 17): ``replica_kill:<id>@<t>`` /
+    ``replica_stall:<id>@<t>`` parse into targeted, timed Faults; the
+    ``replica_plan()`` hook returns the time-sorted schedule the chaos
+    harness executes; malformed specs fail loudly."""
+    specs = faults.parse(
+        "replica_kill:replica-1@0.4,replica_stall:replica-2@0.2"
+    )
+    assert specs[0] == faults.Fault(
+        "replica_kill", value=0.4, target="replica-1"
+    )
+    assert specs[1].kind == "replica_stall"
+    assert specs[1].target == "replica-2"
+    assert specs[1].value == 0.2
+    for bad in (
+        "replica_kill",          # needs a payload
+        "replica_kill:r1",       # needs @t
+        "replica_kill:@0.4",     # needs an id
+        "replica_stall:r1@soon", # t must be seconds
+    ):
+        with pytest.raises(ValueError):
+            faults.parse(bad)
+    # The plan is (kind, id, at_s), sorted by fire time, and empty
+    # (zero-cost) when the knob is unset.
+    monkeypatch.setenv(
+        "TPUFLOW_FAULT",
+        "replica_kill:replica-1@0.4,replica_stall:replica-2@0.2",
+    )
+    assert faults.replica_plan() == [
+        ("replica_stall", "replica-2", 0.2),
+        ("replica_kill", "replica-1", 0.4),
+    ]
+    monkeypatch.delenv("TPUFLOW_FAULT")
+    assert faults.replica_plan() == []
+
+
 def test_ckpt_io_fault_is_per_op_path_and_bounded(monkeypatch):
     """ckpt_io_flaky:p2 injects exactly two transient EIOs per distinct
     (op, path) and then stands down — deterministic for retry tests."""

@@ -14,6 +14,7 @@ lives in tests/test_serve.py)."""
 
 import json
 import os
+import threading
 import time
 
 import pytest
@@ -378,6 +379,65 @@ def test_poller_qps_queue_trend_and_snapshot_jsonl(tmp_path):
         lines = [json.loads(ln) for ln in f if ln.strip()]
     assert len(lines) == 3
     assert lines[-1]["fleet"]["replicas"] == 1
+
+
+def test_append_snapshot_multi_writer_and_torn_tail(tmp_path):
+    """ISSUE 17 satellite: the snapshot trail is multi-writer safe.
+    The router's poller and a concurrent ``tpu_watch --fleet`` may
+    share one TPUFLOW_FLEET_SNAPSHOT_PATH — each snapshot must land as
+    ONE O_APPEND write (lines interleave, bytes never do), and the
+    reader must skip a torn tail instead of raising."""
+    path = str(tmp_path / "trail" / "fleet.jsonl")  # dir auto-created
+    n_writers, n_each = 8, 25
+    barrier = threading.Barrier(n_writers)
+    oks: list[bool] = []
+
+    def writer(k):
+        barrier.wait()
+        for i in range(n_each):
+            oks.append(
+                fleet.append_snapshot(
+                    path, {"fleet": {"writer": k, "seq": i}}
+                )
+            )
+
+    threads = [
+        threading.Thread(target=writer, args=(k,))
+        for k in range(n_writers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(oks)
+    snaps = fleet.read_snapshots(path)
+    # Every line intact and parseable: no interleaved bytes, no loss.
+    assert len(snaps) == n_writers * n_each
+    for k in range(n_writers):
+        seqs = [
+            s["fleet"]["seq"]
+            for s in snaps
+            if s["fleet"]["writer"] == k
+        ]
+        assert seqs == list(range(n_each))  # per-writer order holds
+    # A crash mid-append tears at most the final line; the reader
+    # skips it (no trailing newline) without raising.
+    with open(path, "a") as f:
+        f.write('{"fleet": {"torn": tru')
+    assert len(fleet.read_snapshots(path)) == n_writers * n_each
+    # The next appender writes AFTER the torn bytes: the merged line
+    # is corrupt (skipped), and a fresh append lands clean again.
+    fleet.append_snapshot(path, {"fleet": {"merged_into_torn": True}})
+    assert len(fleet.read_snapshots(path)) == n_writers * n_each
+    fleet.append_snapshot(path, {"fleet": {"clean": True}})
+    snaps = fleet.read_snapshots(path)
+    assert len(snaps) == n_writers * n_each + 1
+    assert snaps[-1]["fleet"] == {"clean": True}
+    # Non-snapshot JSON values are skipped too; a missing file reads [].
+    with open(path, "a") as f:
+        f.write('"just a string"\n{"no_fleet": 1}\n')
+    assert len(fleet.read_snapshots(path)) == n_writers * n_each + 1
+    assert fleet.read_snapshots(str(tmp_path / "missing.jsonl")) == []
 
 
 def test_tpu_watch_fleet_survives_truncated_status_over_http(capsys):

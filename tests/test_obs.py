@@ -394,6 +394,17 @@ def test_obs_catalog_lint():
         ("event", "registry.append"),
         ("event", "alert.fired"),
         ("event", "alert.resolved"),
+        # Front-door router (ISSUE 17) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
+        # admission, failover, drain, and autoscale evidence.
+        ("event", "router.admit"),
+        ("event", "router.reject"),
+        ("event", "router.retry"),
+        ("event", "router.reroute"),
+        ("event", "router.drain"),
+        ("event", "router.replace"),
+        ("gauge", "router.queue_depth"),
+        ("gauge", "router.budget_pages"),
         # Native int8 decode (ISSUE 9) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
         ("span", "serve.quant_decode"),

@@ -1,0 +1,805 @@
+"""Front-door router unit tests (ISSUE 17) — fast and device-free.
+
+Every policy the router composes is pinned against INJECTED fleet
+snapshots, fake forwards, and injected clocks/sleeps: token-budget
+admission (wait, then admit; bounded wait, then explicit 503),
+health x trend balance scoring, prefix-affinity digest matching
+(bit-equal to PagePool's chain), the retry/backoff/reroute state
+machine (including exhaustion → FleetBusy, never a hang), drain
+bookkeeping, idempotent replay, the autoscale controller's dedup'd
+actions, and the HTTP faces (ReplicaGateway + FrontDoor + the
+http_forward contract) over a fake engine. The heavy end-to-end chaos
+acceptance lives in tests/test_router_chaos.py (slow-marked).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuflow.infer import router as router_mod
+from tpuflow.infer.frontdoor import (
+    FrontDoor,
+    ReplicaGateway,
+    http_forward,
+)
+from tpuflow.infer.router import (
+    AutoscaleController,
+    FleetBusy,
+    Router,
+    pages_needed,
+    prefix_digests,
+    route_score,
+)
+
+
+def _row(
+    rid,
+    *,
+    pages=100,
+    health=1.0,
+    trend=0,
+    stale=False,
+    draining=False,
+    url=None,
+):
+    row = {
+        "id": rid,
+        "stale": stale,
+        "health": health,
+        "queue_trend": trend,
+        "serve_pages_free": pages,
+    }
+    if draining:
+        row["serve_draining"] = True
+    if url:
+        row["generate_url"] = url
+    return row
+
+
+def _snap(rows, **fleet):
+    return {"ts": 0.0, "fleet": dict(fleet), "replicas": rows}
+
+
+def _router(state, forward, **kw):
+    """Router over a mutable row-list closure, tuned for fast tests."""
+    kw.setdefault("page_size", 8)
+    kw.setdefault("timeout_s", 5.0)
+    kw.setdefault("retries", 2)
+    kw.setdefault("backoff_s", 0.01)
+    kw.setdefault("queue_timeout_s", 1.0)
+    kw.setdefault("refresh_s", 0.0)  # every admission pass re-reads
+    kw.setdefault("wait_tick_s", 0.01)
+    return Router(lambda: _snap(state["rows"]), forward, **kw)
+
+
+def _echo_forward(row, request, timeout_s):
+    return {"replica": row["id"], "tokens": [1, 2]}
+
+
+# -------------------------------------------------------- pure policy
+def test_pages_needed_and_route_score():
+    assert pages_needed(8, 8, 8) == 2
+    assert pages_needed(9, 8, 8) == 3  # partial page rounds up
+    assert pages_needed(1, 1, 8) == 1  # floor of one page
+    assert route_score(1.0, 0, 0.5) == 1.0
+    assert route_score(1.0, 2, 0.5) == 0.25  # geometric shed
+    assert route_score(0.8, 1, 0.5) == pytest.approx(0.4)
+    assert route_score(-0.5, 0, 0.5) == 0.0  # never negative
+
+
+def test_prefix_digests_bit_equal_to_pagepool():
+    """The router's affinity keys ARE the engine's prefix-cache keys:
+    same int32 cast, same sha1 chain, only fully-covered pages."""
+    from tpuflow.infer.serve import PagePool
+
+    pool = PagePool(n_pages=6, page_size=4)
+    prompt = np.arange(10, dtype=np.int64)  # cast matters: int64 in
+    ours = prefix_digests(prompt, 4)
+    assert ours == pool.prefix_digests(prompt)
+    assert len(ours) == 2  # the trailing 2 tokens never hash
+    assert prefix_digests([1, 2, 3], 4) == []  # no full page
+
+
+# ----------------------------------------------------------- admission
+def test_admission_waits_for_budget_then_admits():
+    state = {"rows": [_row("a", pages=1)]}
+    r = _router(state, _echo_forward)
+
+    def grow():
+        time.sleep(0.1)
+        state["rows"] = [_row("a", pages=8)]
+
+    threading.Thread(target=grow, daemon=True).start()
+    t0 = time.monotonic()
+    # Needs 2 pages (8 prompt + 8 new over page_size 8): queued until
+    # the fleet frees pages — backpressure, not a drop.
+    resp = r.route(
+        {"id": "q1", "prompt": list(range(8)), "max_new_tokens": 8}
+    )
+    assert resp["replica"] == "a"
+    assert time.monotonic() - t0 >= 0.08
+    s = r.stats()
+    assert s["router_requests"] == 1 and s["router_dropped"] == 0
+
+
+def test_admission_timeout_is_explicit_503():
+    state = {"rows": [_row("a", pages=1)]}
+    r = _router(state, _echo_forward, queue_timeout_s=0.15)
+    t0 = time.monotonic()
+    with pytest.raises(FleetBusy):
+        r.route(
+            {"id": "q1", "prompt": list(range(8)), "max_new_tokens": 8}
+        )
+    assert time.monotonic() - t0 < 2.0  # bounded, never a hang
+    s = r.stats()
+    assert s["router_rejected"] == 1
+    assert s["router_dropped"] == 0  # rejected is accounted, not lost
+
+
+def test_inflight_pages_charged_against_budget():
+    """A dispatched request's pages count against the fleet budget
+    until it resolves — the router never oversubscribes a replica on
+    its own stale view of pages_free."""
+    state = {"rows": [_row("a", pages=3)]}
+    hold = threading.Event()
+    started = threading.Event()
+
+    def forward(row, request, timeout_s):
+        if request["id"] == "q1":
+            started.set()
+            assert hold.wait(5.0)
+        return {"replica": row["id"]}
+
+    r = _router(state, forward, queue_timeout_s=2.0)
+    out = {}
+
+    def go(rid):
+        out[rid] = r.route(
+            {"id": rid, "prompt": list(range(8)), "max_new_tokens": 8}
+        )
+
+    t1 = threading.Thread(target=go, args=("q1",), daemon=True)
+    t1.start()
+    assert started.wait(5.0)
+    t2 = threading.Thread(target=go, args=("q2",), daemon=True)
+    t2.start()
+    time.sleep(0.1)
+    assert "q2" not in out  # 3 - 2 charged = 1 free < 2 needed
+    hold.set()
+    t1.join(5.0)
+    t2.join(5.0)
+    assert out["q1"]["replica"] == "a" and out["q2"]["replica"] == "a"
+    assert r.stats()["router_dropped"] == 0
+
+
+# ------------------------------------------------------------- balance
+def test_pick_maximizes_health_times_trend_decay():
+    state = {
+        "rows": [
+            _row("hot", health=1.0, trend=2),  # 1.0 * 0.5^2 = 0.25
+            _row("calm", health=0.9, trend=0),  # 0.9
+        ]
+    }
+    r = _router(state, _echo_forward, trend_decay=0.5)
+    resp = r.route({"id": "q1", "prompt": [1, 2], "max_new_tokens": 1})
+    assert resp["replica"] == "calm"
+
+
+def test_pick_excludes_stale_draining_and_unhealthy():
+    state = {
+        "rows": [
+            _row("dead", stale=True),
+            _row("leaving", draining=True),
+            _row("sick", health=0.1),
+            _row("ok", health=0.6),
+        ]
+    }
+    r = _router(state, _echo_forward, min_health=0.25)
+    for k in range(3):
+        resp = r.route(
+            {"id": f"q{k}", "prompt": [1, 2], "max_new_tokens": 1}
+        )
+        assert resp["replica"] == "ok"
+    assert r.stats()["router_drains"] == 1  # flip counted once
+
+
+# ------------------------------------------------------------ affinity
+def test_affinity_routes_shared_prefix_to_same_replica():
+    """Second request sharing a full-page prefix pins to the replica
+    that served the first — even when another replica scores higher —
+    so fleet-wide prefix caching needs zero page movement."""
+    pre = list(range(8))  # one full page at page_size 8
+    state = {"rows": [_row("a", health=0.5)]}
+    r = _router(state, _echo_forward)
+    r.route({"id": "q1", "prompt": pre + [9], "max_new_tokens": 1})
+    # Now a healthier replica appears: score says "b", affinity says "a".
+    state["rows"] = [_row("a", health=0.5), _row("b", health=1.0)]
+    resp = r.route({"id": "q2", "prompt": pre + [7], "max_new_tokens": 1})
+    assert resp["replica"] == "a"
+    assert r.stats()["router_affinity_hits"] == 1
+    # A prompt with no cached prefix follows the score.
+    resp = r.route(
+        {"id": "q3", "prompt": [50, 51, 52], "max_new_tokens": 1}
+    )
+    assert resp["replica"] == "b"
+
+
+def test_affinity_disabled_follows_score():
+    pre = list(range(8))
+    state = {"rows": [_row("a", health=0.5)]}
+    r = _router(state, _echo_forward, affinity=False)
+    r.route({"id": "q1", "prompt": pre + [9], "max_new_tokens": 1})
+    state["rows"] = [_row("a", health=0.5), _row("b", health=1.0)]
+    resp = r.route({"id": "q2", "prompt": pre + [7], "max_new_tokens": 1})
+    assert resp["replica"] == "b"
+    assert r.stats()["router_affinity_hits"] == 0
+
+
+# ------------------------------------------------------------ failover
+def test_retry_reroutes_to_surviving_replica():
+    state = {"rows": [_row("dying", health=1.0), _row("live", health=0.9)]}
+    calls = []
+
+    def forward(row, request, timeout_s):
+        calls.append(row["id"])
+        if row["id"] == "dying":
+            raise RuntimeError("connection reset")
+        return {"replica": row["id"], "tokens": [3]}
+
+    sleeps = []
+    r = _router(state, forward, sleep=sleeps.append)
+    resp = r.route({"id": "q1", "prompt": [1, 2], "max_new_tokens": 1})
+    assert resp["replica"] == "live"
+    assert calls == ["dying", "live"]
+    s = r.stats()
+    assert s["router_retries"] == 1 and s["router_reroutes"] == 1
+    assert s["router_dropped"] == 0
+    assert sleeps == [pytest.approx(0.01)]  # backoff before the retry
+
+
+def test_retries_exhausted_raises_busy_with_exponential_backoff():
+    state = {"rows": [_row("a")]}
+
+    def forward(row, request, timeout_s):
+        raise RuntimeError("refused")
+
+    sleeps = []
+    r = _router(state, forward, retries=2, sleep=sleeps.append)
+    t0 = time.monotonic()
+    with pytest.raises(FleetBusy):
+        r.route({"id": "q1", "prompt": [1, 2], "max_new_tokens": 1})
+    assert time.monotonic() - t0 < 5.0  # bounded, never a hang
+    assert sleeps == [pytest.approx(0.01), pytest.approx(0.02)]
+    s = r.stats()
+    assert s["router_retries"] == 3  # every attempt failed
+    assert s["router_rejected"] == 1 and s["router_dropped"] == 0
+
+
+def test_hedge_skips_first_retry_backoff():
+    state = {"rows": [_row("a"), _row("b")]}
+    calls = []
+
+    def forward(row, request, timeout_s):
+        calls.append(row["id"])
+        if len(calls) == 1:
+            raise RuntimeError("reset")
+        return {"replica": row["id"]}
+
+    sleeps = []
+    r = _router(state, forward, hedge=True, sleep=sleeps.append)
+    r.route({"id": "q1", "prompt": [1, 2], "max_new_tokens": 1})
+    assert sleeps == []  # the first re-dispatch fires immediately
+
+
+def test_failed_replica_backs_off_for_subsequent_requests():
+    state = {"rows": [_row("flaky"), _row("good", health=0.8)]}
+    calls = []
+
+    def forward(row, request, timeout_s):
+        calls.append(row["id"])
+        if row["id"] == "flaky" and len(calls) == 1:
+            raise RuntimeError("reset")
+        return {"replica": row["id"]}
+
+    r = _router(state, forward, backoff_s=5.0, sleep=lambda s: None)
+    r.route({"id": "q1", "prompt": [1, 2], "max_new_tokens": 1})
+    # "flaky" sits in failure backoff: the next request avoids it even
+    # though its health score is better.
+    resp = r.route({"id": "q2", "prompt": [1, 2], "max_new_tokens": 1})
+    assert resp["replica"] == "good"
+
+
+# --------------------------------------------------------- idempotency
+def test_idempotent_replay_by_request_id():
+    state = {"rows": [_row("a")]}
+    calls = []
+
+    def forward(row, request, timeout_s):
+        calls.append(request["id"])
+        return {"replica": row["id"], "tokens": [7]}
+
+    r = _router(state, forward)
+    req = {"id": "q1", "prompt": [1, 2], "max_new_tokens": 1}
+    first = r.route(req)
+    second = r.route(dict(req))
+    assert first == second and calls == ["q1"]  # one dispatch, one answer
+
+
+def test_concurrent_duplicate_waits_for_original():
+    state = {"rows": [_row("a")]}
+    hold = threading.Event()
+    calls = []
+
+    def forward(row, request, timeout_s):
+        calls.append(request["id"])
+        assert hold.wait(5.0)
+        return {"tokens": [9]}
+
+    r = _router(state, forward)
+    req = {"id": "q1", "prompt": [1, 2], "max_new_tokens": 1}
+    out = []
+    ts = [
+        threading.Thread(
+            target=lambda: out.append(r.route(dict(req))), daemon=True
+        )
+        for _ in range(2)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.1)
+    hold.set()
+    for t in ts:
+        t.join(5.0)
+    assert calls == ["q1"]  # the duplicate attached, never re-dispatched
+    assert out[0] == out[1] == {"tokens": [9]}
+
+
+def test_malformed_requests_rejected_eagerly():
+    r = _router({"rows": [_row("a")]}, _echo_forward)
+    with pytest.raises(ValueError):
+        r.route({"prompt": [1], "max_new_tokens": 1})  # no id
+    with pytest.raises(ValueError):
+        r.route({"id": "q", "prompt": [], "max_new_tokens": 1})
+
+
+# ----------------------------------------------------------- autoscale
+def test_autoscale_replaces_stale_and_scales_on_pressure():
+    clock = {"t": 0.0}
+    launched = []
+    ctl = AutoscaleController(
+        launched.append,
+        enabled=True,
+        occ_high=0.8,
+        slo_rate_max=0.1,
+        cooldown_s=60.0,
+        clock=lambda: clock["t"],
+    )
+    # Stale replica → one replacement, deduped across sweeps until the
+    # cooldown expires.
+    snap = _snap([_row("r0", stale=True)], requests=100, slo_violations=0)
+    acts = ctl.consider(snap)
+    assert [a["action"] for a in acts] == ["replace"]
+    assert acts[0]["replica"] == "r0" and acts[0]["reason"] == "stale"
+    assert "prewarm_cache" in " ".join(acts[0]["command"])
+    assert ctl.consider(snap) == []  # cooldown holds
+    clock["t"] = 61.0
+    assert [a["action"] for a in ctl.consider(snap)] == ["replace"]
+    # Occupancy pressure → scale_up.
+    clock["t"] = 200.0
+    snap2 = _snap([_row("r0")], slot_occupancy=0.95)
+    assert [a["action"] for a in ctl.consider(snap2)] == ["scale_up"]
+    # SLO rate is a DELTA between sweeps, not a lifetime ratio.
+    clock["t"] = 400.0
+    ctl.consider(_snap([_row("r0")], requests=100, slo_violations=0))
+    clock["t"] = 500.0
+    acts = ctl.consider(
+        _snap([_row("r0")], requests=200, slo_violations=50)
+    )
+    assert any(
+        a["action"] == "scale_up" and "slo_rate" in a["reason"]
+        for a in acts
+    )
+    assert len(launched) == len(ctl.actions)
+
+
+def test_autoscale_disabled_is_inert():
+    ctl = AutoscaleController(enabled=False)
+    assert ctl.consider(_snap([_row("r0", stale=True)])) == []
+    assert ctl.actions == []
+
+
+# ------------------------------------------------------- HTTP plumbing
+class _FakeHandle:
+    def __init__(self, tokens, state="done"):
+        self.state = state
+        self.tokens = tokens
+        self.finish_reason = "budget"
+        self.drained = False
+
+
+class _FakeEngine:
+    """Just enough engine for the gateway: submit echoes the prompt
+    length so responses are distinguishable per request."""
+
+    max_slots = 4
+    pool = None
+
+    def __init__(self):
+        self.submits = 0
+
+    def submit(self, prompt, *, max_new_tokens, eos_id=None, **kw):
+        self.submits += 1
+        return _FakeHandle([int(len(prompt)), int(max_new_tokens)])
+
+
+def test_gateway_generate_replay_drain_and_kill():
+    eng = _FakeEngine()
+    gw = ReplicaGateway(eng)
+    try:
+        body = {"id": "g1", "prompt": [1, 2, 3], "max_new_tokens": 5}
+        code, payload = gw.handle_generate(body)
+        assert code == 200 and payload["tokens"] == [3, 5]
+        # Idempotent replay: no second submit.
+        code, again = gw.handle_generate(dict(body))
+        assert code == 200 and again == payload and eng.submits == 1
+        code, err = gw.handle_generate({"id": "", "prompt": [1]})
+        assert code == 400
+        gw.draining = True
+        code, err = gw.handle_generate(
+            {"id": "g2", "prompt": [1], "max_new_tokens": 1}
+        )
+        assert code == 503 and err["error"] == "draining"
+        gw.draining = False
+        gw.aborted = True
+        code, err = gw.handle_generate(
+            {"id": "g3", "prompt": [1], "max_new_tokens": 1}
+        )
+        assert code == 503 and err["error"] == "killed"
+    finally:
+        gw.close()
+
+
+def test_gateway_drained_handle_returns_503_for_reroute():
+    class _DrainEngine(_FakeEngine):
+        def submit(self, prompt, **kw):
+            self.submits += 1
+            h = _FakeHandle([], state="queued")
+            h.drained = True  # SIGTERM drained it before it started
+            return h
+
+    gw = ReplicaGateway(_DrainEngine())
+    try:
+        code, err = gw.handle_generate(
+            {"id": "g1", "prompt": [1], "max_new_tokens": 1}
+        )
+        assert code == 503 and err["error"] == "drained"
+    finally:
+        gw.close()
+
+
+def test_frontdoor_end_to_end_over_http():
+    """Client → FrontDoor → Router → http_forward → ReplicaGateway →
+    fake engine, all over real sockets: 200 with the replica's answer,
+    router /status counters, 400 on junk, 503 when the fleet is empty."""
+    eng = _FakeEngine()
+    gw = ReplicaGateway(eng)
+    state = {"rows": [_row("a", url=gw.url)]}
+    r = Router(
+        lambda: _snap(state["rows"]),
+        http_forward,
+        page_size=8,
+        timeout_s=5.0,
+        retries=1,
+        backoff_s=0.01,
+        queue_timeout_s=0.3,
+        refresh_s=0.0,
+        wait_tick_s=0.01,
+    )
+    door = FrontDoor(r, host="127.0.0.1", port=0)
+    try:
+        def post(path, obj):
+            req = urllib.request.Request(
+                door.url + path,
+                data=json.dumps(obj).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, resp = post(
+            "/generate",
+            {"id": "h1", "prompt": [1, 2, 3, 4], "max_new_tokens": 2},
+        )
+        assert code == 200 and resp["tokens"] == [4, 2]
+        assert resp["finish_reason"] == "budget"
+        with urllib.request.urlopen(
+            door.url + "/status", timeout=10
+        ) as s:
+            status = json.loads(s.read())
+        assert status["router_requests"] == 1
+        assert status["router_dropped"] == 0
+        code, _ = post("/generate", {"id": "h2", "prompt": []})
+        assert code == 400
+        state["rows"] = []  # the whole fleet vanished
+        code, err = post(
+            "/generate",
+            {"id": "h3", "prompt": [1, 2], "max_new_tokens": 1},
+        )
+        assert code == 503 and "error" in err
+    finally:
+        door.close()
+        gw.close()
+
+
+def test_http_forward_raises_on_replica_503():
+    eng = _FakeEngine()
+    gw = ReplicaGateway(eng)
+    gw.draining = True
+    try:
+        with pytest.raises(RuntimeError, match="503"):
+            http_forward(
+                {"id": "a", "generate_url": gw.url},
+                {"id": "x", "prompt": [1], "max_new_tokens": 1},
+                5.0,
+            )
+        with pytest.raises(RuntimeError, match="generate_url"):
+            http_forward({"id": "b"}, {"id": "x"}, 1.0)
+    finally:
+        gw.close()
+
+
+# -------------------------------------------- review regressions (PR 17)
+def test_route_rejects_malformed_types_as_valueerror():
+    """Type garbage in a request (list max_new_tokens, non-token
+    prompt) is a client error — ValueError from route(), never a
+    TypeError that would sever an HTTP connection, and never counted
+    as a router drop."""
+    r = _router({"rows": [_row("a")]}, _echo_forward)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        r.route({"id": "m1", "prompt": [1, 2], "max_new_tokens": [64]})
+    with pytest.raises(ValueError, match="prompt"):
+        r.route({"id": "m2", "prompt": "junk", "max_new_tokens": 1})
+    s = r.stats()
+    assert s["router_dropped"] == 0
+    assert s["router_requests"] == 0  # rejected before admission
+
+
+def test_frontdoor_maps_malformed_and_internal_errors_to_json():
+    """The HTTP face mirrors route()'s contract: malformed types are a
+    400 JSON body, an unexpected router exception is a 500 JSON body —
+    either way the client reads a response, never a torn socket."""
+    eng = _FakeEngine()
+    gw = ReplicaGateway(eng)
+    state = {"rows": [_row("a", url=gw.url)]}
+    r = _router(state, http_forward)
+    door = FrontDoor(r, port=0)
+    try:
+
+        def post(path, obj):
+            req = urllib.request.Request(
+                door.url + path,
+                data=json.dumps(obj).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, err = post(
+            "/generate",
+            {"id": "f1", "prompt": [1, 2], "max_new_tokens": [64]},
+        )
+        assert code == 400 and "max_new_tokens" in err["error"]
+        code, err = post(
+            "/generate",
+            {"id": "f2", "prompt": "junk", "max_new_tokens": 1},
+        )
+        assert code == 400 and "prompt" in err["error"]
+
+        class _Boom:
+            def route(self, body):
+                raise RuntimeError("kaboom")
+
+        door.router = _Boom()
+        code, err = post(
+            "/generate",
+            {"id": "f3", "prompt": [1], "max_new_tokens": 1},
+        )
+        assert code == 500
+        assert "RuntimeError" in err["error"] and "kaboom" in err["error"]
+    finally:
+        door.close()
+        gw.close()
+
+
+def test_slow_snapshot_fn_never_blocks_routing():
+    """A hung fleet sweep must not stall admission: the router releases
+    its lock around snapshot_fn, so requests keep routing on the cached
+    view while one thread is stuck mid-fetch."""
+    hang = threading.Event()
+    entered = threading.Event()
+    calls = {"n": 0}
+
+    def snapshot_fn():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            entered.set()
+            hang.wait(timeout=10.0)  # simulate an unresponsive sweep
+        return _snap([_row("a")])
+
+    r = Router(
+        snapshot_fn,
+        _echo_forward,
+        page_size=8,
+        timeout_s=5.0,
+        retries=1,
+        backoff_s=0.01,
+        queue_timeout_s=1.0,
+        refresh_s=0.0,
+    )
+    r.refresh(force=True)  # prime the cached view (fetch #1, fast)
+    stuck = threading.Thread(
+        target=lambda: r.refresh(force=True), daemon=True
+    )
+    stuck.start()
+    assert entered.wait(timeout=5.0)
+    t0 = time.monotonic()
+    resp = r.route({"id": "s1", "prompt": [1, 2], "max_new_tokens": 2})
+    waited = time.monotonic() - t0
+    assert resp["replica"] == "a"
+    assert waited < 2.0  # routed on the cached rows, not the hung fetch
+    hang.set()
+    stuck.join(timeout=5.0)
+    assert not stuck.is_alive()
+
+
+def test_fleet_poller_hands_router_a_cached_snapshot():
+    """FleetPoller owns the synchronous sweep on its own thread:
+    snapshot() is a lock-guarded dict handoff that never fetches, while
+    the background loop keeps sweeping."""
+    from tpuflow.obs import fleet as obs_fleet
+
+    calls = {"n": 0}
+
+    def fetch(url, timeout_s):
+        calls["n"] += 1
+        return {
+            "replica": {"id": "p0"},
+            "serve_pages_free": 4,
+            "generate_url": "http://x/generate",
+        }
+
+    obsy = obs_fleet.FleetObservatory(
+        "http://127.0.0.1:1",
+        timeout_s=0.1,
+        stale_s=5.0,
+        poll_interval_s=0.01,
+        fetch=fetch,
+    )
+    poller = obs_fleet.FleetPoller(obsy, interval_s=0.01)
+    try:
+        snap = poller.snapshot()  # construction ran one sweep already
+        assert snap["replicas"][0]["generate_url"] == "http://x/generate"
+        n0 = calls["n"]
+        for _ in range(50):
+            poller.snapshot()
+        assert calls["n"] == n0  # snapshot() itself never sweeps
+        deadline = time.monotonic() + 5.0
+        while calls["n"] == n0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert calls["n"] > n0  # the background thread does
+    finally:
+        poller.close()
+    assert not poller._thread.is_alive()
+
+
+def test_launch_command_is_cwd_independent():
+    """The autoscale launch hint must work from any cwd: an absolute
+    path to a script that actually exists."""
+    import os
+
+    cmd = router_mod.launch_command("replace", "r0")
+    script = cmd[1]
+    assert os.path.isabs(script)
+    assert os.path.exists(script)
+    assert script.endswith(os.path.join("tools", "prewarm_cache.py"))
+
+
+def test_serve_forever_exports_generate_url_and_forwards(
+    tmp_path, monkeypatch
+):
+    """Production ingress end-to-end (the HIGH review finding): a bare
+    serve_forever replica — no chaos harness — starts its own
+    ReplicaGateway, its fleet row carries generate_url, http_forward
+    round-trips a request to it, and the URL is retracted on exit."""
+    from tpuflow.infer import serve as serve_mod
+    from tpuflow.obs import export as obs_export
+    from tpuflow.obs import fleet as obs_fleet
+    from tpuflow.obs import goodput as obs_goodput
+
+    class _LoopEngine(_FakeEngine):
+        """Enough engine surface for the serving loop itself."""
+
+        def __init__(self):
+            super().__init__()
+            self._iters = 0
+            self._live = np.zeros((1,), bool)
+
+            import contextlib
+
+            class _Ledger:
+                def bucket(self, name):
+                    return contextlib.nullcontext()
+
+            self.ledger = _Ledger()
+
+        def step(self, admit=True):
+            self._iters += 1
+            return False  # idle loop; submits answer synchronously
+
+        def drain_queued(self):
+            return 0
+
+    reg = tmp_path / "fleet"
+    reg.mkdir()  # discovery reads a dir; a missing one parses as URLs
+    obs_export.stop()  # a leftover exporter would hide our port knob
+    monkeypatch.setenv("TPUFLOW_OBS_HTTP_PORT", "0")
+    monkeypatch.setenv("TPUFLOW_FLEET_REGISTRATION_DIR", str(reg))
+    obs_goodput.live().reset()
+    stop = threading.Event()
+    eng = _LoopEngine()
+    th = threading.Thread(
+        target=serve_mod.serve_forever,
+        args=(eng,),
+        kwargs={
+            "idle_sleep_s": 0.002,
+            "max_s": 60.0,
+            "should_stop": stop.is_set,
+        },
+        daemon=True,
+    )
+    th.start()
+    try:
+        obsy = obs_fleet.FleetObservatory(
+            str(reg), timeout_s=2.0, stale_s=10.0, poll_interval_s=0.01
+        )
+        row = None
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            rows = obsy.poll().get("replicas") or []
+            row = next(
+                (r for r in rows if r.get("generate_url")), None
+            )
+            if row is not None:
+                break
+            time.sleep(0.05)
+        assert row is not None, "fleet row never carried generate_url"
+        resp = http_forward(
+            row,
+            {"id": "sf-1", "prompt": [1, 2, 3], "max_new_tokens": 4},
+            5.0,
+        )
+        assert resp["tokens"] == [3, 4]
+        assert eng.submits == 1
+    finally:
+        stop.set()
+        th.join(timeout=15.0)
+        try:
+            assert not th.is_alive()
+            # The loop's finally retracted the URL before closing.
+            assert obs_goodput.live().serve_generate_url is None
+        finally:
+            obs_export.stop()
+            obs_goodput.live().reset()

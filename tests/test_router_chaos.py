@@ -1,0 +1,282 @@
+"""Chaos acceptance for the front-door router (ISSUE 17) — slow tier.
+
+THE proof of the PR's robustness claims, end to end over real sockets:
+three live in-process replicas (real ServeEngines with gateways,
+ledgers, /status exporters, and a registration dir), a real
+FleetObservatory snapshot chain, Poisson load through the Router, and
+mid-drive chaos from the PR 6 fault vocabulary — one ``replica_kill``
+and one ``replica_stall``. The assertions:
+
+- **Zero dropped requests.** Every request resolves as an answer or an
+  explicit 503; the error bucket and ``router_dropped`` are both 0.
+- **Bit-equal responses.** Every answered request's tokens equal a solo
+  greedy ``generate()`` of its prompt — failover and re-dispatch never
+  perturb numerics.
+- **Re-route, not staleness-wait.** Work in flight on the killed
+  replica re-dispatches (reroutes > 0) and the whole drive completes
+  well inside the observatory's stale threshold budget — the router
+  reacts to connection failures, it does not wait for a row to age out.
+- **Bounded fleet tail.** The fleet-MERGED TTFT histogram (PR 14's
+  mergeable construction) yields a finite p99.
+- **No survivor recompiles.** ``compile_stats()`` on the surviving
+  replicas is unchanged from its post-warmup baseline.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.infer import generate
+from tpuflow.infer.frontdoor import http_forward
+from tpuflow.infer.router import Router
+from tpuflow.infer.serve import ServeEngine
+from tpuflow.models.gpt2 import GPT2, GPT2Config
+from tpuflow.obs import fleet as obs_fleet
+from tpuflow.testing import faults
+from tpuflow.testing.chaos import (
+    LocalReplica,
+    apply_replica_plan,
+    run_poisson,
+)
+
+pytestmark = pytest.mark.slow
+
+STALE_S = 10.0  # the staleness budget the re-route must beat
+
+
+def test_router_chaos_kill_and_stall_zero_drops(tmp_path, monkeypatch):
+    cfg = GPT2Config.small_test(n_ctx=64, dropout=0.0)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(11)
+    R, M = 18, 6
+    # A third of the prompts share a full-page system prefix so the
+    # kill also lands on affinity-pinned traffic.
+    pre = rng.integers(0, 512, size=8).astype(np.int32)
+    prompts = []
+    for k in range(R):
+        if k % 3 == 0:
+            tail = rng.integers(
+                0, 512, size=int(rng.integers(1, 6))
+            ).astype(np.int32)
+            prompts.append(np.concatenate([pre, tail]))
+        else:
+            prompts.append(
+                rng.integers(
+                    0, 512, size=int(rng.integers(4, 20))
+                ).astype(np.int32)
+            )
+    # Solo greedy baselines FIRST (also outside the chaos window).
+    # generate() returns the generated tokens only — same shape as
+    # the gateway's "tokens" payload.
+    expected = {}
+    for k, p in enumerate(prompts):
+        gen = np.asarray(
+            generate(
+                model, params, p[None, :],
+                max_new_tokens=M, temperature=0.0,
+            )
+        )[0]
+        expected[f"req-{k}"] = [int(t) for t in gen]
+
+    reg = str(tmp_path / "fleet")
+    dev_lock = threading.Lock()  # one physical device, three engines
+    replicas: dict[str, LocalReplica] = {}
+    baselines: dict[str, dict] = {}
+    try:
+        for i in range(3):
+            eng = ServeEngine(
+                model, params, max_slots=2, decode_block=4,
+                buckets=[16, 32], page_size=8,
+            )
+            with dev_lock:
+                eng.warmup()  # serial, pre-chaos
+            rep = LocalReplica(
+                f"rep-{i}", eng,
+                registration_dir=reg, device_lock=dev_lock,
+            )
+            replicas[rep.id] = rep
+            baselines[rep.id] = eng.compile_stats()
+
+        obsy = obs_fleet.FleetObservatory(
+            reg, timeout_s=0.5, stale_s=STALE_S, poll_interval_s=0.02,
+        )
+        router = Router(
+            obsy.poll, http_forward,
+            page_size=8,
+            timeout_s=3.0,   # the stall detector
+            retries=4,
+            backoff_s=0.02,
+            queue_timeout_s=120.0,  # queue, never drop
+            refresh_s=0.05,
+        )
+        router.refresh(force=True)
+        assert router.stats()["router_budget_pages"] > 0
+
+        # Chaos through the PR 6 vocabulary: one kill, one stall,
+        # both mid-drive.
+        monkeypatch.setenv(
+            "TPUFLOW_FAULT",
+            "replica_kill:rep-1@0.6,replica_stall:rep-2@0.3",
+        )
+        plan = faults.replica_plan()
+        assert plan == [
+            ("replica_stall", "rep-2", 0.3),
+            ("replica_kill", "rep-1", 0.6),
+        ]
+        reqs = [
+            {
+                "id": f"req-{k}",
+                "prompt": [int(t) for t in prompts[k]],
+                "max_new_tokens": M,
+            }
+            for k in range(R)
+        ]
+        t0 = time.monotonic()
+        chaos = apply_replica_plan(replicas, plan, t0=t0)
+        results = run_poisson(
+            router.route, reqs, rate_qps=20.0, rng=rng
+        )
+        chaos.join(timeout=30.0)
+        wall = time.monotonic() - t0
+
+        # ---- zero dropped requests; answers for (nearly) everything.
+        errors = [r for r in results if r["outcome"] == "error"]
+        assert errors == [], f"dropped requests: {errors}"
+        stats = router.stats()
+        assert stats["router_dropped"] == 0
+        assert stats["router_inflight"] == 0
+        oks = [r for r in results if r["outcome"] == "ok"]
+        # The 120s admission window and 4-retry budget should absorb a
+        # 1-of-3 kill + 1-of-3 stall entirely: everything answers.
+        assert len(oks) == R
+
+        # ---- bit-equality: failover never perturbs numerics.
+        for r in oks:
+            rid = r["request"]["id"]
+            assert r["response"]["tokens"] == expected[rid], rid
+
+        # ---- the faults actually landed, and re-dispatch (not
+        # staleness aging) absorbed them.
+        assert stats["router_retries"] >= 1
+        assert stats["router_reroutes"] >= 1
+        killed_wait = max(
+            (
+                r["latency_s"] for r in oks
+            ),
+            default=0.0,
+        )
+        # Worst single answer: bounded by the stall detector + backoff
+        # + a re-decode, far under the queue timeout — and the whole
+        # drive beats the staleness budget the re-route must not need.
+        assert killed_wait < 60.0
+        assert wall < STALE_S + 60.0
+
+        # ---- bounded fleet tail from the MERGED histogram.
+        snap = obsy.poll()
+        ttft = snap["fleet"].get("ttft")
+        assert ttft and ttft["count"] >= len(oks) - stats["router_reroutes"]
+        assert np.isfinite(ttft["p99"])
+
+        # ---- the kill/stall rows read as expected to the fleet.
+        rows = {r["id"]: r for r in snap["replicas"]}
+        assert not rows["rep-0"]["stale"]
+
+        # ---- no survivor recompiled anything under chaos.
+        for rid in ("rep-0", "rep-2"):
+            assert (
+                replicas[rid].engine.compile_stats() == baselines[rid]
+            ), f"{rid} recompiled under chaos"
+    finally:
+        for rep in replicas.values():
+            rep.close()
+
+
+def test_router_drain_reroutes_queued_work(tmp_path):
+    """SIGTERM drain end to end: a draining replica finishes its live
+    slots, 503s its queued-but-unstarted work back to the router, stops
+    receiving admissions (router.drain bookkeeping), and the re-routed
+    requests still answer bit-equal."""
+    cfg = GPT2Config.small_test(n_ctx=64, dropout=0.0)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(5)
+    R, M = 8, 5
+    prompts = [
+        rng.integers(0, 512, size=int(L)).astype(np.int32)
+        for L in rng.integers(4, 16, size=R)
+    ]
+    expected = []
+    for p in prompts:
+        gen = np.asarray(
+            generate(
+                model, params, p[None, :],
+                max_new_tokens=M, temperature=0.0,
+            )
+        )[0]
+        expected.append([int(t) for t in gen])
+
+    reg = str(tmp_path / "fleet")
+    dev_lock = threading.Lock()
+    replicas: dict[str, LocalReplica] = {}
+    try:
+        for i in range(2):
+            eng = ServeEngine(
+                model, params, max_slots=2, decode_block=4,
+                buckets=[16], page_size=8,
+            )
+            with dev_lock:
+                eng.warmup()
+            rep = LocalReplica(
+                f"dr-{i}", eng,
+                registration_dir=reg, device_lock=dev_lock,
+            )
+            replicas[rep.id] = rep
+        obsy = obs_fleet.FleetObservatory(
+            reg, timeout_s=0.5, stale_s=STALE_S, poll_interval_s=0.02,
+        )
+        router = Router(
+            obsy.poll, http_forward,
+            page_size=8, timeout_s=5.0, retries=4, backoff_s=0.02,
+            queue_timeout_s=60.0, refresh_s=0.02,
+        )
+        router.refresh(force=True)
+        # Drain dr-0 immediately before the burst: its ledger flips
+        # serve_draining, the fleet row carries it, and after the next
+        # refresh the router admits nothing there.
+        replicas["dr-0"].drain()
+        time.sleep(0.1)
+        reqs = [
+            {
+                "id": f"dq-{k}",
+                "prompt": [int(t) for t in prompts[k]],
+                "max_new_tokens": M,
+            }
+            for k in range(R)
+        ]
+        results = run_poisson(
+            router.route, reqs, rate_qps=40.0, rng=rng
+        )
+        assert [r for r in results if r["outcome"] != "ok"] == []
+        for k, r in enumerate(results):
+            assert r["response"]["tokens"] == expected[k], k
+        stats = router.stats()
+        assert stats["router_dropped"] == 0
+        assert stats["router_drains"] == 1  # the flip, counted once
+        # Every request landed on the survivor: the drained replica's
+        # engine admitted nothing new after the flip.
+        assert replicas["dr-0"].engine.queue_depth == 0
+        snap = obsy.poll()
+        rows = {r["id"]: r for r in snap["replicas"]}
+        assert rows["dr-0"].get("serve_draining") is True
+    finally:
+        for rep in replicas.values():
+            rep.close()

@@ -505,6 +505,142 @@ def serving_headless_service(name: str, *, metrics_port: int = 8080) -> dict:
     }
 
 
+def router_deployment(
+    name: str,
+    *,
+    image: str = "tpuflow:latest",
+    replicas: int = 1,
+    port: int = 8900,
+    fleet_target: str | None = None,
+    command: list[str] | None = None,
+    timeout_s: float | None = None,
+    retries: int | None = None,
+    queue_timeout_s: float | None = None,
+    autoscale: bool = False,
+    env: dict[str, str] | None = None,
+) -> dict:
+    """apps/v1 Deployment for the front-door router (ISSUE 17): the
+    fleet's single client-facing ingress, running
+    ``tpuflow.infer.frontdoor`` against the serving fleet's headless
+    discovery Service.
+
+    A HOST deployment, not a TPU one — the router is pure python over
+    snapshot dicts and sockets, so it requests no accelerator and needs
+    no node selector: it schedules anywhere, restarts instantly, and
+    scales by cheap replicas. ``fleet_target`` is what the router's
+    fleet observatory polls — point it at the serving fleet's
+    ``http://<serving>-fleet:<metrics_port>`` headless Service (or a
+    registration dir on shared storage). The readiness probe hits the
+    router's own ``/healthz``; its ``/status`` serves the ``router_*``
+    counters the reroute_spike alert feeds on.
+    """
+    dep_name = name.lower().replace("_", "-")
+    penv = [
+        {"name": "TPUFLOW_ROUTER_PORT", "value": str(int(port))},
+        # Clients and the probe come in over the pod IP.
+        {"name": "TPUFLOW_ROUTER_HOST", "value": "0.0.0.0"},
+    ]
+    if fleet_target:
+        penv.append(
+            {"name": "TPUFLOW_ROUTER_TARGET", "value": str(fleet_target)}
+        )
+    if timeout_s is not None:
+        penv.append(
+            {
+                "name": "TPUFLOW_ROUTER_TIMEOUT_S",
+                "value": str(float(timeout_s)),
+            }
+        )
+    if retries is not None:
+        penv.append(
+            {"name": "TPUFLOW_ROUTER_RETRIES", "value": str(int(retries))}
+        )
+    if queue_timeout_s is not None:
+        penv.append(
+            {
+                "name": "TPUFLOW_ROUTER_QUEUE_TIMEOUT_S",
+                "value": str(float(queue_timeout_s)),
+            }
+        )
+    if autoscale:
+        penv.append({"name": "TPUFLOW_ROUTER_AUTOSCALE", "value": "1"})
+    for k, v in sorted((env or {}).items()):
+        penv.append({"name": str(k), "value": str(v)})
+    container = {
+        "name": dep_name,
+        "image": image,
+        "command": command or ["python", "-m", "tpuflow.infer.frontdoor"],
+        "env": penv,
+        "ports": [{"name": "http", "containerPort": int(port)}],
+        "readinessProbe": {
+            "httpGet": {"path": "/healthz", "port": int(port)},
+            "periodSeconds": 5,
+        },
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": dep_name,
+            "annotations": {"tpuflow.dev/router": "1"},
+        },
+        "spec": {
+            "replicas": int(replicas),
+            "selector": {"matchLabels": {"app": dep_name}},
+            "template": {
+                "metadata": {"labels": {"app": dep_name}},
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+
+
+def router_service(name: str, *, port: int = 8900) -> dict:
+    """ClusterIP Service in front of the router Deployment — the
+    address clients (and the serving runbook's curl examples) use."""
+    dep_name = name.lower().replace("_", "-")
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": dep_name},
+        "spec": {
+            "selector": {"app": dep_name},
+            "ports": [
+                {"name": "http", "port": int(port), "targetPort": int(port)}
+            ],
+        },
+    }
+
+
+def materialize_router(
+    name: str, out_dir: str, *, image: str = "tpuflow:latest", **kw
+) -> list[str]:
+    """Write the router Deployment + Service YAML into ``out_dir``;
+    returns the files written (kubectl-apply shapes, like
+    materialize_serving)."""
+    import yaml
+
+    os.makedirs(out_dir, exist_ok=True)
+    dep_name = name.lower().replace("_", "-")
+    port = int(kw.get("port", 8900))
+    written = []
+    for fname, payload in (
+        (
+            f"{dep_name}.deployment.yaml",
+            router_deployment(name, image=image, **kw),
+        ),
+        (
+            f"{dep_name}.service.yaml",
+            router_service(name, port=port),
+        ),
+    ):
+        path = os.path.join(out_dir, fname)
+        with open(path, "w") as f:
+            yaml.safe_dump(payload, f, sort_keys=False)
+        written.append(path)
+    return written
+
+
 def materialize_serving(
     name: str, out_dir: str, *, image: str = "tpuflow:latest", **kw
 ) -> list[str]:

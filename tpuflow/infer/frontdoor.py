@@ -1,0 +1,378 @@
+"""HTTP faces of the front-door router (ISSUE 17).
+
+Two small servers and the forwarder that connects them:
+
+- ``ReplicaGateway`` runs BESIDE a ServeEngine in each replica process:
+  ``POST /generate`` submits into the engine's continuous-batching
+  queue (under the step-loop's lock) and holds the connection until
+  the request finishes. It is idempotent by request id — a duplicate
+  of an in-flight id attaches to the existing handle instead of
+  submitting twice, and a duplicate of a finished id replays the
+  cached answer — which is what makes the router's re-dispatch safe
+  when a retry races a slow original. A draining or drained replica
+  answers 503 with a reason the router treats as "go elsewhere".
+- ``FrontDoor`` is the client-facing ingress: ``POST /generate`` runs
+  ``Router.route`` (admission → pick → forward → bounded retry) and
+  maps its outcomes onto HTTP — 200 with the replica's answer,
+  503 on ``FleetBusy`` (queue timeout / retries exhausted), 400 on a
+  malformed request. ``GET /status`` serves ``router_*`` stats (the
+  alert engine's reroute_spike feed).
+- ``http_forward`` is the Router's default ``forward_fn``: one POST to
+  the replica row's ``generate_url`` with a hard timeout, raising on
+  anything but a 200 — the router's retry loop is built on exactly
+  that contract.
+
+Everything is stdlib http.server + urllib: jax-free, import-safe on a
+CPU-only host, and the same code path the in-process chaos harness
+drives in tests.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+import numpy as np
+
+from tpuflow.infer.router import FleetBusy, Router
+from tpuflow.utils import knobs
+
+_RESULT_CACHE_MAX = 2048
+
+
+def _read_json(handler: BaseHTTPRequestHandler) -> dict | None:
+    try:
+        n = int(handler.headers.get("Content-Length") or 0)
+        body = handler.rfile.read(n) if n > 0 else b""
+        obj = json.loads(body.decode("utf-8") or "{}")
+        return obj if isinstance(obj, dict) else None
+    except (ValueError, OSError):
+        return None
+
+
+def _send_json(
+    handler: BaseHTTPRequestHandler, code: int, payload: dict
+) -> None:
+    body = json.dumps(payload).encode("utf-8")
+    try:
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        pass  # client gave up; the engine-side work is unaffected
+
+
+# ------------------------------------------------------ replica gateway
+class ReplicaGateway:
+    """The replica-side /generate endpoint over a live ServeEngine.
+
+    ``lock`` must be the SAME lock the replica's step loop holds while
+    stepping — submit and step interleave safely through it. The
+    gateway never steps the engine itself; it submits and polls the
+    handle, so a stalled step loop shows up to the router as a forward
+    timeout, not a crash.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        lock: threading.RLock | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        hold_timeout_s: float = 60.0,
+        poll_s: float = 0.005,
+        on_complete=None,
+    ):
+        self.engine = engine
+        self.lock = lock if lock is not None else threading.RLock()
+        self.hold_timeout_s = float(hold_timeout_s)
+        self.poll_s = float(poll_s)
+        # Called (under the lock) with each finished handle — the
+        # replica's chance to feed its ledger (TTFT histogram,
+        # completion counter) without the gateway knowing about obs.
+        self.on_complete = on_complete
+        self.draining = False
+        # Set by a chaos kill (or a dying process): every held and new
+        # request answers 503 immediately so the router's re-dispatch
+        # fires at once instead of waiting out the forward timeout.
+        self.aborted = False
+        self._handles: dict[str, Any] = {}
+        self._results: OrderedDict[str, dict] = OrderedDict()
+        gateway = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path != "/generate":
+                    _send_json(self, 404, {"error": "not found"})
+                    return
+                body = _read_json(self)
+                if body is None:
+                    _send_json(self, 400, {"error": "bad json"})
+                    return
+                try:
+                    code, payload = gateway.handle_generate(body)
+                except Exception as e:  # noqa: BLE001 — a raised
+                    # forward is "try another replica" to the router;
+                    # an explicit 500 beats a severed connection.
+                    code, payload = 500, {
+                        "error": f"{type(e).__name__}: {e}"
+                    }
+                _send_json(self, code, payload)
+
+            def log_message(self, *args):  # silence request spam
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="tpuflow-replica-gateway",
+            daemon=True,
+        )
+        self._thread.start()
+        h, p = self._server.server_address[:2]
+        self.url = f"http://{h}:{p}/generate"
+
+    # ------------------------------------------------------- handling
+    def handle_generate(self, body: dict) -> tuple[int, dict]:
+        rid = str(body.get("id") or "")
+        prompt = body.get("prompt")
+        if not rid or not isinstance(prompt, list) or not prompt:
+            return 400, {"error": "need id and non-empty prompt"}
+        with self.lock:
+            done = self._results.get(rid)
+            if done is not None:
+                return 200, dict(done)  # idempotent replay
+            handle = self._handles.get(rid)
+            if handle is None:
+                if self.aborted:
+                    return 503, {"error": "killed"}
+                if self.draining:
+                    return 503, {"error": "draining"}
+                eos = body.get("eos_id")
+                try:
+                    handle = self.engine.submit(
+                        np.asarray(prompt, np.int32),
+                        max_new_tokens=int(
+                            body.get("max_new_tokens") or 1
+                        ),
+                        eos_id=None if eos is None else int(eos),
+                    )
+                except (TypeError, ValueError) as e:
+                    # TypeError covers non-castable fields (a list
+                    # max_new_tokens) — still the client's fault, 400.
+                    return 400, {"error": str(e)}
+                self._handles[rid] = handle
+        deadline = time.monotonic() + self.hold_timeout_s
+        while True:
+            with self.lock:
+                if self.aborted:
+                    self._handles.pop(rid, None)
+                    return 503, {"error": "killed"}
+                if handle.state == "done":
+                    payload = {
+                        "id": rid,
+                        "tokens": [int(t) for t in handle.tokens],
+                        "finish_reason": handle.finish_reason,
+                    }
+                    if self.on_complete is not None:
+                        try:
+                            self.on_complete(handle)
+                        except Exception:  # noqa: BLE001 — obs only
+                            pass
+                    self._handles.pop(rid, None)
+                    self._results[rid] = payload
+                    while len(self._results) > _RESULT_CACHE_MAX:
+                        self._results.popitem(last=False)
+                    return 200, dict(payload)
+                if getattr(handle, "drained", False):
+                    # SIGTERM landed before this request started: the
+                    # router re-dispatches it to a live replica.
+                    self._handles.pop(rid, None)
+                    return 503, {"error": "drained"}
+            if time.monotonic() >= deadline:
+                return 503, {"error": "hold timeout"}
+            time.sleep(self.poll_s)
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+# ------------------------------------------------------------ CLI entry
+def main(argv: list[str] | None = None) -> int:
+    """``python -m tpuflow.infer.frontdoor [target]`` — the ingress the
+    router_deployment manifest launches: discover replicas (arg >
+    TPUFLOW_ROUTER_TARGET > the fleet discovery knobs), poll them, and
+    serve /generate on TPUFLOW_ROUTER_HOST:TPUFLOW_ROUTER_PORT until
+    SIGINT/SIGTERM."""
+    import signal
+
+    from tpuflow.obs import fleet as _fleet
+
+    args = list(argv) if argv is not None else None
+    target = None
+    if args:
+        target = args[0]
+    if target is None:
+        target = knobs.raw("TPUFLOW_ROUTER_TARGET") or None
+    observatory = _fleet.FleetObservatory(target)
+    # The observatory sweep runs on the poller's thread; the router
+    # only ever reads its cached snapshot (the "cheap snapshot_fn"
+    # contract — a slow /status must not stall routing).
+    poller = _fleet.FleetPoller(observatory)
+    router = Router(poller.snapshot, http_forward)
+    door = FrontDoor(router)
+    print(f"[frontdoor] serving {door.url}/generate", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # non-main thread (tests)
+    try:
+        while not stop.is_set():
+            router.refresh()
+            stop.wait(0.5)
+    finally:
+        door.close()
+        poller.close()
+    return 0
+
+
+# ---------------------------------------------------------- forwarding
+def http_forward(row: dict, request: dict, timeout_s: float) -> dict:
+    """One forward attempt to a replica row's ``generate_url``.
+
+    Raises on ANY failure — no URL in the row, connection refused,
+    timeout, non-200, undecodable body — because the Router's retry
+    loop treats "raise" as "try another replica". A 200 body is the
+    client's response, verbatim.
+    """
+    url = row.get("generate_url")
+    if not url:
+        raise RuntimeError(
+            f"replica {row.get('id')!r} exports no generate_url"
+        )
+    data = json.dumps(request).encode("utf-8")
+    req = urlrequest.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urlrequest.urlopen(req, timeout=timeout_s) as resp:
+            body = resp.read()
+    except urlerror.HTTPError as e:
+        detail = ""
+        try:
+            detail = e.read().decode("utf-8", "replace")[:200]
+        except OSError:
+            pass
+        raise RuntimeError(
+            f"replica {row.get('id')!r} answered {e.code}: {detail}"
+        ) from e
+    out = json.loads(body.decode("utf-8"))
+    if not isinstance(out, dict):
+        raise RuntimeError("replica answered a non-object body")
+    return out
+
+
+# ----------------------------------------------------------- front door
+class FrontDoor:
+    """Client-facing ingress: POST /generate → Router.route, with the
+    router's explicit outcomes mapped onto HTTP codes. GET /status
+    serves ``router_*`` stats; GET /healthz answers 200 while up."""
+
+    def __init__(
+        self,
+        router: Router,
+        *,
+        host: str | None = None,
+        port: int | None = None,
+    ):
+        if host is None:
+            host = knobs.get_str("TPUFLOW_ROUTER_HOST")
+        if port is None:
+            port = knobs.get_int("TPUFLOW_ROUTER_PORT")
+        self.router = router
+        door = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path != "/generate":
+                    _send_json(self, 404, {"error": "not found"})
+                    return
+                body = _read_json(self)
+                if body is None:
+                    _send_json(self, 400, {"error": "bad json"})
+                    return
+                try:
+                    resp = door.router.route(body)
+                except FleetBusy as e:
+                    _send_json(self, 503, {"error": str(e)})
+                    return
+                except (TypeError, ValueError) as e:
+                    _send_json(self, 400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — the "every
+                    # request ends answered or told" contract: an
+                    # unexpected failure is a 500 JSON answer, never a
+                    # severed connection.
+                    _send_json(
+                        self, 500,
+                        {"error": f"{type(e).__name__}: {e}"},
+                    )
+                    return
+                _send_json(self, 200, resp)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/status":
+                    _send_json(self, 200, door.router.stats())
+                elif self.path == "/healthz":
+                    _send_json(self, 200, {"ok": True})
+                else:
+                    _send_json(self, 404, {"error": "not found"})
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="tpuflow-frontdoor",
+            daemon=True,
+        )
+        self._thread.start()
+        h, p = self._server.server_address[:2]
+        self.url = f"http://{h}:{p}"
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

@@ -150,6 +150,7 @@ import dataclasses
 import functools
 import hashlib
 import os
+import threading
 import time
 
 import jax
@@ -1953,12 +1954,50 @@ def serve_forever(
     drains — stops admitting, finishes the live slots, exits — instead
     of killing requests mid-decode.
 
+    With ``TPUFLOW_ROUTER_GATEWAY`` armed (the default) the loop also
+    starts a ``ReplicaGateway`` — the replica-side ``/generate``
+    endpoint the front-door router forwards to — sharing the step
+    loop's lock (submit and step interleave safely) and advertising its
+    URL as ``generate_url`` in this process's ``/status`` snapshot, so
+    the fleet row the router picks carries a forwardable address.
+
     ``max_s`` bounds the loop (tests / bounded jobs); ``should_stop`` is
     an optional callable polled each iteration.
     """
     from tpuflow.utils import heartbeat, preempt
 
     obs.maybe_start_export()
+    step_lock = threading.RLock()
+    gateway = None
+    if knobs.get_bool("TPUFLOW_ROUTER_GATEWAY"):
+        # Production ingress (ISSUE 17): without this, every fleet row
+        # is status-only and the router's http_forward has nothing to
+        # POST to. Ephemeral port — the URL travels via /status, no
+        # static port to collide on. Bind host follows the /status
+        # exporter's knob so both endpoints share reachability.
+        from tpuflow.infer.frontdoor import ReplicaGateway
+
+        gw_host = knobs.raw("TPUFLOW_OBS_HTTP_HOST", "127.0.0.1")
+        try:
+            gateway = ReplicaGateway(
+                engine, lock=step_lock, host=gw_host
+            )
+        except OSError as e:
+            print(
+                f"[tpuflow] replica gateway failed to bind on "
+                f"{gw_host} ({e}); serving status-only"
+            )
+        else:
+            url = gateway.url
+            if gw_host == "0.0.0.0":  # noqa: S104 (operator knob)
+                import socket as _socket
+                from urllib.parse import urlsplit
+
+                port = urlsplit(url).port
+                url = (
+                    f"http://{_socket.gethostname()}:{port}/generate"
+                )
+            obs.goodput_live().note_serve_generate_url(url)
     if obs.recorder() is not None and knobs.get_bool(
         "TPUFLOW_DEVICE_LEDGER"
     ):
@@ -1979,15 +2018,28 @@ def serve_forever(
     draining = False
     try:
         while True:
-            if preempt.preemption_requested():
+            if preempt.preemption_requested() and not draining:
+                # Drain hook (ISSUE 17): flip the exported flag the same
+                # iteration admissions stop, so the front-door router
+                # sees ``serve_draining`` on the next /status poll and
+                # re-routes queued work instead of waiting for
+                # staleness to prove a death that is actually a drain.
                 draining = True
-            did = engine.step(admit=not draining)
+                obs.goodput_live().note_serve_draining(True)
+                if gateway is not None:
+                    # New /generate requests 503 "draining" at once —
+                    # the router re-dispatches instead of queueing work
+                    # on a replica that will never admit it.
+                    gateway.draining = True
+            with step_lock:
+                did = engine.step(admit=not draining)
             heartbeat.beat(step=engine._iters)
             if draining and not engine._live.any():
                 # Queued requests ride the requeue; their traces reach
                 # the drained terminal so no submitted request vanishes
                 # from the access log (ISSUE 13).
-                engine.drain_queued()
+                with step_lock:
+                    engine.drain_queued()
                 return
             if should_stop is not None and should_stop():
                 return
@@ -1995,11 +2047,18 @@ def serve_forever(
                 return
             if not did:
                 if draining:
-                    engine.drain_queued()
+                    with step_lock:
+                        engine.drain_queued()
                     return
                 with engine.ledger.bucket("idle"):
                     time.sleep(idle_sleep_s)
     finally:
+        if gateway is not None:
+            # Retract the advertised URL before the socket dies so a
+            # fleet poll racing the shutdown never hands the router an
+            # address that can only ever refuse.
+            obs.goodput_live().note_serve_generate_url(None)
+            gateway.close()
         # Run registry (ISSUE 16): whatever ended the loop — drain,
         # stop callable, deadline, or an exception on its way out —
         # this replica's headline (requests, TTFT/ITL percentiles from

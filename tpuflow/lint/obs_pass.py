@@ -135,6 +135,17 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("event", "registry.append"),
     ("event", "alert.fired"),
     ("event", "alert.resolved"),
+    # Front-door router (ISSUE 17): admission, failover, and drain
+    # evidence — the chaos harness's zero-drop claim is audited from
+    # exactly these events.
+    ("event", "router.admit"),
+    ("event", "router.reject"),
+    ("event", "router.retry"),
+    ("event", "router.reroute"),
+    ("event", "router.drain"),
+    ("event", "router.replace"),
+    ("gauge", "router.queue_depth"),
+    ("gauge", "router.budget_pages"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
     ("event", "ops.flash_bwd_fused"),

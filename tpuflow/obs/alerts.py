@@ -17,6 +17,12 @@ aggregation — the "actuator" side of the PR 13–15 sensors:
 - ``health_collapse`` — the fleet's worst replica health score fell
   under the floor.
 - ``stale_replicas``  — replicas stopped answering /status.
+- ``reroute_spike``   — (ISSUE 17) the front-door router's reroute
+  rate (reroutes / completed requests over the fast window, from the
+  same cumulative-counter construction as the burn gate) exceeded
+  ``TPUFLOW_ALERT_REROUTE_RATE``. Occasional reroutes are the router
+  doing its job; a sustained rate means replicas are dying or
+  stalling faster than the fleet absorbs.
 
 Lifecycle: a rule entering its firing condition emits ONE
 ``alert.fired`` event (severity + runbook anchor + message); while it
@@ -80,6 +86,12 @@ RULES: tuple[Rule, ...] = (
     Rule(
         "stale_replicas", "ticket", "fleet-observability-runbook",
         "one or more replicas stopped answering /status",
+    ),
+    Rule(
+        "reroute_spike", "ticket", "router--failover-runbook",
+        "front-door reroute rate over the fast window past the "
+        "threshold — replicas dying/stalling faster than the fleet "
+        "absorbs",
     ),
 )
 
@@ -150,6 +162,7 @@ class AlertEngine:
         goodput_min: float | None = None,
         min_health: float | None = None,
         cooldown_s: float | None = None,
+        reroute_rate: float | None = None,
     ):
         self.rules = {r.name: r for r in rules}
         self._clock = clock
@@ -167,6 +180,8 @@ class AlertEngine:
             min_health = knobs.get_float("TPUFLOW_ALERT_MIN_HEALTH")
         if cooldown_s is None:
             cooldown_s = knobs.get_float("TPUFLOW_ALERT_COOLDOWN_S")
+        if reroute_rate is None:
+            reroute_rate = knobs.get_float("TPUFLOW_ALERT_REROUTE_RATE")
         self.slo_budget = float(slo_budget)
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
@@ -174,7 +189,11 @@ class AlertEngine:
         self.goodput_min = float(goodput_min)
         self.min_health = float(min_health)
         self.cooldown_s = float(cooldown_s)
+        self.reroute_rate = float(reroute_rate)
         self._samples: deque[tuple[float, float, float]] = deque()
+        # (ts, router_requests, router_reroutes) — same cumulative-
+        # counter shape as _samples, so window_rate() applies verbatim.
+        self._router_samples: deque[tuple[float, float, float]] = deque()
         self._active: dict[str, dict[str, Any]] = {}
         self._lock = threading.Lock()
 
@@ -196,6 +215,20 @@ class AlertEngine:
             cut = now - self.slow_window_s
             while self._samples and self._samples[0][0] < cut:
                 self._samples.popleft()
+        rr = rq = None
+        if status is not None:
+            rq = status.get("router_requests")
+            rr = status.get("router_reroutes")
+        if isinstance(rq, (int, float)) and isinstance(
+            rr, (int, float)
+        ):
+            self._router_samples.append((now, float(rq), float(rr)))
+            cut = now - max(self.fast_window_s, self.slow_window_s)
+            while (
+                self._router_samples
+                and self._router_samples[0][0] < cut
+            ):
+                self._router_samples.popleft()
 
     def _evaluate(
         self, now: float, status: dict | None, fleet: dict | None
@@ -262,6 +295,17 @@ class AlertEngine:
                     f"(of {fleet.get('replicas', '?')})",
                     int(stale),
                 )
+        rrate = window_rate(
+            list(self._router_samples), now, self.fast_window_s
+        )
+        if rrate is not None and rrate > self.reroute_rate:
+            firing["reroute_spike"] = (
+                f"router reroute rate {rrate:.3f} over the fast "
+                f"window exceeds the {self.reroute_rate:.3g} "
+                f"threshold — replicas dying/stalling faster than "
+                f"the fleet absorbs",
+                round(rrate, 4),
+            )
         return {k: v for k, v in firing.items() if k in self.rules}
 
     # ------------------------------------------------------- lifecycle

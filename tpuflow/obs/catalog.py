@@ -349,6 +349,58 @@ CATALOG: dict[str, tuple[str, str]] = {
         "malformed/truncated JSON (replica, url, age_s, last error); "
         "its health score pins to 0 until it answers again",
     ),
+    # -------------------------------------------------------------- router
+    # Front-door router (ISSUE 17): the admission/failover evidence
+    # trail. A request's router events reconstruct its whole fleet
+    # journey — admitted under what budget, forwarded where, rerouted
+    # off which dead replica — without touching any replica's log.
+    "router.admit": (
+        "event",
+        "a request cleared fleet token-budget admission and was "
+        "dispatched (request id, replica, pages charged, queue wait s, "
+        "affinity hit)",
+    ),
+    "router.reject": (
+        "event",
+        "a request exhausted its retry budget or timed out in the "
+        "admission queue and returned 503 — the router's only loss "
+        "mode, and it is explicit, bounded, and counted",
+    ),
+    "router.retry": (
+        "event",
+        "one forward attempt failed (timeout, refused, 5xx) and the "
+        "request re-dispatched after backoff (request id, attempt, "
+        "failed replica, error)",
+    ),
+    "router.reroute": (
+        "event",
+        "a retry landed on a different replica than the failed one — "
+        "the transparent-failover case: replica died or stalled "
+        "mid-request, client still sees exactly one answer",
+    ),
+    "router.drain": (
+        "event",
+        "a replica flipped serve_draining in its /status (SIGTERM "
+        "landed): no new admissions route there; its queued-but-"
+        "unstarted work re-enters the pick loop",
+    ),
+    "router.replace": (
+        "event",
+        "the autoscale loop launched a prewarm_cache-seeded "
+        "replacement or requested scale-up (action, replica, reason: "
+        "stale | occupancy | slo_rate)",
+    ),
+    "router.queue_depth": (
+        "gauge",
+        "requests waiting in the front door's admission queue for "
+        "fleet token budget (backpressure queues here, never drops)",
+    ),
+    "router.budget_pages": (
+        "gauge",
+        "fleet token budget the admission gate sees: summed pages_free "
+        "over routable replicas minus pages the router has charged to "
+        "in-flight requests",
+    ),
     # --------------------------------------------------------------- quant
     "quant.decision": (
         "event",

@@ -58,6 +58,7 @@ import bisect
 import json
 import os
 import socket
+import threading
 import time
 from typing import Any, Callable, Iterable
 
@@ -555,14 +556,52 @@ def aggregate(statuses: list[dict]) -> dict[str, Any]:
 
 def append_snapshot(path: str, snapshot: dict) -> bool:
     """Append one fleet snapshot as a JSONL line (post-hoc analysis
-    trail); failures are reported via the return value, never raised."""
+    trail); failures are reported via the return value, never raised.
+
+    Multi-writer safe (ISSUE 17): the router's poller and a concurrent
+    ``tpu_watch --fleet`` may both point at the same
+    ``TPUFLOW_FLEET_SNAPSHOT_PATH``, so the whole line lands in ONE
+    O_APPEND write — concurrent appenders interleave snapshots, not
+    bytes (the registry's crash-safe idiom), and a crash tears at most
+    the final line, which ``read_snapshots`` skips."""
     try:
+        data = (json.dumps(snapshot, default=str) + "\n").encode()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(snapshot, default=str) + "\n")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
         return True
     except OSError:
         return False
+
+
+def read_snapshots(path: str) -> list[dict]:
+    """Every well-formed fleet snapshot in file order. A torn final line
+    (an append died mid-write), a corrupt line, or a non-snapshot JSON
+    value is skipped — reading a damaged trail never raises."""
+    out: list[dict] = []
+    try:
+        f = open(path, encoding="utf-8", errors="replace")
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            if not line.endswith("\n"):
+                continue  # torn tail: the append died mid-write
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                snap = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(snap, dict) and isinstance(
+                snap.get("fleet"), dict
+            ):
+                out.append(snap)
+    return out
 
 
 # ---------------------------------------------------------------- poller
@@ -750,6 +789,7 @@ class FleetObservatory:
                 "stale": stale,
                 "health": round(score, 3),
                 "health_reasons": reasons,
+                "queue_trend": rep.queue_trend,
             }
             if rep.last_ok is not None:
                 row["age_s"] = round(now - rep.last_ok, 3)
@@ -767,8 +807,9 @@ class FleetObservatory:
                     "serve_slo_violations", "serve_pages_free",
                     "serve_decode_utilization", "serve_idle_fraction",
                     "serve_decode_fraction", "serve_ttft_p99_s",
-                    "serve_itl_p99_s", "uptime_s", "step", "mfu",
-                    "hbm_used_frac", "hbm_peak_frac",
+                    "serve_itl_p99_s", "serve_draining",
+                    "generate_url", "uptime_s",
+                    "step", "mfu", "hbm_used_frac", "hbm_peak_frac",
                 ):
                     if key in rep.status:
                         row[key] = rep.status[key]
@@ -789,6 +830,61 @@ class FleetObservatory:
             (r["health"] for r in rows), default=0.0
         )
         return {"ts": time.time(), "fleet": fleet, "replicas": rows}
+
+
+class FleetPoller:
+    """Background sweep loop over a :class:`FleetObservatory`.
+
+    The front-door router requires a CHEAP ``snapshot_fn`` —
+    ``observatory.poll()`` is a synchronous HTTP sweep of every replica
+    and must never run on the routing path (an unresponsive /status
+    would stall admission exactly when the fleet is degraded). The
+    poller owns that sweep on a daemon thread (``interval_s``, default
+    the observatory's poll cadence) and hands consumers
+    :meth:`snapshot`: the last COMPLETED sweep, a dict handoff under a
+    lock — microseconds, never a round-trip. One synchronous sweep runs
+    at construction so the first consumer already sees a populated
+    fleet."""
+
+    def __init__(
+        self,
+        observatory: FleetObservatory,
+        interval_s: float | None = None,
+    ):
+        self.observatory = observatory
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else observatory.poll_interval_s
+        )
+        self._lock = threading.Lock()
+        self._snap: dict[str, Any] = {}
+        self._stop = threading.Event()
+        self._sweep()
+        self._thread = threading.Thread(
+            target=self._run, name="tpuflow-fleet-poller", daemon=True
+        )
+        self._thread.start()
+
+    def _sweep(self) -> None:
+        try:
+            snap = self.observatory.poll()
+        except Exception:  # noqa: BLE001 — a bad sweep must not kill
+            return  # the loop; consumers keep the last good snapshot
+        with self._lock:
+            self._snap = snap
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._sweep()
+
+    def snapshot(self) -> dict[str, Any]:
+        """The last completed sweep (``{}`` until one succeeds)."""
+        with self._lock:
+            return self._snap
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
 
 
 # ------------------------------------------------------------- rendering

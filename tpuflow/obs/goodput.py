@@ -319,6 +319,15 @@ class ProcessLedger:
         self.serve_queue_depth = 0
         self.serve_live_slots = 0
         self.serve_max_slots = 0
+        # Drain flag (ISSUE 17): set by serve_forever the moment SIGTERM
+        # flips it to admit=False, exported on /status so the front-door
+        # router stops admitting to this replica BEFORE it goes dark.
+        self.serve_draining = False
+        # Forwarding address (ISSUE 17): the replica-side /generate URL
+        # (serve_forever's ReplicaGateway), exported verbatim on
+        # /status — the fleet row copies it and http_forward POSTs to
+        # it. None = no gateway, the row is status-only.
+        self.serve_generate_url: str | None = None
         # Paged-KV view (ISSUE 11): page-pool headroom, prefix-cache
         # reuse, and speculative acceptance — zero serve_pages_total =
         # a contiguous (non-paged) engine, keys omitted.
@@ -446,6 +455,20 @@ class ProcessLedger:
                 self.serve_requests_by_group.get(group, 0) + 1
             )
 
+    def note_serve_draining(self, draining: bool = True) -> None:
+        """The serve loop entered (or left) its SIGTERM drain: no new
+        admissions; the fleet row carries ``serve_draining`` so a router
+        re-routes this replica's queued work instead of waiting for
+        staleness to prove the death."""
+        self.serve_draining = bool(draining)
+
+    def note_serve_generate_url(self, url: str | None) -> None:
+        """Advertise (or retract) this replica's /generate endpoint.
+        The /status snapshot carries it as ``generate_url``; the fleet
+        observatory copies it onto the replica row, which is what the
+        front-door router's ``http_forward`` POSTs to."""
+        self.serve_generate_url = url if url is None else str(url)
+
     def note_serve_pages(self, free: int, total: int) -> None:
         """Paged-KV pool headroom (free includes idle-evictable pages)."""
         self.serve_pages_free = int(free)
@@ -540,6 +563,12 @@ class ProcessLedger:
                 out["hbm_peak_frac"] = round(
                     self.hbm_peak_bytes / self.hbm_limit_bytes, 4
                 )
+        # Outside the serve_max_slots guard on purpose: the gateway
+        # starts before the engine's first scheduler iteration feeds
+        # note_serve_state, and the router must be able to forward from
+        # the very first fleet poll.
+        if self.serve_generate_url:
+            out["generate_url"] = self.serve_generate_url
         if self.serve_max_slots:
             out["serve_requests"] = self.serve_requests
             out["serve_tokens"] = self.serve_tokens
@@ -582,6 +611,8 @@ class ProcessLedger:
                     self.serve_masked_row_waste, 4
                 )
             out["serve_slo_violations"] = self.serve_slo_violations
+            if self.serve_draining:
+                out["serve_draining"] = True
             # Mergeable histogram view (ISSUE 14): cumulative bucket
             # counts /metrics renders in the Prometheus histogram
             # convention and the fleet observatory SUMS across replicas

@@ -55,6 +55,17 @@ process. Grammar (one spec per entry)::
                                  the fast checkpoint tier (default 5 s) —
                                  a slow shared filesystem the async saver
                                  must absorb off the training path
+    replica_kill:<id>@<t>        serving chaos (ISSUE 17): kill replica
+                                 <id> (a fleet replica id, not a gang
+                                 rank) <t> seconds into the chaos drive —
+                                 its sockets close abruptly, like a pod
+                                 death mid-decode; the router must
+                                 re-dispatch its in-flight work
+    replica_stall:<id>@<t>       serving chaos: replica <id> stops
+                                 stepping <t> seconds in but its sockets
+                                 stay open and hang — the livelock case
+                                 only the router's per-replica forward
+                                 timeout can detect
 
 Hooks are threaded through gang exec (``maybe_rendezvous_delay``), the
 train loops (``step_boundary`` — called by ``TrainContext.report`` and
@@ -81,6 +92,9 @@ class Fault:
     rank: int | None = None
     step: int | None = None
     value: float | None = None
+    # Serving chaos (ISSUE 17): replicas are named by fleet id strings
+    # (pod names), not integer gang ranks.
+    target: str | None = None
 
 
 KINDS = (
@@ -97,6 +111,8 @@ KINDS = (
     "ckpt_io_flaky",
     "ckpt_partial_commit",
     "upload_stall",
+    "replica_kill",
+    "replica_stall",
 )
 
 # Parse cache keyed on the raw env string (tests flip the env between
@@ -128,7 +144,7 @@ def parse(raw: str) -> list[Fault]:
                 f"unknown fault kind {kind!r} in TPUFLOW_FAULT={raw!r}; "
                 f"known: {KINDS}"
             )
-        rank = step = value = None
+        rank = step = value = target = None
         if kind in (
             "member_exit", "member_lost", "preempt", "nan_grad", "loss_spike"
         ):
@@ -162,9 +178,20 @@ def parse(raw: str) -> list[Fault]:
             value = float(int(payload[1:]))
         elif kind == "upload_stall":
             value = float(payload) if payload else 5.0
+        elif kind in ("replica_kill", "replica_stall"):
+            target_s, _, t_s = payload.partition("@")
+            if not target_s or not t_s:
+                raise ValueError(
+                    f"{kind} spec needs '<id>@<t>' (fleet replica id @ "
+                    f"seconds into the drive), got {entry!r}"
+                )
+            target = target_s
+            value = float(t_s)
         elif payload:
             raise ValueError(f"fault {kind} takes no payload, got {entry!r}")
-        out.append(Fault(kind, rank=rank, step=step, value=value))
+        out.append(
+            Fault(kind, rank=rank, step=step, value=value, target=target)
+        )
     return out
 
 
@@ -347,6 +374,24 @@ def maybe_upload_stall() -> None:
             f"[faults] upload_stall: sleeping {f.value}s", file=sys.stderr
         )
         time.sleep(f.value or 0.0)
+
+
+def replica_plan() -> list[tuple[str, str, float]]:
+    """Serving-chaos hook (ISSUE 17): the ``(kind, replica_id, at_s)``
+    schedule of every ``replica_kill``/``replica_stall`` spec, sorted by
+    fire time. The chaos harness (``tpuflow.testing.chaos``) applies it
+    against its in-process replicas; the ``serving.router`` bench leg
+    reads the same specs, so one ``TPUFLOW_FAULT`` string drives both.
+    One env lookup when unset."""
+    if not knobs.raw("TPUFLOW_FAULT"):
+        return []
+    plan = [
+        (f.kind, f.target or "", float(f.value or 0.0))
+        for f in _specs()
+        if f.kind in ("replica_kill", "replica_stall")
+    ]
+    plan.sort(key=lambda x: x[2])
+    return plan
 
 
 def corrupt_after_write(path: str) -> None:

@@ -50,6 +50,7 @@ _A_HEALTH = "training-health-runbook"
 _A_STEP = "step-pipeline--performance-runbook"
 _A_SERVE = "serving-runbook"
 _A_FLEET = "fleet-observability-runbook"
+_A_ROUTER = "router--failover-runbook"
 _A_DEVICE = "device-observatory-runbook"
 _A_QUANT = "quantization-runbook"
 _A_ALERTS = "regression--alerting-runbook"
@@ -441,6 +442,72 @@ REGISTRY: dict[str, Knob] = dict(
            "replica identity stamped into /status and the registration "
            "file (the serving Deployment sets it from the pod name; "
            "default host-pid)", "fleet", _A_FLEET, internal=True),
+        # --------------------------------------------------------- router
+        _k("TPUFLOW_ROUTER_PORT", "int", 8900,
+           "front-door HTTP bind port (0 = ephemeral; the router is the "
+           "fleet's single client-facing ingress)", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_HOST", "str", "127.0.0.1",
+           "front-door HTTP bind host (0.0.0.0 for a cluster ingress)",
+           "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_TARGET", "str", None,
+           "replica discovery target: a registration dir or comma "
+           "/status URL list (default: the fleet observatory's "
+           "discovery knobs)", "router", _A_ROUTER,
+           default_doc="fleet knobs"),
+        _k("TPUFLOW_ROUTER_GATEWAY", "bool", True,
+           "0 = serve_forever skips its replica-side /generate gateway "
+           "(the fleet row stays status-only and the front door cannot "
+           "forward to this replica); the gateway shares the step "
+           "loop's lock and advertises its URL as generate_url in "
+           "/status", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_TIMEOUT_S", "float", 30.0,
+           "per-replica forward timeout (s): a stalled replica is "
+           "indistinguishable from a slow one until this expires, then "
+           "the request re-dispatches", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_RETRIES", "int", 3,
+           "forward retry budget per request; exhaustion returns 503 to "
+           "the client (bounded, never a hang)", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_BACKOFF_S", "float", 0.05,
+           "exponential-backoff base slept before retry k "
+           "(base * 2^(k-1), capped at 2s)", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_AFFINITY", "bool", True,
+           "0 = disable prefix-affine routing (requests sharing a "
+           "prompt prefix pin to the replica already holding those "
+           "pages — the fleet-wide prefix cache)", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_HEDGE", "bool", False,
+           "1 = the first retry after a forward failure fires "
+           "immediately (no backoff sleep) — lower rerouted-tail "
+           "latency at the cost of load on an already-degraded fleet",
+           "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_MIN_HEALTH", "float", 0.25,
+           "fleet health-score floor for routing eligibility (stale "
+           "replicas score 0 and are never routable)", "router",
+           _A_ROUTER),
+        _k("TPUFLOW_ROUTER_TREND_DECAY", "float", 0.5,
+           "balance-score multiplier per consecutive queue-growth poll "
+           "(score = health * decay^trend): a replica falling behind "
+           "its arrivals sheds new work geometrically", "router",
+           _A_ROUTER),
+        _k("TPUFLOW_ROUTER_QUEUE_TIMEOUT_S", "float", 60.0,
+           "max seconds a request waits in the admission queue for "
+           "fleet token budget before 503 (backpressure queues, never "
+           "drops — this is the bound that keeps the queue finite)",
+           "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_AUTOSCALE", "bool", False,
+           "1 = arm the autoscale/replacement loop: dead replicas get "
+           "prewarm_cache-seeded replacements, sustained occupancy/SLO "
+           "pressure requests scale-up", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_AUTOSCALE_OCC", "float", 0.85,
+           "fleet mean slot-occupancy threshold above which the "
+           "autoscale loop requests one scale-up", "router", _A_ROUTER),
+        _k("TPUFLOW_ROUTER_AUTOSCALE_SLO", "float", 0.05,
+           "fleet SLO violation rate (violations/requests) above which "
+           "the autoscale loop requests one scale-up", "router",
+           _A_ROUTER),
+        _k("TPUFLOW_ROUTER_AUTOSCALE_COOLDOWN_S", "float", 120.0,
+           "minimum seconds between autoscale actions per replica slot "
+           "(replacements must not flap faster than pods can start)",
+           "router", _A_ROUTER),
         # --------------------------------------------------------- device
         _k("TPUFLOW_DEVICE_POLL_S", "float", 10.0,
            "HBM gauge poll cadence (s) at the fences the hot loops "
@@ -508,6 +575,11 @@ REGISTRY: dict[str, Knob] = dict(
         _k("TPUFLOW_ALERT_COOLDOWN_S", "float", 60.0,
            "minimum seconds an alert stays active before it may "
            "resolve (anti-flap hold)", "alerts", _A_ALERTS),
+        _k("TPUFLOW_ALERT_REROUTE_RATE", "float", 0.1,
+           "router reroute rate (reroutes / completed requests over "
+           "the fast window) past which reroute_spike fires — "
+           "sustained rerouting means replicas are dying or stalling "
+           "faster than the fleet absorbs", "alerts", _A_ROUTER),
         # -------------------------------------------------------- testing
         _k("TPUFLOW_FAULT", "str", None,
            "comma-separated fault-injection specs (chaos suite)",
@@ -528,6 +600,11 @@ REGISTRY: dict[str, Knob] = dict(
            "bench train-leg subprocess timeout (s)", "bench", _A_BENCH),
         _k("TPUFLOW_BENCH_SERVE", "bool", True,
            "0 = skip the serving bench leg", "bench", _A_BENCH),
+        _k("TPUFLOW_BENCH_ROUTER", "bool", True,
+           "0 = skip the serving.router bench leg (3 in-process "
+           "replicas + one kill behind the front door; records "
+           "dropped_requests — must be 0 — and routed p99)", "bench",
+           _A_BENCH),
         _k("TPUFLOW_BENCH_INT8", "bool", True,
            "0 = skip the int8 bench legs", "bench", _A_BENCH),
         _k("TPUFLOW_BENCH_OVERLAP", "bool", True,
@@ -577,6 +654,7 @@ _SUBSYSTEM_TITLES = (
     ("quant", "Quantization"),
     ("serve", "Serving"),
     ("fleet", "Fleet observatory"),
+    ("router", "Front-door router"),
     ("device", "Device observatory"),
     ("alerts", "Run registry & alerting"),
     ("testing", "Fault injection & testing"),
