@@ -881,6 +881,12 @@ def bench_serving_router(model, params, cfg, on_tpu: bool) -> dict:
             "reroutes": stats["router_reroutes"],
             "retries": stats["router_retries"],
             "affinity_hits": stats["router_affinity_hits"],
+            # Registry headline trio (ISSUE 18): the raw router_*
+            # counters ride the record verbatim so trend/compare track
+            # them across runs (router_dropped must stay 0).
+            "router_requests": stats["router_requests"],
+            "router_reroutes": stats["router_reroutes"],
+            "router_dropped": stats["router_dropped"],
             "routed_p50_s": (
                 round(lat[len(lat) // 2], 4) if lat else None
             ),
@@ -2564,6 +2570,22 @@ def _compact_summary(record: dict, train) -> dict:
             digest["serving_paged"]["programs_ledger"] = paged[
                 "programs_ledger_path"
             ]
+    # Front-door router verdicts (ISSUE 17/18): the zero-drop contract
+    # plus the registry headline trio. Legacy records (pre-router, or a
+    # skipped/errored sub-leg) simply lack the digest section — the
+    # registry's guarded path walk reports "metric absent".
+    rtr = serving.get("router", {})
+    if isinstance(rtr, dict) and isinstance(
+        rtr.get("dropped_requests"), (int, float)
+    ):
+        digest["serving_router"] = {
+            "dropped_requests": rtr["dropped_requests"],
+            "reroutes": rtr.get("reroutes"),
+            "routed_p99_s": rtr.get("routed_p99_s"),
+            "router_requests": rtr.get("router_requests"),
+            "router_reroutes": rtr.get("router_reroutes"),
+            "router_dropped": rtr.get("router_dropped"),
+        }
     int8 = ev_train.get("decode", {}).get("int8", {})
     for mode in ("weight_only", "fused_native", "weight", "mxu"):
         # Current sub-leg names first; the legacy r5 names keep older

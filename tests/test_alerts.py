@@ -10,6 +10,8 @@ import os
 import random
 import urllib.request
 
+import pytest
+
 from tpuflow.obs import alerts
 from tpuflow.obs.alerts import AlertEngine, burn_gate, window_rate
 
@@ -219,6 +221,117 @@ def test_reroute_spike_rate_threshold_and_lifecycle():
         ("reroute_spike", "resolved")
     ]
     assert eng.active() == []
+
+
+def test_ttft_router_dominance_threshold_and_lifecycle():
+    """ISSUE 18 satellite: mean router-side wait per completed request
+    (``router_wait_s``/``router_requests`` through the same
+    cumulative-counter window_rate as the burn gate) against the fleet
+    TTFT p95 — fires the ticket-severity ``ttft_router_dominance`` only
+    past the knob-set fraction, dedups on the rising edge, and points
+    the operator at ``obs trace``."""
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=0.0, router_ttft_frac=0.5)
+    fleet = {"ttft": {"p50": 0.1, "p95": 0.2, "p99": 0.3}}
+    # One sample: nothing to difference -> silent.
+    assert eng.observe(
+        status={"router_requests": 0, "router_wait_s": 0.0},
+        fleet=fleet,
+    ) == []
+    # Healthy: 100 requests waited 2s total (0.02s/req < 0.5*0.2).
+    clock.t += 10.0
+    assert eng.observe(
+        status={"router_requests": 100, "router_wait_s": 2.0},
+        fleet=fleet,
+    ) == []
+    # The router becomes the bottleneck: the next 100 requests waited
+    # 23 more seconds, dragging the fast-window mean to 25s/200req =
+    # 0.125s/req > 0.5 * 0.2 -> fires once, ticket severity, anchored
+    # to the tracing runbook, message naming the obs trace workflow.
+    clock.t += 10.0
+    fired = eng.observe(
+        status={"router_requests": 200, "router_wait_s": 25.0},
+        fleet=fleet,
+    )
+    assert [t["rule"] for t in fired] == ["ttft_router_dominance"]
+    assert fired[0]["severity"] == "ticket"
+    assert fired[0]["runbook"] == "distributed-tracing-runbook"
+    assert "obs trace" in fired[0]["message"]
+    assert fired[0]["value"] == pytest.approx(0.125)
+    # Still dominated next sweep: dedup, no second transition.
+    clock.t += 1.0
+    assert eng.observe(
+        status={"router_requests": 210, "router_wait_s": 26.5},
+        fleet=fleet,
+    ) == []
+    # Admission wait recovers (rate diluted under the threshold): the
+    # alert resolves once.
+    clock.t += 10.0
+    resolved = eng.observe(
+        status={"router_requests": 2000, "router_wait_s": 27.0},
+        fleet=fleet,
+    )
+    assert [(t["rule"], t["state"]) for t in resolved] == [
+        ("ttft_router_dominance", "resolved")
+    ]
+    assert eng.active() == []
+
+
+def test_ttft_router_dominance_needs_flow_p95_and_positive_frac():
+    """Undefined inputs never page: no request flow between sweeps, a
+    missing/degenerate fleet p95, or a zeroed fraction knob all keep
+    the rule silent — an idle router with a scary past is not an
+    incident, and neither is a fleet that has not served yet."""
+    clock = FakeClock()
+    eng = _engine(clock, cooldown_s=0.0, router_ttft_frac=0.5)
+    fleet = {"ttft": {"p95": 0.2}}
+    # Massive wait counters but zero request flow: rate is undefined.
+    for _ in range(3):
+        clock.t += 10.0
+        assert eng.observe(
+            status={"router_requests": 500, "router_wait_s": 400.0},
+            fleet=fleet,
+        ) == []
+    # Real flow and dominance-grade wait, but no usable p95: silent.
+    for bad_fleet in (
+        None, {}, {"ttft": {"p95": 0.0}},
+        {"ttft": {"p95": float("inf")}}, {"ttft": "junk"},
+    ):
+        clock.t += 10.0
+        assert eng.observe(
+            status={
+                "router_requests": 500 + int(clock.t),
+                "router_wait_s": 400.0 + 10.0 * clock.t,
+            },
+            fleet=bad_fleet,
+        ) == []
+    # A disarmed fraction (0) never fires even on flagrant dominance.
+    eng0 = _engine(clock, cooldown_s=0.0, router_ttft_frac=0.0)
+    eng0.observe(
+        status={"router_requests": 0, "router_wait_s": 0.0},
+        fleet=fleet,
+    )
+    clock.t += 10.0
+    assert eng0.observe(
+        status={"router_requests": 100, "router_wait_s": 99.0},
+        fleet=fleet,
+    ) == []
+    # Statuses missing the wait counter feed nothing.
+    clock.t += 10.0
+    assert eng0.observe(
+        status={"router_requests": 200}, fleet=fleet
+    ) == []
+
+
+def test_ttft_router_dominance_knob_default(monkeypatch):
+    """The fraction resolves from TPUFLOW_ALERT_ROUTER_TTFT_FRAC when
+    not injected, and the rule is registered with its runbook anchor."""
+    monkeypatch.setenv("TPUFLOW_ALERT_ROUTER_TTFT_FRAC", "0.25")
+    eng = _engine(FakeClock())
+    assert eng.router_ttft_frac == 0.25
+    rule = {r.name: r for r in alerts.RULES}["ttft_router_dominance"]
+    assert rule.severity == "ticket"
+    assert rule.runbook == "distributed-tracing-runbook"
 
 
 def test_reroute_spike_never_fires_without_request_flow():

@@ -35,6 +35,7 @@ def _greedy_reference(model, params, prompt, n_new):
     return np.stack(out, axis=1)
 
 
+@pytest.mark.slow
 def test_greedy_cached_decode_matches_full_forward():
     model, params = _model()
     prompt = np.arange(3 * 7, dtype=np.int32).reshape(3, 7) % 512

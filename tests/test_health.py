@@ -420,6 +420,7 @@ def test_chaos_nan_grad_rollback_continuous_history(tmp_path, monkeypatch):
     )
 
 
+@pytest.mark.slow
 def test_chaos_nan_grad_halts_when_rollback_disabled(tmp_path, monkeypatch):
     """With TPUFLOW_HEALTH_ROLLBACK=0 the same fault halts the run with a
     diagnostic naming the detector — instead of reporting NaN losses."""

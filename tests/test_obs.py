@@ -405,6 +405,14 @@ def test_obs_catalog_lint():
         ("event", "router.replace"),
         ("gauge", "router.queue_depth"),
         ("gauge", "router.budget_pages"),
+        # End-to-end tracing (ISSUE 18) with the right kinds (also
+        # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
+        # tail-sampling escalations, per-flush evidence, and the
+        # spans-written/spans-dropped conservation pair.
+        ("event", "trace.escalate"),
+        ("event", "trace.flush"),
+        ("counter", "trace.spans"),
+        ("counter", "trace.dropped"),
         # Native int8 decode (ISSUE 9) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check).
         ("span", "serve.quant_decode"),
@@ -488,6 +496,7 @@ def _read_run_events(run_dir):
     return obs.read_events(path)
 
 
+@pytest.mark.slow
 def test_gpt_flow_dryrun_produces_timeline(tmp_path, monkeypatch):
     """The acceptance dryrun on the REAL flow file: flows/gpt_flow.py run
     with the test preset produces a merged events.jsonl + timeline card."""
@@ -903,7 +912,7 @@ def test_tier1_duration_guard(tmp_path):
     write({"duration_s": 860.0, "markexpr": "not slow",
            "testscollected": 300})
     err = mod.tier1_duration_guard(str(tmp_path))
-    assert err and "860" in err and "800" in err
+    assert err and "860" in err and "820" in err
     # The slow suite and partial runs are exempt — their durations say
     # nothing about the tier-1 budget.
     write({"duration_s": 9000.0, "markexpr": "slow",

@@ -366,6 +366,7 @@ def test_attention_projection_scales_are_per_out_channel(lm):
 
 
 # --------------------------------------------------------------- ISSUE 9
+@pytest.mark.slow
 def test_fused_kernel_and_interceptor_reference_token_exact(lm):
     """The tentpole numerics pin: the Pallas fused quantize-matmul-
     dequant kernel and the XLA int8 dot_general reference produce

@@ -557,6 +557,261 @@ def test_http_forward_raises_on_replica_503():
         gw.close()
 
 
+# ------------------------------------------ end-to-end tracing (ISSUE 18)
+def _mk_ctx(rid="q1", sampled=True):
+    from tpuflow.obs import trace as reqtrace
+
+    return reqtrace.TraceContext("a" * 32, "b" * 16, rid, sampled=sampled)
+
+
+def test_route_traced_retry_reroute_span_chain():
+    """The router's per-attempt spans: each forward attempt links
+    causally to the PRIOR attempt's span, the replica-propagation span
+    is mutated to the live attempt, the reroute escalates the trace,
+    and router_wait_s accumulates admission wait into /status."""
+    state = {"rows": [_row("dying"), _row("live", health=0.9)]}
+
+    def forward(row, request, timeout_s):
+        if row["id"] == "dying":
+            raise RuntimeError("connection reset")
+        return {"replica": row["id"]}
+
+    ctx = _mk_ctx()
+    r = _router(state, forward, sleep=lambda s: None)
+    req = {
+        "id": "q1", "prompt": [1, 2], "max_new_tokens": 1,
+        "_trace_ctx": ctx,
+    }
+    resp = r.route(req)
+    assert resp["replica"] == "live"
+    names = [s["name"] for s in ctx.spans]
+    assert names == [
+        "router.queue", "router.forward",  # attempt 0: failed
+        "router.queue", "router.forward",  # attempt 1: rerouted
+    ]
+    f0, f1 = [s for s in ctx.spans if s["name"] == "router.forward"]
+    assert f0["attempt"] == 0 and f0["ok"] is False
+    assert f0["replica"] == "dying"
+    assert "connection reset" in f0["error"]
+    assert f0["backoff_s"] == pytest.approx(0.01)
+    assert f0["parent"] == ctx.root_id  # first attempt hangs off entry
+    assert f1["attempt"] == 1 and f1["ok"] is True
+    assert f1["replica"] == "live" and f1["reroute"] is True
+    assert f1["parent"] == f0["span"]  # causal link to the prior attempt
+    # The propagation span IS the live attempt: the replica's spans
+    # parent to exactly the forward that carried them.
+    assert ctx.span_id == f1["span"]
+    for q in (s for s in ctx.spans if s["name"] == "router.queue"):
+        assert q["parent"] == ctx.root_id
+    # A reroute is tail-sampled; the error fired first and wins.
+    assert ctx.escalated and ctx.escalate_reason == "error"
+    assert r.stats()["router_wait_s"] >= 0.0
+
+
+def test_route_traced_queue_timeout_reject_spans():
+    """A queue-timeout FleetBusy leaves the evidence on the context —
+    the terminal router.queue wait plus a router.reject span — and
+    escalates so the rejection is never lost to the head sampler."""
+    state = {"rows": [_row("a", pages=0)]}  # no budget, ever
+    ctx = _mk_ctx(sampled=False)  # head sampler said no
+    r = _router(state, _echo_forward, queue_timeout_s=0.05)
+    with pytest.raises(FleetBusy):
+        r.route({
+            "id": "q1", "prompt": [1, 2], "max_new_tokens": 1,
+            "_trace_ctx": ctx,
+        })
+    assert ctx.escalate_reason == "queue_timeout"
+    assert ctx.recorded  # escalation resurrects the unsampled trace
+    names = [s["name"] for s in ctx.spans]
+    assert names == ["router.queue", "router.reject"]
+    rej = ctx.spans[-1]
+    assert rej["reason"] == "queue_timeout" and rej["attempts"] == 0
+    assert ctx.spans[0]["dur_s"] >= 0.04  # the bounded wait itself
+
+
+def test_route_untraced_request_has_no_trace_keys():
+    """No context on the request: route() runs the pre-trace path and
+    the forward sees the request dict untouched."""
+    seen = {}
+
+    def forward(row, request, timeout_s):
+        seen.update(request)
+        return {"replica": row["id"]}
+
+    r = _router({"rows": [_row("a")]}, forward)
+    r.route({"id": "u1", "prompt": [1, 2], "max_new_tokens": 1})
+    assert "_trace_ctx" not in seen
+    assert r.stats()["router_wait_s"] >= 0.0
+
+
+def test_gateway_propagates_trace_into_engine_and_attach_span():
+    """The gateway hop: a traceparent header rebuilds the context,
+    ``trace=`` rides engine.submit only then, the hold span carries the
+    outcome, and a duplicate-in-flight dedupe-attach is recorded."""
+    from tpuflow.obs import trace as reqtrace
+
+    class _CapturingEngine(_FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.kw = None
+
+        def submit(self, prompt, *, max_new_tokens, eos_id=None, **kw):
+            self.kw = kw
+            self.submits += 1
+            return _FakeHandle([int(len(prompt)), int(max_new_tokens)])
+
+    eng = _CapturingEngine()
+    gw = ReplicaGateway(eng)
+    try:
+        # Untraced: no trace kwarg at all (fake engines without the
+        # parameter keep working — the back-compat pin).
+        code, _ = gw.handle_generate(
+            {"id": "t0", "prompt": [1], "max_new_tokens": 1}
+        )
+        assert code == 200 and eng.kw == {}
+        header = _mk_ctx("t1").to_traceparent()
+        code, _ = gw.handle_generate(
+            {"id": "t1", "prompt": [1, 2], "max_new_tokens": 1},
+            traceparent=header,
+        )
+        assert code == 200
+        assert eng.kw["trace"].trace_id == "a" * 32
+        # Malformed header fails closed to the untraced path.
+        code, _ = gw.handle_generate(
+            {"id": "t2", "prompt": [1], "max_new_tokens": 1},
+            traceparent="garbage",
+        )
+        assert code == 200 and eng.kw == {}
+    finally:
+        gw.close()
+    # Dedupe-attach: an in-flight duplicate records gateway.attach.
+    slow = _FakeEngine()
+    slow.submit = lambda prompt, **kw: _FakeHandle([], state="queued")
+    gw2 = ReplicaGateway(slow, hold_timeout_s=0.05, poll_s=0.01)
+    try:
+        body = {"id": "d1", "prompt": [1], "max_new_tokens": 1}
+        code, _ = gw2.handle_generate(
+            body, traceparent=_mk_ctx("d1").to_traceparent()
+        )
+        assert code == 503  # hold timeout — the handle never finishes
+        ctx2 = reqtrace.from_traceparent(
+            _mk_ctx("d1").to_traceparent(), "d1"
+        )
+        code, _ = gw2._handle_generate(dict(body), "d1", ctx2)
+        assert code == 503
+        assert any(
+            s["name"] == "gateway.attach" and s["attached"]
+            for s in ctx2.spans
+        )
+    finally:
+        gw2.close()
+
+
+def test_frontdoor_trace_end_to_end_over_http(tmp_path, monkeypatch):
+    """The tentpole, over real sockets: FrontDoor mints the context,
+    http_forward strips it off the wire body and speaks traceparent,
+    the gateway's hop lands in its own JSONL, and ``obs trace``
+    assembles one timeline whose hold span parents to the exact
+    forward attempt that carried it."""
+    from tpuflow.obs import trace as reqtrace
+
+    monkeypatch.setenv("TPUFLOW_TRACE_DIR", str(tmp_path))
+    gw = ReplicaGateway(_FakeEngine())
+    state = {"rows": [_row("a", url=gw.url)]}
+    r = Router(
+        lambda: _snap(state["rows"]), http_forward,
+        page_size=8, timeout_s=5.0, retries=1, backoff_s=0.01,
+        queue_timeout_s=1.0, refresh_s=0.0, wait_tick_s=0.01,
+    )
+    door = FrontDoor(r, host="127.0.0.1", port=0)
+    try:
+        req = urllib.request.Request(
+            door.url + "/generate",
+            data=json.dumps(
+                {"id": "e2e-1", "prompt": [1, 2, 3],
+                 "max_new_tokens": 2}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        door.close()
+        gw.close()
+    spans = reqtrace.spans_for_request(str(tmp_path), "e2e-1")
+    by_name = {s["name"]: s for s in spans}
+    assert {"router.ingress", "router.queue", "router.forward",
+            "gateway.hold"} <= set(by_name)
+    assert by_name["router.ingress"]["writer"] == "frontdoor"
+    assert by_name["router.ingress"]["status"] == 200
+    fwd = by_name["router.forward"]
+    assert fwd["ok"] is True and fwd["replica"] == "a"
+    # The gateway's hop (its own writer file) parents to the forward
+    # attempt span the traceparent header carried.
+    hold = by_name["gateway.hold"]
+    assert hold["status"] == 200
+    assert hold["parent"] == fwd["span"]
+    assert hold["writer"] != "frontdoor"
+    a = reqtrace.assemble(spans)
+    assert a is not None and not a["rerouted"]
+    assert a["writers"][0] == "frontdoor" and len(a["writers"]) == 2
+    assert [s["segment"] for s in a["critical_path"]] == ["router_queue"]
+
+
+def test_http_forward_strips_ctx_and_sets_traceparent_header():
+    """The in-process context never rides the wire: the JSON body the
+    replica sees has no ``_trace_ctx`` and the traceparent header
+    carries the router's live attempt span."""
+    import http.server as hs
+
+    captured = {}
+
+    class _H(hs.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            captured["body"] = json.loads(self.rfile.read(n))
+            captured["traceparent"] = self.headers.get("traceparent")
+            out = json.dumps({"ok": True}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *args):
+            pass
+
+    srv = hs.ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        h, p = srv.server_address[:2]
+        ctx = _mk_ctx("w1")
+        ctx.span_id = "c" * 16  # the router's live attempt span
+        http_forward(
+            {"id": "a", "generate_url": f"http://{h}:{p}/generate"},
+            {"id": "w1", "prompt": [1], "max_new_tokens": 1,
+             "_trace_ctx": ctx},
+            5.0,
+        )
+        assert "_trace_ctx" not in captured["body"]
+        assert captured["body"]["id"] == "w1"
+        assert captured["traceparent"] == (
+            "00-" + "a" * 32 + "-" + "c" * 16 + "-01"
+        )
+        # Untraced requests carry no header at all.
+        http_forward(
+            {"id": "a", "generate_url": f"http://{h}:{p}/generate"},
+            {"id": "w2", "prompt": [1], "max_new_tokens": 1},
+            5.0,
+        )
+        assert captured["traceparent"] is None
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        th.join(timeout=2.0)
+
+
 # -------------------------------------------- review regressions (PR 17)
 def test_route_rejects_malformed_types_as_valueerror():
     """Type garbage in a request (list max_new_tokens, non-token

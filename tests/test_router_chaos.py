@@ -32,7 +32,7 @@ import pytest
 
 from tpuflow.infer import generate
 from tpuflow.infer.frontdoor import http_forward
-from tpuflow.infer.router import Router
+from tpuflow.infer.router import FleetBusy, Router
 from tpuflow.infer.serve import ServeEngine
 from tpuflow.models.gpt2 import GPT2, GPT2Config
 from tpuflow.obs import fleet as obs_fleet
@@ -194,6 +194,245 @@ def test_router_chaos_kill_and_stall_zero_drops(tmp_path, monkeypatch):
                 replicas[rid].engine.compile_stats() == baselines[rid]
             ), f"{rid} recompiled under chaos"
     finally:
+        for rep in replicas.values():
+            rep.close()
+
+
+def test_traced_reroute_assembles_cross_replica_timeline(
+    tmp_path, monkeypatch
+):
+    """ISSUE 18 chaos acceptance: tracing armed end to end — client →
+    FrontDoor (mints the context) → Router → http_forward (traceparent
+    on the wire) → ReplicaGateway → ServeEngine — under a mid-drive
+    replica_kill. A rerouted request's assembled trace spans every hop
+    across BOTH replicas (the failed forward on the dead one, the
+    gateway + engine lifecycle on the winner), the per-hop spans
+    reconcile against the client-observed wall, the critical path
+    names the reroute, the fleet-MERGED p99 TTFT exemplar resolves to
+    a real on-disk trace through ``obs trace``, and no survivor
+    recompiled with tracing armed."""
+    import json as _json
+    import urllib.error as _uerr
+    import urllib.request as _ureq
+
+    from tpuflow.infer.frontdoor import FrontDoor
+    from tpuflow.obs import trace as reqtrace
+    from tpuflow.obs.__main__ import main as obs_main
+
+    trace_dir = str(tmp_path / "trace")
+    monkeypatch.setenv("TPUFLOW_TRACE_DIR", trace_dir)
+    monkeypatch.setenv("TPUFLOW_TRACE", "1")
+    monkeypatch.setenv("TPUFLOW_TRACE_SAMPLE", "1.0")
+
+    cfg = GPT2Config.small_test(n_ctx=64, dropout=0.0)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(18)
+    # Long decodes on purpose: the kill must land on requests HELD at
+    # the dead replica's gateway (that is what forces the reroute the
+    # assertions trace) — short answers would all complete before it.
+    R, M = 18, 32
+    prompts = [
+        rng.integers(0, 512, size=int(L)).astype(np.int32)
+        for L in rng.integers(4, 20, size=R)
+    ]
+
+    reg = str(tmp_path / "fleet")
+    dev_lock = threading.Lock()
+    replicas: dict[str, LocalReplica] = {}
+    baselines: dict[str, dict] = {}
+    door = None
+    try:
+        for i in range(3):
+            eng = ServeEngine(
+                model, params, max_slots=2, decode_block=4,
+                buckets=[16, 32], page_size=8,
+            )
+            with dev_lock:
+                eng.warmup()
+            rep = LocalReplica(
+                f"tr-{i}", eng,
+                registration_dir=reg, device_lock=dev_lock,
+            )
+            replicas[rep.id] = rep
+            baselines[rep.id] = eng.compile_stats()
+
+        obsy = obs_fleet.FleetObservatory(
+            reg, timeout_s=0.5, stale_s=STALE_S, poll_interval_s=0.02,
+        )
+        router = Router(
+            obsy.poll, http_forward,
+            page_size=8, timeout_s=3.0, retries=4, backoff_s=0.02,
+            queue_timeout_s=120.0, refresh_s=0.05,
+        )
+        router.refresh(force=True)
+        door = FrontDoor(router, host="127.0.0.1", port=0)
+
+        def submit(req: dict) -> dict:
+            """Client side over real sockets: 503 is an explicit
+            FleetBusy to the load harness, never a drop."""
+            post = _ureq.Request(
+                door.url + "/generate",
+                data=_json.dumps(req).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with _ureq.urlopen(post, timeout=130.0) as resp:
+                    return _json.loads(resp.read())
+            except _uerr.HTTPError as e:
+                if e.code == 503:
+                    raise FleetBusy(e.read().decode("utf-8", "replace"))
+                raise
+
+        reqs = [
+            {
+                "id": f"tr-req-{k}",
+                "prompt": [int(t) for t in prompts[k]],
+                "max_new_tokens": M,
+            }
+            for k in range(R)
+        ]
+        # Deterministic mid-drive kill: the wall-clock offset variant
+        # is timing-sensitive (on a fast box every answer can complete
+        # before the fault lands and nothing reroutes). Instead the
+        # killer watches tr-1's gateway and fires the PR 6
+        # ``replica_kill`` the moment work is actually HELD there —
+        # guaranteeing in-flight requests that 503 "killed" back to
+        # the router and force the reroute the assertions trace.
+        chaos_box: dict[str, threading.Thread] = {}
+
+        def _kill_when_held() -> None:
+            deadline = time.monotonic() + 30.0
+            gw = replicas["tr-1"].gateway
+            while time.monotonic() < deadline:
+                if gw._handles:  # a request is held mid-decode
+                    chaos_box["chaos"] = apply_replica_plan(
+                        replicas,
+                        [("replica_kill", "tr-1", 0.0)],
+                        t0=time.monotonic(),
+                    )
+                    return
+                time.sleep(0.002)
+
+        killer = threading.Thread(target=_kill_when_held, daemon=True)
+        killer.start()
+        results = run_poisson(submit, reqs, rate_qps=30.0, rng=rng)
+        killer.join(timeout=35.0)
+        assert "chaos" in chaos_box, "no request was ever held at tr-1"
+        chaos_box["chaos"].join(timeout=30.0)
+
+        assert [r for r in results if r["outcome"] == "error"] == []
+        oks = {r["request"]["id"]: r for r in results
+               if r["outcome"] == "ok"}
+        stats = router.stats()
+        assert stats["router_dropped"] == 0
+        assert stats["router_reroutes"] >= 1
+        assert stats["router_wait_s"] >= 0.0
+
+        # ---- find an answered request that rerouted off the corpse.
+        all_spans = reqtrace.read_spans(trace_dir)
+        assert all_spans, "tracing armed but no spans landed"
+        rerouted_rid = None
+        for rid in oks:
+            spans = [
+                s for s in all_spans if s.get("request") == rid
+            ]
+            fwds = [
+                s for s in spans if s.get("name") == "router.forward"
+            ]
+            if any(not f.get("ok") for f in fwds) and any(
+                f.get("ok") and f.get("reroute") for f in fwds
+            ):
+                rerouted_rid = rid
+                break
+        assert rerouted_rid is not None, (
+            "no answered request carried a failed+rerouted forward pair"
+        )
+        spans = reqtrace.spans_for_request(trace_dir, rerouted_rid)
+        a = reqtrace.assemble(spans)
+        assert a is not None and a["rerouted"] is True
+
+        # Every hop, across both replicas: ingress + queue at the
+        # front door, the failed forward naming the dead replica, the
+        # rerouted forward naming a survivor, the winner's gateway
+        # hold, and the engine lifecycle parented to the exact forward
+        # attempt that carried it.
+        names = {s["name"] for s in spans}
+        assert {
+            "router.ingress", "router.queue", "router.forward",
+            "gateway.hold", "serve.queue", "serve.prefill",
+            "serve.first_tick", "serve.lifecycle",
+        } <= names, names
+        fwds = sorted(
+            (s for s in spans if s["name"] == "router.forward"),
+            key=lambda s: int(s.get("attempt") or 0),
+        )
+        failed = [f for f in fwds if not f.get("ok")]
+        winner = next(f for f in fwds if f.get("ok"))
+        assert failed[0]["replica"] == "tr-1"  # the corpse
+        assert winner["replica"] != "tr-1"
+        assert winner["reroute"] is True
+        # Causal chain: the winning attempt links to the prior attempt.
+        assert winner["parent"] == failed[-1]["span"]
+        # The winner replica's engine spans parent to the winning
+        # forward span — the cross-process stitch.
+        for s in spans:
+            if s["name"].startswith("serve."):
+                assert s["parent"] == winner["span"], s
+        hold200 = [
+            s for s in spans
+            if s["name"] == "gateway.hold" and s.get("status") == 200
+        ]
+        assert hold200 and hold200[0]["parent"] == winner["span"]
+
+        # ---- the critical path names the reroute, dead -> winner.
+        seg_names = [seg["segment"] for seg in a["critical_path"]]
+        assert "reroute" in seg_names
+        reroute_seg = next(
+            seg for seg in a["critical_path"]
+            if seg["segment"] == "reroute"
+        )
+        assert reroute_seg["from"] == "tr-1"
+        assert reroute_seg["to"] == winner["replica"]
+
+        # ---- per-hop spans reconcile against the client wall: the
+        # critical-path sum (TTFT attribution + decode) explains the
+        # ingress-observed wall within generous slop (scheduler jitter
+        # and HTTP overhead live between spans, never inside two).
+        decode_s = sum(
+            seg.get("dur_s", 0.0) for seg in a["critical_path"]
+            if seg["segment"] == "decode"
+        )
+        explained = a["ttft_s"] + decode_s
+        wall = a["wall_s"]
+        assert explained <= wall + 0.5, (explained, wall)
+        assert explained >= 0.25 * wall - 0.5, (explained, wall)
+        client_wall = oks[rerouted_rid]["latency_s"]
+        assert abs(wall - client_wall) <= max(0.5, 0.5 * client_wall)
+
+        # ---- the fleet-MERGED p99 TTFT exemplar resolves to a real
+        # trace on disk, and obs trace renders it.
+        snap = obsy.poll()
+        hist = snap["fleet"].get("ttft_hist")
+        assert hist is not None
+        ex = obs_fleet.hist_exemplar(hist, 0.99)
+        assert isinstance(ex, str) and ex
+        ex_spans = reqtrace.spans_for_trace(trace_dir, ex)
+        assert ex_spans, f"exemplar {ex} has no spans on disk"
+        ex_rid = ex_spans[0]["request"]
+        assert obs_main(["trace", str(ex_rid), trace_dir]) == 0
+
+        # ---- tracing armed end to end never recompiled a survivor.
+        for rid in ("tr-0", "tr-2"):
+            assert (
+                replicas[rid].engine.compile_stats() == baselines[rid]
+            ), f"{rid} recompiled with tracing armed"
+    finally:
+        if door is not None:
+            door.close()
         for rep in replicas.values():
             rep.close()
 

@@ -535,6 +535,37 @@ def test_serve_trace_disarmed_is_one_bool_check(engine):
         engine._trace_on = old
 
 
+def test_trace_id_stamping_backcompat(engine):
+    """ISSUE 18 back-compat pin: serving WITHOUT the front door (no
+    propagated TraceContext) produces lifecycle phases, serve.* event
+    attrs, and access rows with NO ``trace_id`` key at all — absent,
+    never an empty string — while a propagated context stamps its id
+    everywhere. The end-to-end integration lives in the chaos tier;
+    this pins the exact dict shapes."""
+    from tpuflow.obs import trace as reqtrace
+
+    # Untraced: submit() without trace= leaves trace_ctx None and the
+    # lifecycle phase dicts carry no trace_id.
+    r = engine.submit([5, 6], max_new_tokens=2)
+    engine.run_until_idle(max_iters=100)
+    assert r.trace_ctx is None and r.done
+    assert r.trace  # lifecycle recorded...
+    assert all("trace_id" not in p for p in r.trace)  # ...unstamped
+    assert ServeEngine._tid(engine, r) == {}
+
+    # Traced: the propagated context's id stamps phases and _tid.
+    ctx = reqtrace.TraceContext("f" * 32, "0" * 16, "tr-1", sampled=True)
+    r2 = engine.submit([5, 6, 7], max_new_tokens=2, trace=ctx)
+    assert r2.trace_ctx is ctx
+    engine.run_until_idle(max_iters=100)
+    assert r2.done
+    assert all(p["trace_id"] == "f" * 32 for p in r2.trace)
+    assert ServeEngine._tid(engine, r2) == {"trace_id": "f" * 32}
+    # The terminal transition flushed the replica half of the trace
+    # through flush_lifecycle (buffer drained on the context).
+    assert ctx.spans == []
+
+
 # ------------------------------------------------- engine decode contracts
 def test_unequal_requests_token_exact_and_never_recompile(
     engine, model_params

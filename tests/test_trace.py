@@ -1,0 +1,384 @@
+"""End-to-end request tracing (ISSUE 18), jax-free units: the W3C
+traceparent mint/parse roundtrip (disarmed and malformed fail closed),
+head-sampling vs tail escalation semantics, the single-O_APPEND
+torn-tail-safe span files, the cross-process assembly with its
+critical-path TTFT attribution (rerouted requests attribute across
+both replicas), the mergeable histograms' Prometheus-style exemplars
+(including legacy no-exemplar back-compat), and the
+``python -m tpuflow.obs trace`` CLI."""
+
+import json
+import os
+
+import pytest
+
+from tpuflow.obs import fleet as obs_fleet
+from tpuflow.obs import trace
+from tpuflow.obs.__main__ import main as obs_main
+
+
+# ---------------------------------------------------- context + headers
+def test_mint_parse_roundtrip():
+    ctx = trace.maybe_mint("req-1")
+    assert ctx is not None and ctx.sampled and ctx.recorded
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.root_id == ctx.span_id
+    header = ctx.to_traceparent()
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = trace.from_traceparent(header, "req-1")
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.sampled is True
+    # The replica hop parents its spans to the propagated span id.
+    assert back.root_id == ctx.span_id
+
+
+def test_disarmed_is_none_from_both_constructors(monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TRACE", "0")
+    assert trace.armed() is False
+    assert trace.maybe_mint("r") is None
+    good = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    assert trace.from_traceparent(good, "r") is None
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # wrong version
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span id
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+        "00-" + "a" * 32 + "-" + "b" * 16,  # no flags
+    ],
+)
+def test_malformed_traceparent_fails_closed(header):
+    assert trace.from_traceparent(header, "r") is None
+
+
+def test_header_is_case_and_whitespace_tolerant():
+    h = "  00-" + "A" * 32 + "-" + "B" * 16 + "-00  "
+    ctx = trace.from_traceparent(h, "r")
+    assert ctx is not None
+    assert ctx.trace_id == "a" * 32
+    assert ctx.sampled is False
+
+
+# ------------------------------------------------ sampling + escalation
+def test_head_sampling_zero_still_propagates(monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TRACE_SAMPLE", "0")
+    ctx = trace.maybe_mint("r")
+    assert ctx is not None  # propagates for downstream escalation
+    assert not ctx.sampled and not ctx.recorded
+    assert ctx.to_traceparent().endswith("-00")
+
+
+def test_escalation_forces_recording_and_dedups():
+    ctx = trace.TraceContext("a" * 32, "b" * 16, "r", sampled=False)
+    assert not ctx.recorded
+    ctx.escalate("reroute")
+    assert ctx.recorded and ctx.escalate_reason == "reroute"
+    assert ctx.to_traceparent().endswith("-01")
+    # First reason wins; repeats are silent.
+    ctx.escalate("error")
+    assert ctx.escalate_reason == "reroute"
+
+
+def test_unrecorded_flush_discards_silently(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TRACE_DIR", str(tmp_path))
+    ctx = trace.TraceContext("a" * 32, "b" * 16, "r", sampled=False)
+    ctx.add_span("router.queue", ts=1.0, dur_s=0.1)
+    assert trace.flush(ctx, writer="w") is True
+    assert ctx.spans == []  # buffer drained either way
+    assert trace.read_spans(str(tmp_path)) == []
+
+
+# ------------------------------------------------------- write + read
+def test_write_read_roundtrip_and_torn_tail(tmp_path):
+    d = str(tmp_path)
+    ctx = trace.TraceContext("a" * 32, "b" * 16, "req-7", sampled=True)
+    ctx.add_span("router.queue", ts=10.0, dur_s=0.5, attempt=0)
+    ctx.add_span(
+        "router.forward", ts=10.5, dur_s=1.0, attempt=0,
+        replica="rep-0", ok=True,
+    )
+    assert trace.write_spans(ctx.spans, writer="frontdoor", directory=d)
+    # A second writer interleaves whole spans into its own file.
+    ctx2 = trace.TraceContext("a" * 32, "c" * 16, "req-7", sampled=True)
+    ctx2.add_span("gateway.hold", ts=10.6, dur_s=0.9, status=200)
+    assert trace.write_spans(ctx2.spans, writer="rep/0", directory=d)
+    # writer ids sanitize into the filename.
+    assert os.path.exists(os.path.join(d, "trace-rep_0.jsonl"))
+    # Damage the trail: garbage line, non-span JSON, and a torn tail.
+    with open(os.path.join(d, "trace-frontdoor.jsonl"), "a") as f:
+        f.write("not json\n")
+        f.write(json.dumps({"no": "trace key"}) + "\n")
+        f.write('{"trace": "a", "name": "torn", "ts": 1')  # no newline
+    spans = trace.read_spans(d)
+    assert len(spans) == 3
+    assert {s["name"] for s in spans} == {
+        "router.queue", "router.forward", "gateway.hold",
+    }
+    assert all(s["writer"] in ("frontdoor", "rep/0") for s in spans)
+    assert trace.spans_for_request(d, "req-7") == spans
+    assert trace.spans_for_request(d, "other") == []
+    assert len(trace.spans_for_trace(d, "a" * 32)) == 3
+    # Missing dir reads as empty, never raises.
+    assert trace.read_spans(str(tmp_path / "nope")) == []
+
+
+def test_write_without_directory_counts_dropped(monkeypatch):
+    monkeypatch.delenv("TPUFLOW_TRACE_DIR", raising=False)
+    # No recorder configured in this process -> no trace dir.
+    assert trace.trace_dir() is None
+    ok = trace.write_spans(
+        [{"trace": "a", "name": "x", "ts": 1.0}], writer="w"
+    )
+    assert ok is False
+
+
+# ------------------------------------------------- lifecycle conversion
+def test_flush_lifecycle_converts_phases_to_spans(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TRACE_DIR", str(tmp_path))
+    import time as _time
+
+    t = _time.monotonic()
+    phases = [
+        {"phase": "submitted", "t": t},
+        {"phase": "queued", "t": t + 0.01, "reason": "slots"},
+        {"phase": "admitted", "t": t + 0.05, "bucket": 32},
+        {"phase": "first_token", "t": t + 0.20},
+        {"phase": "tick", "t": t + 0.25, "tokens": 4},
+        {"phase": "tick", "t": t + 0.30, "tokens": 4},
+        {"phase": "complete", "t": t + 0.30},
+    ]
+    ctx = trace.TraceContext("d" * 32, "e" * 16, "req-9", sampled=True)
+    assert trace.flush_lifecycle(
+        ctx, phases, engine_request=42, writer="rep-1"
+    )
+    by_name = {
+        s["name"]: s for s in trace.read_spans(str(tmp_path))
+    }
+    assert set(by_name) == {
+        "serve.queue", "serve.prefill", "serve.first_tick",
+        "serve.decode", "serve.lifecycle",
+    }
+    # Everything parents to the propagated forward-attempt span.
+    assert {s["parent"] for s in by_name.values()} == {"e" * 16}
+    assert by_name["serve.queue"]["reason"] == "slots"
+    assert by_name["serve.queue"]["dur_s"] == pytest.approx(0.05, abs=1e-6)
+    assert by_name["serve.prefill"]["bucket"] == 32
+    assert by_name["serve.prefill"]["dur_s"] == pytest.approx(0.15, abs=1e-6)
+    assert by_name["serve.first_tick"]["dur_s"] == pytest.approx(
+        0.05, abs=1e-6
+    )
+    assert by_name["serve.decode"]["ticks"] == 2
+    assert by_name["serve.decode"]["tokens"] == 8
+    assert by_name["serve.lifecycle"]["terminal"] == "complete"
+    assert by_name["serve.lifecycle"]["engine_request"] == 42
+    # Monotonic phase times landed as wall clock.
+    assert abs(by_name["serve.queue"]["ts"] - _time.time()) < 60.0
+
+
+def test_flush_lifecycle_empty_phases_is_false():
+    ctx = trace.TraceContext("d" * 32, "e" * 16, "r", sampled=True)
+    assert trace.flush_lifecycle(ctx, []) is False
+
+
+# --------------------------------------------- assembly + critical path
+def _reroute_spans():
+    """A synthetic rerouted request: queue -> failed forward on rep-0
+    (with backoff) -> queue -> rerouted forward on rep-1 -> gateway +
+    serve lifecycle on the winner."""
+    t = 1000.0
+    return [
+        {"trace": "t" * 32, "span": "s0", "parent": None,
+         "request": "req-3", "name": "router.ingress", "ts": t,
+         "dur_s": 1.0, "status": 200, "writer": "frontdoor"},
+        {"trace": "t" * 32, "span": "s1", "parent": "s0",
+         "request": "req-3", "name": "router.queue", "ts": t,
+         "dur_s": 0.05, "attempt": 0, "writer": "frontdoor"},
+        {"trace": "t" * 32, "span": "f0", "parent": "s0",
+         "request": "req-3", "name": "router.forward", "ts": t + 0.05,
+         "dur_s": 0.2, "attempt": 0, "replica": "rep-0", "ok": False,
+         "error": "connection refused", "backoff_s": 0.02,
+         "writer": "frontdoor"},
+        {"trace": "t" * 32, "span": "h0", "parent": "f0",
+         "request": "req-3", "name": "gateway.hold", "ts": t + 0.06,
+         "dur_s": 0.1, "status": 503, "writer": "rep-0"},
+        {"trace": "t" * 32, "span": "s2", "parent": "s0",
+         "request": "req-3", "name": "router.queue", "ts": t + 0.27,
+         "dur_s": 0.03, "attempt": 1, "writer": "frontdoor"},
+        {"trace": "t" * 32, "span": "f1", "parent": "f0",
+         "request": "req-3", "name": "router.forward", "ts": t + 0.30,
+         "dur_s": 0.7, "attempt": 1, "replica": "rep-1", "ok": True,
+         "reroute": True, "writer": "frontdoor"},
+        {"trace": "t" * 32, "span": "h1", "parent": "f1",
+         "request": "req-3", "name": "gateway.hold", "ts": t + 0.31,
+         "dur_s": 0.68, "status": 200, "writer": "rep-1"},
+        {"trace": "t" * 32, "span": "q1", "parent": "f1",
+         "request": "req-3", "name": "serve.queue", "ts": t + 0.32,
+         "dur_s": 0.08, "writer": "rep-1"},
+        {"trace": "t" * 32, "span": "p1", "parent": "f1",
+         "request": "req-3", "name": "serve.prefill", "ts": t + 0.40,
+         "dur_s": 0.3, "writer": "rep-1"},
+        {"trace": "t" * 32, "span": "k1", "parent": "f1",
+         "request": "req-3", "name": "serve.first_tick", "ts": t + 0.70,
+         "dur_s": 0.1, "writer": "rep-1"},
+        {"trace": "t" * 32, "span": "d1", "parent": "f1",
+         "request": "req-3", "name": "serve.decode", "ts": t + 0.70,
+         "dur_s": 0.28, "ticks": 3, "writer": "rep-1"},
+    ]
+
+
+def test_assemble_reroute_critical_path_and_ttft():
+    a = trace.assemble(_reroute_spans())
+    assert a is not None
+    assert a["request"] == "req-3" and a["trace"] == "t" * 32
+    assert a["rerouted"] is True
+    assert a["writers"] == ["frontdoor", "rep-0", "rep-1"]
+    # The ingress span IS the client-observed wall.
+    assert a["wall_s"] == pytest.approx(1.0)
+    segs = [s["segment"] for s in a["critical_path"]]
+    assert segs == [
+        "router_queue", "forward_failed", "reroute", "replica_queue",
+        "prefill", "first_decode_tick", "decode",
+    ]
+    reroute = next(
+        s for s in a["critical_path"] if s["segment"] == "reroute"
+    )
+    assert reroute["from"] == "rep-0" and reroute["to"] == "rep-1"
+    assert reroute["attempt"] == 1
+    b = a["ttft_breakdown"]
+    assert b["router_queue_s"] == pytest.approx(0.08)
+    assert b["forward_failed_s"] == pytest.approx(0.2)
+    assert b["backoff_s"] == pytest.approx(0.02)
+    assert b["replica_queue_s"] == pytest.approx(0.08)
+    assert b["prefill_s"] == pytest.approx(0.3)
+    assert b["first_tick_s"] == pytest.approx(0.1)
+    assert a["ttft_s"] == pytest.approx(sum(b.values()))
+    # The human rendering names the reroute and the attribution.
+    lines = trace.format_timeline(a)
+    joined = "\n".join(lines)
+    assert "[REROUTED]" in joined
+    assert "reroute: rep-0 -> rep-1" in joined
+    assert "router_queue" in joined and "prefill" in joined
+
+
+def test_assemble_empty_and_unrerouted():
+    assert trace.assemble([]) is None
+    # A clean single-replica request never reads rerouted.
+    clean = [
+        {"trace": "x" * 32, "span": "s1", "request": "r",
+         "name": "router.queue", "ts": 1.0, "dur_s": 0.1,
+         "writer": "frontdoor"},
+        {"trace": "x" * 32, "span": "f1", "request": "r",
+         "name": "router.forward", "ts": 1.1, "dur_s": 0.5,
+         "attempt": 0, "replica": "rep-0", "ok": True,
+         "writer": "frontdoor"},
+    ]
+    a = trace.assemble(clean)
+    assert a is not None and a["rerouted"] is False
+    # No ingress span: the wall falls back to the span envelope.
+    assert a["wall_s"] == pytest.approx(0.6)
+
+
+# ----------------------------------------------------------- exemplars
+def test_histogram_exemplars_observe_to_dict_merge():
+    h = obs_fleet.MergeableHistogram(edges=(0.1, 1.0))
+    h.observe(0.05)  # no exemplar
+    assert "exemplars" not in h.to_dict()  # untraced shape unchanged
+    h.observe(0.06, exemplar="traceA")
+    h.observe(0.5, exemplar="traceB")
+    d = h.to_dict()
+    assert d["exemplars"] == ["traceA", "traceB", None]
+    # Later observation wins the bucket.
+    h.observe(0.07, exemplar="traceC")
+    d = h.to_dict()
+    assert d["exemplars"][0] == "traceC"
+
+    # Merge carries exemplars; a legacy dict without them degrades.
+    legacy = obs_fleet.MergeableHistogram(edges=(0.1, 1.0))
+    legacy.observe(0.08)
+    ld = legacy.to_dict()
+    assert "exemplars" not in ld
+    m = obs_fleet.merge_hists([ld, d])
+    assert m is not None
+    assert m["counts"] == [4, 1, 0]  # 3 traced + 1 legacy low-bucket
+    assert m["exemplars"] == ["traceC", "traceB", None]
+    # Legacy-only merges stay exemplar-free.
+    m2 = obs_fleet.merge_hists([ld, ld])
+    assert m2 is not None and "exemplars" not in m2
+
+
+def test_hist_exemplar_rank_walk_and_guards():
+    h = obs_fleet.MergeableHistogram(edges=(0.1, 1.0, 5.0))
+    for _ in range(98):
+        h.observe(0.05, exemplar="fast")
+    h.observe(0.5, exemplar="mid")
+    h.observe(4.0, exemplar="slow")
+    d = h.to_dict()
+    # Same nearest-rank walk as hist_pctl: rank 98 of 100 obs is the
+    # 0.5s observation, rank 99 the 4.0s one.
+    assert obs_fleet.hist_exemplar(d, 0.5) == "fast"
+    assert obs_fleet.hist_exemplar(d, 0.99) == "mid"
+    assert obs_fleet.hist_exemplar(d, 1.0) == "slow"
+    # Guards: empty, absent exemplars, malformed shape.
+    assert obs_fleet.hist_exemplar(None, 0.99) is None
+    assert obs_fleet.hist_exemplar({}, 0.99) is None
+    legacy = {"edges": [0.1], "counts": [1, 0], "count": 1, "sum": 0.05}
+    assert obs_fleet.hist_exemplar(legacy, 0.99) is None
+    bad = dict(d)
+    bad["exemplars"] = ["only-one"]
+    assert obs_fleet.hist_exemplar(bad, 0.99) is None
+
+
+def test_ledger_ttft_exemplar_rides_snapshot():
+    from tpuflow.obs.goodput import ProcessLedger
+
+    led = ProcessLedger()
+    led.note_serve_state(0, 0, 4)  # arms the serve section of /status
+    led.note_serve_ttft(0.2, trace_id="t-1")
+    led.note_serve_ttft(0.3)  # untraced observation: no exemplar
+    snap = led.snapshot()
+    hist = snap["serve_ttft_hist"]
+    assert obs_fleet.hist_exemplar(hist, 0.0) is not None
+
+
+# ----------------------------------------------------------------- CLI
+def test_obs_trace_cli(tmp_path, capsys, monkeypatch):
+    d = str(tmp_path / "trace")
+    os.makedirs(d)
+    spans = _reroute_spans()
+    assert trace.write_spans(
+        [s for s in spans if s["writer"] == "frontdoor"],
+        writer="frontdoor", directory=d,
+    )
+    assert trace.write_spans(
+        [s for s in spans if s["writer"] != "frontdoor"],
+        writer="reps", directory=d,
+    )
+    # Explicit dir (also resolves run-dir parents holding trace/).
+    assert obs_main(["trace", "req-3", d]) == 0
+    out = capsys.readouterr().out
+    assert "[REROUTED]" in out and "reroute: rep-0 -> rep-1" in out
+    assert obs_main(["trace", "req-3", str(tmp_path)]) == 0
+    capsys.readouterr()
+    # --json round-trips the assembled structure.
+    assert obs_main(["trace", "req-3", d, "--json"]) == 0
+    a = json.loads(capsys.readouterr().out)
+    assert a["rerouted"] is True and len(a["spans"]) == len(spans)
+    # TPUFLOW_TRACE_DIR resolves when no dir is given.
+    monkeypatch.setenv("TPUFLOW_TRACE_DIR", d)
+    assert obs_main(["trace", "req-3"]) == 0
+    capsys.readouterr()
+    # Unknown request: explicit failure, not a crash.
+    assert obs_main(["trace", "nope", d]) == 1
+    assert "no spans" in capsys.readouterr().err
+    # No dir anywhere: usage-grade error.
+    monkeypatch.delenv("TPUFLOW_TRACE_DIR")
+    assert obs_main(["trace", "req-3"]) == 2
+    # Missing request id entirely -> usage.
+    assert obs_main(["trace"]) == 2

@@ -364,6 +364,7 @@ def _overlap_vs_sequential(accum: int, steps: int = 2) -> None:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_comm_overlap_scan_matches_sequential():
     """The structurally interesting depth (a real scan + per-microbatch
     reduce-scatter) stays in tier 1; accum 1 and 4 ride the slow twin —

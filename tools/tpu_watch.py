@@ -322,6 +322,20 @@ def fleet(target: str | None, interval: float, max_s: float) -> int:
             )
             for row in snap["replicas"]:
                 print(fleet_mod.format_replica_line(row), flush=True)
+            # End-to-end tracing (ISSUE 18): when the merged fleet TTFT
+            # histogram carries exemplars, name the concrete trace
+            # behind the p99 bucket — `python -m tpuflow.obs trace`
+            # turns it into the per-hop breakdown.
+            ex = fleet_mod.hist_exemplar(
+                snap["fleet"].get("ttft_hist"), 0.99
+            )
+            if ex is not None:
+                print(
+                    f"[tpu_watch {stamp}] ttft p99 exemplar: trace "
+                    f"{ex} (python -m tpuflow.obs trace <request_id> "
+                    "resolves it)",
+                    flush=True,
+                )
             for t in eng.observe(fleet=snap["fleet"]):
                 print(
                     f"[tpu_watch {stamp}] "

@@ -51,6 +51,24 @@ def _requeue_pod_failure_policy() -> dict:
         ]
     }
 
+def _trace_env() -> list[dict]:
+    """End-to-end tracing (ISSUE 18): the trace knobs set at render
+    time ride the pod env so the front door (which mints the context)
+    and every replica share one sampling policy and — on shared
+    storage — one span directory. Literal accessor names on purpose:
+    tpulint's declared-name pass checks them statically."""
+    pairs = (
+        ("TPUFLOW_TRACE", knobs.raw("TPUFLOW_TRACE")),
+        ("TPUFLOW_TRACE_SAMPLE", knobs.raw("TPUFLOW_TRACE_SAMPLE")),
+        ("TPUFLOW_TRACE_DIR", knobs.raw("TPUFLOW_TRACE_DIR")),
+    )
+    return [
+        {"name": tk, "value": str(tv)}
+        for tk, tv in pairs
+        if tv is not None
+    ]
+
+
 # chips per host and default 2-D ICI topology per v5e/v6e slice size; v4/v5p
 # use 4-chip hosts with 3-D topologies (coarse entries for the common ones).
 _TPU_SLICES: dict[str, dict[int, str]] = {
@@ -399,6 +417,7 @@ def serving_deployment(
                 "value": str(float(slo_itl_ms)),
             }
         )
+    penv.extend(_trace_env())
     for k, v in sorted((env or {}).items()):
         penv.append({"name": str(k), "value": str(v)})
     container = {
@@ -564,6 +583,7 @@ def router_deployment(
         )
     if autoscale:
         penv.append({"name": "TPUFLOW_ROUTER_AUTOSCALE", "value": "1"})
+    penv.extend(_trace_env())
     for k, v in sorted((env or {}).items()):
         penv.append({"name": str(k), "value": str(v)})
     container = {

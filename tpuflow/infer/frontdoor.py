@@ -41,6 +41,7 @@ from urllib import request as urlrequest
 import numpy as np
 
 from tpuflow.infer.router import FleetBusy, Router
+from tpuflow.obs import trace as _reqtrace
 from tpuflow.utils import knobs
 
 _RESULT_CACHE_MAX = 2048
@@ -119,7 +120,10 @@ class ReplicaGateway:
                     _send_json(self, 400, {"error": "bad json"})
                     return
                 try:
-                    code, payload = gateway.handle_generate(body)
+                    code, payload = gateway.handle_generate(
+                        body,
+                        traceparent=self.headers.get("traceparent"),
+                    )
                 except Exception as e:  # noqa: BLE001 — a raised
                     # forward is "try another replica" to the router;
                     # an explicit 500 beats a severed connection.
@@ -144,8 +148,37 @@ class ReplicaGateway:
         self.url = f"http://{h}:{p}/generate"
 
     # ------------------------------------------------------- handling
-    def handle_generate(self, body: dict) -> tuple[int, dict]:
+    def handle_generate(
+        self, body: dict, traceparent: str | None = None
+    ) -> tuple[int, dict]:
+        """Replica hop of the end-to-end trace (ISSUE 18): rebuild the
+        context from the propagated ``traceparent`` header, record the
+        gateway hold (and any error outcome) as spans parented to the
+        forward attempt that carried the request, and flush to this
+        replica's trace JSONL. Untraced requests skip all of it on one
+        ``is not None`` check."""
         rid = str(body.get("id") or "")
+        ctx = _reqtrace.from_traceparent(traceparent, rid)
+        t0 = time.time()
+        code, payload = self._handle_generate(body, rid, ctx)
+        if ctx is not None:
+            if code != 200:
+                # Tail sampling: killed / draining / hold-timeout /
+                # malformed outcomes always record.
+                ctx.escalate("error")
+            ctx.add_span(
+                "gateway.hold",
+                ts=t0,
+                dur_s=time.time() - t0,
+                parent=ctx.root_id,
+                status=code,
+            )
+            _reqtrace.flush(ctx)
+        return code, payload
+
+    def _handle_generate(
+        self, body: dict, rid: str, ctx: Any = None
+    ) -> tuple[int, dict]:
         prompt = body.get("prompt")
         if not rid or not isinstance(prompt, list) or not prompt:
             return 400, {"error": "need id and non-empty prompt"}
@@ -154,6 +187,15 @@ class ReplicaGateway:
             if done is not None:
                 return 200, dict(done)  # idempotent replay
             handle = self._handles.get(rid)
+            if handle is not None and ctx is not None:
+                # Dedupe-attach: a router re-dispatch raced the slow
+                # original — the span marks which attempt attached.
+                ctx.add_span(
+                    "gateway.attach",
+                    ts=time.time(),
+                    parent=ctx.root_id,
+                    attached=True,
+                )
             if handle is None:
                 if self.aborted:
                     return 503, {"error": "killed"}
@@ -161,12 +203,16 @@ class ReplicaGateway:
                     return 503, {"error": "draining"}
                 eos = body.get("eos_id")
                 try:
+                    # trace= rides only for traced requests so fake
+                    # engines without the kwarg keep working untraced.
+                    kw = {} if ctx is None else {"trace": ctx}
                     handle = self.engine.submit(
                         np.asarray(prompt, np.int32),
                         max_new_tokens=int(
                             body.get("max_new_tokens") or 1
                         ),
                         eos_id=None if eos is None else int(eos),
+                        **kw,
                     )
                 except (TypeError, ValueError) as e:
                     # TypeError covers non-castable fields (a list
@@ -268,11 +314,21 @@ def http_forward(row: dict, request: dict, timeout_s: float) -> dict:
         raise RuntimeError(
             f"replica {row.get('id')!r} exports no generate_url"
         )
-    data = json.dumps(request).encode("utf-8")
+    # The in-process TraceContext never rides the wire: strip it from
+    # the body and propagate as a W3C traceparent header, whose span id
+    # the Router set to THIS forward attempt's span.
+    ctx = request.get("_trace_ctx")
+    headers = {"Content-Type": "application/json"}
+    if ctx is None:
+        payload = request
+    else:
+        payload = {
+            k: v for k, v in request.items() if k != "_trace_ctx"
+        }
+        headers["traceparent"] = ctx.to_traceparent()
+    data = json.dumps(payload).encode("utf-8")
     req = urlrequest.Request(
-        url, data=data,
-        headers={"Content-Type": "application/json"},
-        method="POST",
+        url, data=data, headers=headers, method="POST",
     )
     try:
         with urlrequest.urlopen(req, timeout=timeout_s) as resp:
@@ -321,24 +377,42 @@ class FrontDoor:
                 if body is None:
                     _send_json(self, 400, {"error": "bad json"})
                     return
+                # End-to-end tracing (ISSUE 18): mint the trace at
+                # ingress; the context rides the body in-process (the
+                # forwarder strips it and speaks traceparent on the
+                # wire) and the ingress span is the client-observed
+                # wall the critical path reconciles against.
+                ctx = _reqtrace.maybe_mint(body.get("id"))
+                if ctx is not None:
+                    body["_trace_ctx"] = ctx
+                t0 = time.time()
                 try:
-                    resp = door.router.route(body)
+                    code, out = 200, door.router.route(body)
                 except FleetBusy as e:
-                    _send_json(self, 503, {"error": str(e)})
-                    return
+                    code, out = 503, {"error": str(e)}
                 except (TypeError, ValueError) as e:
-                    _send_json(self, 400, {"error": str(e)})
-                    return
+                    if ctx is not None:
+                        ctx.escalate("error")
+                    code, out = 400, {"error": str(e)}
                 except Exception as e:  # noqa: BLE001 — the "every
                     # request ends answered or told" contract: an
                     # unexpected failure is a 500 JSON answer, never a
                     # severed connection.
-                    _send_json(
-                        self, 500,
-                        {"error": f"{type(e).__name__}: {e}"},
+                    if ctx is not None:
+                        ctx.escalate("error")
+                    code, out = 500, {
+                        "error": f"{type(e).__name__}: {e}"
+                    }
+                if ctx is not None:
+                    ctx.add_span(
+                        "router.ingress",
+                        span_id=ctx.root_id,
+                        ts=t0,
+                        dur_s=time.time() - t0,
+                        status=code,
                     )
-                    return
-                _send_json(self, 200, resp)
+                    _reqtrace.flush(ctx, writer="frontdoor")
+                _send_json(self, code, out)
 
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path == "/status":

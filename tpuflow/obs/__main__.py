@@ -1,6 +1,6 @@
 """Operator CLI: ``python -m tpuflow.obs <command> [target] [--json]``.
 
-Seven commands, all jax-free and safe against a LIVE run from a login
+Eight commands, all jax-free and safe against a LIVE run from a login
 shell:
 
 - ``summarize <run_dir>`` — the run's merged telemetry (the committed
@@ -32,6 +32,14 @@ shell:
   reads "absent", never an error.
 - ``registry-backfill [<dir>]`` — one-shot idempotent import of the
   driver's ``BENCH_r*.json`` captures into the registry.
+- ``trace <request_id> [<dir> ...]`` — end-to-end tracing (ISSUE 18):
+  assemble one request's cross-process spans (FrontDoor ingress →
+  router forward attempts → replica gateway → engine lifecycle) from
+  the given trace directories (a trace dir itself, a run dir holding
+  ``trace/`` or ``obs/trace/``, or — no dirs given —
+  ``TPUFLOW_TRACE_DIR``) into one merged timeline with the
+  critical-path TTFT breakdown; rerouted requests attribute across
+  both replicas.
 
 The registry commands resolve the registry file from
 ``TPUFLOW_REGISTRY_PATH`` (override per-call with
@@ -63,7 +71,9 @@ _USAGE = (
     "       python -m tpuflow.obs compare <runA> <runB> "
     "[--registry=PATH] [--json]\n"
     "       python -m tpuflow.obs registry-backfill [<bench_dir>] "
-    "[--registry=PATH]"
+    "[--registry=PATH]\n"
+    "       python -m tpuflow.obs trace <request_id> [<dir> ...] "
+    "[--json]"
 )
 
 
@@ -277,6 +287,70 @@ def _fleet_summary(target: str | None, as_json: bool) -> int:
     return 0
 
 
+def _trace_cmd(
+    request_id: str, targets: list[str], as_json: bool
+) -> int:
+    """Assemble one request's cross-process trace (ISSUE 18). Each
+    target may be the trace dir itself or a parent holding ``trace/``
+    or ``obs/trace/``; with no targets, ``TPUFLOW_TRACE_DIR`` resolves
+    one. Spans from every dir merge into one timeline."""
+    from tpuflow.obs import trace as tracemod
+    from tpuflow.utils import knobs
+
+    dirs = list(targets)
+    if not dirs:
+        d = knobs.raw("TPUFLOW_TRACE_DIR")
+        if d:
+            dirs.append(d)
+    if not dirs:
+        print(
+            "no trace directory — pass one or more dirs (the trace "
+            "dir, or a run dir holding trace/ or obs/trace/) or set "
+            "TPUFLOW_TRACE_DIR",
+            file=sys.stderr,
+        )
+        return 2
+    spans: list[dict] = []
+    seen: set[tuple] = set()
+    scanned: list[str] = []
+    for d in dirs:
+        for cand in (
+            d,
+            os.path.join(d, "trace"),
+            os.path.join(d, "obs", "trace"),
+        ):
+            if not os.path.isdir(cand):
+                continue
+            scanned.append(cand)
+            for s in tracemod.spans_for_request(cand, request_id):
+                key = (
+                    s.get("trace"), s.get("span"), s.get("name"),
+                    s.get("ts"), s.get("writer"),
+                )
+                if key in seen:
+                    continue
+                seen.add(key)
+                spans.append(s)
+    assembled = tracemod.assemble(spans)
+    if assembled is None:
+        print(
+            f"no spans for request {request_id!r} under "
+            f"{', '.join(scanned) or ', '.join(dirs)} (unsampled and "
+            "never escalated, or the trace dir is wrong)",
+            file=sys.stderr,
+        )
+        return 1
+    if as_json:
+        json.dump(
+            assembled, sys.stdout, indent=2, sort_keys=True, default=str
+        )
+        print()
+        return 0
+    for line in tracemod.format_timeline(assembled):
+        print(line)
+    return 0
+
+
 def _find_record(records: list[dict], token: str) -> dict | None:
     """The newest record whose run_id matches ``token`` exactly, else
     the newest run-id *prefix* match (so ``bench-17...`` abbreviates)."""
@@ -401,6 +475,13 @@ def _registry_cli(argv: list[str]) -> int:
 def main(argv: list[str]) -> int:
     if argv and argv[0] in ("trend", "compare", "registry-backfill"):
         return _registry_cli(argv)
+    if argv and argv[0] == "trace":
+        args = [a for a in argv[1:] if not a.startswith("-")]
+        flags = {a for a in argv[1:] if a.startswith("-")}
+        if flags - {"--json"} or not args:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        return _trace_cmd(args[0], args[1:], "--json" in flags)
     args = [a for a in argv if not a.startswith("-")]
     flags = {a for a in argv if a.startswith("-")}
     commands = (

@@ -23,6 +23,13 @@ aggregation — the "actuator" side of the PR 13–15 sensors:
   ``TPUFLOW_ALERT_REROUTE_RATE``. Occasional reroutes are the router
   doing its job; a sustained rate means replicas are dying or
   stalling faster than the fleet absorbs.
+- ``ttft_router_dominance`` — (ISSUE 18) the router-side admission
+  wait per completed request over the fast window (the cumulative
+  ``router_wait_s`` / ``router_requests`` counters through the same
+  ``window_rate`` construction) exceeded
+  ``TPUFLOW_ALERT_ROUTER_TTFT_FRAC`` of the fleet TTFT p95: the fleet
+  is slow because requests sit in the ROUTER, not in the replicas —
+  the exact split ``python -m tpuflow.obs trace`` shows per request.
 
 Lifecycle: a rule entering its firing condition emits ONE
 ``alert.fired`` event (severity + runbook anchor + message); while it
@@ -92,6 +99,12 @@ RULES: tuple[Rule, ...] = (
         "front-door reroute rate over the fast window past the "
         "threshold — replicas dying/stalling faster than the fleet "
         "absorbs",
+    ),
+    Rule(
+        "ttft_router_dominance", "ticket", "distributed-tracing-runbook",
+        "router-side wait per request over the fast window exceeds the "
+        "knob-set fraction of fleet TTFT p95 — latency lives in the "
+        "router, not the replicas",
     ),
 )
 
@@ -163,6 +176,7 @@ class AlertEngine:
         min_health: float | None = None,
         cooldown_s: float | None = None,
         reroute_rate: float | None = None,
+        router_ttft_frac: float | None = None,
     ):
         self.rules = {r.name: r for r in rules}
         self._clock = clock
@@ -182,6 +196,10 @@ class AlertEngine:
             cooldown_s = knobs.get_float("TPUFLOW_ALERT_COOLDOWN_S")
         if reroute_rate is None:
             reroute_rate = knobs.get_float("TPUFLOW_ALERT_REROUTE_RATE")
+        if router_ttft_frac is None:
+            router_ttft_frac = knobs.get_float(
+                "TPUFLOW_ALERT_ROUTER_TTFT_FRAC"
+            )
         self.slo_budget = float(slo_budget)
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
@@ -190,10 +208,17 @@ class AlertEngine:
         self.min_health = float(min_health)
         self.cooldown_s = float(cooldown_s)
         self.reroute_rate = float(reroute_rate)
+        self.router_ttft_frac = float(router_ttft_frac)
         self._samples: deque[tuple[float, float, float]] = deque()
         # (ts, router_requests, router_reroutes) — same cumulative-
         # counter shape as _samples, so window_rate() applies verbatim.
         self._router_samples: deque[tuple[float, float, float]] = deque()
+        # (ts, router_requests, router_wait_s): window_rate() over it
+        # is mean router-side wait per completed request — the
+        # ttft_router_dominance numerator.
+        self._router_wait_samples: deque[
+            tuple[float, float, float]
+        ] = deque()
         self._active: dict[str, dict[str, Any]] = {}
         self._lock = threading.Lock()
 
@@ -229,6 +254,19 @@ class AlertEngine:
                 and self._router_samples[0][0] < cut
             ):
                 self._router_samples.popleft()
+        rw = status.get("router_wait_s") if status is not None else None
+        if isinstance(rq, (int, float)) and isinstance(
+            rw, (int, float)
+        ):
+            self._router_wait_samples.append(
+                (now, float(rq), float(rw))
+            )
+            cut = now - max(self.fast_window_s, self.slow_window_s)
+            while (
+                self._router_wait_samples
+                and self._router_wait_samples[0][0] < cut
+            ):
+                self._router_wait_samples.popleft()
 
     def _evaluate(
         self, now: float, status: dict | None, fleet: dict | None
@@ -305,6 +343,35 @@ class AlertEngine:
                 f"threshold — replicas dying/stalling faster than "
                 f"the fleet absorbs",
                 round(rrate, 4),
+            )
+        # Mean router-side wait per completed request vs the fleet TTFT
+        # p95: when the router's share crosses the knob-set fraction,
+        # the latency lives in admission/queueing, not the replicas.
+        wrate = window_rate(
+            list(self._router_wait_samples), now, self.fast_window_s
+        )
+        p95 = None
+        if fleet is not None and isinstance(fleet.get("ttft"), dict):
+            p = fleet["ttft"].get("p95")
+            if (
+                isinstance(p, (int, float))
+                and p > 0
+                and p != float("inf")
+            ):
+                p95 = float(p)
+        if (
+            wrate is not None
+            and p95 is not None
+            and self.router_ttft_frac > 0
+            and wrate > self.router_ttft_frac * p95
+        ):
+            firing["ttft_router_dominance"] = (
+                f"router-side wait {wrate:.4f}s/request over the fast "
+                f"window exceeds {self.router_ttft_frac:.3g}x the "
+                f"fleet TTFT p95 ({p95:.4f}s) — latency lives in the "
+                f"router; pull a trace: python -m tpuflow.obs trace "
+                f"<request_id>",
+                round(wrate, 6),
             )
         return {k: v for k, v in firing.items() if k in self.rules}
 

@@ -401,6 +401,31 @@ CATALOG: dict[str, tuple[str, str]] = {
         "over routable replicas minus pages the router has charged to "
         "in-flight requests",
     ),
+    # --------------------------------------------------------------- trace
+    "trace.escalate": (
+        "event",
+        "tail-sampling override: a head-unsampled trace force-recorded "
+        "by an SLO breach, reroute, forward error, or queue timeout "
+        "(trace id, request id, first reason) — the tail is never lost "
+        "to the sampler",
+    ),
+    "trace.flush": (
+        "event",
+        "one recorded trace context drained its span buffer to the "
+        "writer's trace JSONL (trace id, request id, span count, "
+        "writer, escalation reason if any)",
+    ),
+    "trace.spans": (
+        "counter",
+        "spans appended to this process's trace-<writer>.jsonl (one "
+        "O_APPEND write per flush — torn-tail-safe like the registry)",
+    ),
+    "trace.dropped": (
+        "counter",
+        "spans discarded because no trace directory resolves "
+        "(TPUFLOW_TRACE_DIR unset and telemetry off) or the append "
+        "failed — tracing never raises into the serving path",
+    ),
     # --------------------------------------------------------------- quant
     "quant.decision": (
         "event",

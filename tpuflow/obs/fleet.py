@@ -116,7 +116,7 @@ class MergeableHistogram:
     internal counts stay per-bucket so merges and tests are plain
     integer sums."""
 
-    __slots__ = ("edges", "counts", "count", "sum")
+    __slots__ = ("edges", "counts", "count", "sum", "exemplars")
 
     def __init__(self, edges: Iterable[float] | None = None):
         self.edges: tuple[float, ...] = tuple(
@@ -125,19 +125,30 @@ class MergeableHistogram:
         self.counts: list[int] = [0] * (len(self.edges) + 1)
         self.count = 0
         self.sum = 0.0
+        # Prometheus-style exemplars: each bucket remembers the LAST
+        # trace id observed into it, so a fleet percentile resolves to
+        # a concrete `obs trace` timeline. None entries cost nothing
+        # and to_dict omits the key entirely until one is set.
+        self.exemplars: list[str | None] = [None] * (len(self.edges) + 1)
 
-    def observe(self, v: float) -> None:
-        self.counts[bisect.bisect_left(self.edges, float(v))] += 1
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        i = bisect.bisect_left(self.edges, float(v))
+        self.counts[i] += 1
         self.count += 1
         self.sum += float(v)
+        if exemplar is not None:
+            self.exemplars[i] = str(exemplar)
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "edges": list(self.edges),
             "counts": list(self.counts),
             "count": self.count,
             "sum": round(self.sum, 9),
         }
+        if any(e is not None for e in self.exemplars):
+            out["exemplars"] = list(self.exemplars)
+        return out
 
     def cumulative(self) -> list[int]:
         """Prometheus ``le`` counts: cumulative per-bucket counts, the
@@ -166,6 +177,9 @@ def merge_hists(hists: Iterable[dict]) -> dict[str, Any] | None:
         except (TypeError, KeyError, ValueError):
             skipped += 1
             continue
+        ex = h.get("exemplars")
+        if not (isinstance(ex, list) and len(ex) == len(counts)):
+            ex = None  # absent/malformed exemplars degrade, never skip
         if merged is None:
             merged = {
                 "edges": edges,
@@ -173,12 +187,19 @@ def merge_hists(hists: Iterable[dict]) -> dict[str, Any] | None:
                 "count": int(h.get("count", sum(counts))),
                 "sum": float(h.get("sum", 0.0)),
             }
+            if ex is not None:
+                merged["exemplars"] = list(ex)
         elif edges == merged["edges"]:
             merged["counts"] = [
                 a + b for a, b in zip(merged["counts"], counts)
             ]
             merged["count"] += int(h.get("count", sum(counts)))
             merged["sum"] += float(h.get("sum", 0.0))
+            if ex is not None:
+                prev = merged.get("exemplars") or [None] * len(counts)
+                merged["exemplars"] = [
+                    b if b is not None else a for a, b in zip(prev, ex)
+                ]
         else:
             skipped += 1
     if merged is not None and skipped:
@@ -218,6 +239,30 @@ def hist_percentiles(h: dict | None) -> dict[str, float] | None:
         "p95": hist_pctl(edges, counts, 0.95),
         "p99": hist_pctl(edges, counts, 0.99),
     }
+
+
+def hist_exemplar(h: dict | None, q: float) -> str | None:
+    """The trace-id exemplar for the bucket holding the rank-``q``
+    observation (same rank walk as :func:`hist_pctl`), so "fleet p99
+    TTFT regressed" resolves to a concrete ``obs trace`` timeline.
+    None when the histogram is empty, carries no exemplars, or the
+    target bucket never recorded one."""
+    if not h or not h.get("count"):
+        return None
+    ex = h.get("exemplars")
+    counts = h.get("counts") or []
+    if not (isinstance(ex, list) and len(ex) == len(counts)):
+        return None
+    n = sum(counts)
+    if n <= 0:
+        return None
+    rank = min(n - 1, int(q * (n - 1) + 0.5))
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc > rank:
+            return ex[i] if isinstance(ex[i], str) else None
+    return None
 
 
 # ------------------------------------------------------ replica identity

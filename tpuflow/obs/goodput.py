@@ -443,10 +443,12 @@ class ProcessLedger:
             self.serve_tokens += int(n)
         self._serve_recent.append((time.monotonic(), self.serve_tokens))
 
-    def note_serve_ttft(self, ttft_s: float | None) -> None:
+    def note_serve_ttft(
+        self, ttft_s: float | None, trace_id: str | None = None
+    ) -> None:
         if isinstance(ttft_s, (int, float)):
             self._serve_ttfts.append(float(ttft_s))
-            self._serve_ttft_hist.observe(float(ttft_s))
+            self._serve_ttft_hist.observe(float(ttft_s), exemplar=trace_id)
 
     def note_serve_complete(self, group: str | None = None) -> None:
         self.serve_requests += 1
@@ -484,12 +486,14 @@ class ProcessLedger:
         self.serve_spec_committed = int(committed)
         self.serve_spec_forwards = int(forwards)
 
-    def note_serve_itl(self, itl_s: float | None) -> None:
+    def note_serve_itl(
+        self, itl_s: float | None, trace_id: str | None = None
+    ) -> None:
         """One decode tick's per-token latency observation (tick wall /
         tokens committed) for the live ITL percentiles."""
         if isinstance(itl_s, (int, float)):
             self._serve_itls.append(float(itl_s))
-            self._serve_itl_hist.observe(float(itl_s))
+            self._serve_itl_hist.observe(float(itl_s), exemplar=trace_id)
 
     def note_serve_ledger(
         self,

@@ -70,6 +70,12 @@ _DIGEST_PATHS: tuple[tuple[str, tuple[str, ...]], ...] = (
     # exit-6 gate input
     ("paged_vs_slot", ("serving_paged", "vs_slot")),
     ("paged_tokens_per_s", ("serving_paged", "tokens_per_s")),
+    # ISSUE 18: front-door router headline (absent on pre-router
+    # records — trend/compare degrade to "metric absent" by the same
+    # guarded walk as every other path here). router_dropped must be 0.
+    ("router_requests", ("serving_router", "router_requests")),
+    ("router_reroutes", ("serving_router", "router_reroutes")),
+    ("router_dropped", ("serving_router", "router_dropped")),
     # exit-4 gate inputs
     ("int8_weight_only_speedup", ("int8_weight_only", "speedup")),
     ("int8_fused_native_speedup", ("int8_fused_native", "speedup")),
@@ -84,7 +90,7 @@ _DIGEST_PATHS: tuple[tuple[str, tuple[str, ...]], ...] = (
 # higher-is-better (throughputs, speedups, fractions-of-peak).
 _LOWER_IS_BETTER_TOKENS = (
     "ttft", "itl", "exposed_comm", "hbm_peak", "hbm_used", "slo_",
-    "compile_s",
+    "compile_s", "dropped", "reroute",
 )
 
 
@@ -293,6 +299,10 @@ def snapshot_metrics(snap: dict) -> dict[str, float]:
         "hbm_peak_frac", "hbm_used_frac", "serve_tokens_per_s",
         "serve_requests", "serve_slo_violations", "serve_queue_depth",
         "nonfinite_steps",
+        # ISSUE 18: router headline keys (present only when the
+        # snapshot IS a front-door /status — legacy and replica
+        # snapshots simply lack them).
+        "router_requests", "router_reroutes", "router_dropped",
     ):
         v = _num(snap.get(key))
         if v is not None:

@@ -101,8 +101,19 @@ class LocalReplica:
     # -------------------------------------------------------- plumbing
     def _completed(self, handle: Any) -> None:
         """Feed the private ledger per finished request: the TTFT
-        histogram is what makes the fleet's MERGED p99 exist."""
-        self._ledger.note_serve_ttft(getattr(handle, "ttft_s", None))
+        histogram is what makes the fleet's MERGED p99 exist. Traced
+        requests pin their trace id as the bucket exemplar (ISSUE 18)
+        — but only when the trace is actually recorded, so a fleet p99
+        exemplar always resolves to spans on disk."""
+        ctx = getattr(handle, "trace_ctx", None)
+        self._ledger.note_serve_ttft(
+            getattr(handle, "ttft_s", None),
+            trace_id=(
+                ctx.trace_id
+                if ctx is not None and ctx.recorded
+                else None
+            ),
+        )
         self._ledger.note_serve_complete()
 
     def _status(self) -> dict:

@@ -51,6 +51,7 @@ _A_STEP = "step-pipeline--performance-runbook"
 _A_SERVE = "serving-runbook"
 _A_FLEET = "fleet-observability-runbook"
 _A_ROUTER = "router--failover-runbook"
+_A_TRACE = "distributed-tracing-runbook"
 _A_DEVICE = "device-observatory-runbook"
 _A_QUANT = "quantization-runbook"
 _A_ALERTS = "regression--alerting-runbook"
@@ -580,6 +581,26 @@ REGISTRY: dict[str, Knob] = dict(
            "the fast window) past which reroute_spike fires — "
            "sustained rerouting means replicas are dying or stalling "
            "faster than the fleet absorbs", "alerts", _A_ROUTER),
+        _k("TPUFLOW_ALERT_ROUTER_TTFT_FRAC", "float", 0.5,
+           "fraction of the fleet TTFT p95 the router-side wait per "
+           "request (fast window) may reach before "
+           "ttft_router_dominance fires — past it, latency lives in "
+           "router admission, not the replicas", "alerts", _A_TRACE),
+        # ---------------------------------------------------------- trace
+        _k("TPUFLOW_TRACE", "bool", True,
+           "0 = disarm end-to-end request tracing (context minting, "
+           "propagation, and span recording; the disarmed fast path "
+           "is one `is not None` check per integration point)",
+           "trace", _A_TRACE),
+        _k("TPUFLOW_TRACE_SAMPLE", "float", 1.0,
+           "head-sample rate for ingress-minted traces (0..1); SLO "
+           "breach, reroute, forward error, and queue timeout always "
+           "record regardless (tail sampling)", "trace", _A_TRACE),
+        _k("TPUFLOW_TRACE_DIR", "path", None,
+           "trace-span JSONL directory override (default: "
+           "<obs_dir>/trace beside the recorder's event fragments; "
+           "unset with telemetry off = spans counted dropped)",
+           "trace", _A_TRACE, default_doc="unset"),
         # -------------------------------------------------------- testing
         _k("TPUFLOW_FAULT", "str", None,
            "comma-separated fault-injection specs (chaos suite)",
@@ -655,6 +676,7 @@ _SUBSYSTEM_TITLES = (
     ("serve", "Serving"),
     ("fleet", "Fleet observatory"),
     ("router", "Front-door router"),
+    ("trace", "Distributed tracing"),
     ("device", "Device observatory"),
     ("alerts", "Run registry & alerting"),
     ("testing", "Fault injection & testing"),
