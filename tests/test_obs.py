@@ -371,6 +371,17 @@ def test_obs_catalog_lint():
         ("gauge", "serve.prefill_fraction"),
         ("gauge", "serve.decode_utilization"),
         ("gauge", "serve.masked_row_waste"),
+        # Disaggregated prefill/decode + tiered KV (ISSUE 19) with the
+        # right kinds (also REQUIRED_EMITTERS below — same
+        # standalone/pytest cross-check): ship/import spans, the tier
+        # spill/hit/promote trail, per-tier page gauges.
+        ("span", "serve.kv_ship"),
+        ("span", "serve.kv_import"),
+        ("event", "serve.tier_hit"),
+        ("event", "serve.tier_promote"),
+        ("event", "serve.tier_spill"),
+        ("gauge", "serve.pages_host"),
+        ("gauge", "serve.pages_disk"),
         # Fleet observatory (ISSUE 14) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
         # registration, the poll sweep, staleness evidence.
@@ -405,6 +416,10 @@ def test_obs_catalog_lint():
         ("event", "router.replace"),
         ("gauge", "router.queue_depth"),
         ("gauge", "router.budget_pages"),
+        # Disaggregated serving (ISSUE 19): the router's ship hop and
+        # its explicit local-prefill degradation.
+        ("event", "router.ship"),
+        ("event", "router.ship_fallback"),
         # End-to-end tracing (ISSUE 18) with the right kinds (also
         # REQUIRED_EMITTERS below — same standalone/pytest cross-check):
         # tail-sampling escalations, per-flush evidence, and the

@@ -269,6 +269,13 @@ def follow(url: str, interval: float, max_s: float) -> int:
                     f"slo={st.get('serve_slo_violations', '-')} "
                     f"done={st.get('serve_requests', '-')}"
                 )
+                if "serve_pages_host" in st or "serve_pages_disk" in st:
+                    # Tiered prefix cache (ISSUE 19): lower-tier page
+                    # counts, only when a tier is armed on the replica.
+                    serving += (
+                        f" host={st.get('serve_pages_host', '-')} "
+                        f"disk={st.get('serve_pages_disk', '-')}"
+                    )
             print(
                 f"[tpu_watch {stamp}] step={st.get('step', '-')} "
                 f"rate={fmt(st, 'step_rate')}/s "

@@ -614,15 +614,17 @@ class CheckpointManager:
                     if faults.partial_commit():
                         return  # simulated kill between payload and marker
                 # Marker written INSIDE the staging dir (atomically), then
-                # one rename publishes payload + metadata together.
+                # one rename publishes payload + metadata together. The
+                # stage→replace write is the same helper the KV-page
+                # store commits through (ISSUE 19) — one idiom, no drift.
+                from tpuflow.infer import kv_store as kv_fmt
+
                 marker = os.path.join(stage_dir, _META_FILE)
-
-                def write_marker() -> None:
-                    with open(marker + _STAGE_SUFFIX, "w") as f:
-                        json.dump(meta, f)
-                    os.replace(marker + _STAGE_SUFFIX, marker)
-
-                raw_fmt.retry_io(write_marker, op="write_meta", path=marker)
+                raw_fmt.retry_io(
+                    lambda: kv_fmt.atomic_write_json(marker, meta),
+                    op="write_meta",
+                    path=marker,
+                )
                 raw_fmt.retry_io(
                     lambda: os.replace(stage_dir, commit_root),
                     op="commit",

@@ -182,6 +182,8 @@ class ReplicaGateway:
         prompt = body.get("prompt")
         if not rid or not isinstance(prompt, list) or not prompt:
             return 400, {"error": "need id and non-empty prompt"}
+        if body.get("phase") == "prefill":
+            return self._handle_prefill(body, rid, prompt)
         with self.lock:
             done = self._results.get(rid)
             if done is not None:
@@ -206,6 +208,11 @@ class ReplicaGateway:
                     # trace= rides only for traced requests so fake
                     # engines without the kwarg keep working untraced.
                     kw = {} if ctx is None else {"trace": ctx}
+                    # kv_key likewise rides only when the router
+                    # shipped a prefill (ISSUE 19): engines without
+                    # the kwarg keep working on plain forwards.
+                    if body.get("kv_key"):
+                        kw["kv_key"] = str(body["kv_key"])
                     handle = self.engine.submit(
                         np.asarray(prompt, np.int32),
                         max_new_tokens=int(
@@ -249,6 +256,42 @@ class ReplicaGateway:
             if time.monotonic() >= deadline:
                 return 503, {"error": "hold timeout"}
             time.sleep(self.poll_s)
+
+    def _handle_prefill(
+        self, body: dict, rid: str, prompt: list
+    ) -> tuple[int, dict]:
+        """Disaggregated ship hop (ISSUE 19): run a chunked prefill on
+        THIS replica, commit the KV pages as a tiny checkpoint, answer
+        the store key. Any failure — no kv store, a mid-ship kill, a
+        commit error — is an explicit 503: the router counts a
+        ship-fallback and the decode replica prefills locally, so the
+        client's answer never depends on this hop succeeding."""
+        with self.lock:
+            done = self._results.get(rid)
+            if done is not None:
+                return 200, dict(done)  # idempotent replay
+            if self.aborted:
+                return 503, {"error": "killed"}
+            if self.draining:
+                return 503, {"error": "draining"}
+            ship = getattr(self.engine, "ship", None)
+            if ship is None:
+                return 503, {"error": "replica cannot ship"}
+            try:
+                key = ship(
+                    np.asarray(prompt, np.int32),
+                    quantize=bool(body.get("quantize")),
+                )
+            except (TypeError, ValueError) as e:
+                return 400, {"error": str(e)}
+            except Exception as e:  # noqa: BLE001 — ship is optional;
+                # "try another path" beats a severed connection.
+                return 503, {"error": f"{type(e).__name__}: {e}"}
+            payload = {"id": rid, "kv_key": str(key)}
+            self._results[rid] = payload
+            while len(self._results) > _RESULT_CACHE_MAX:
+                self._results.popitem(last=False)
+            return 200, dict(payload)
 
     def close(self) -> None:
         try:

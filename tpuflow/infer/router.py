@@ -110,6 +110,18 @@ def route_score(
     return h * (d ** t)
 
 
+def row_tier_pages(row: dict) -> int:
+    """Host + disk spill-tier pages a fleet row reports (ISSUE 19) —
+    the warmth signal the decode tie-break prefers. Rows without tier
+    counts score 0."""
+    total = 0
+    for key in ("serve_pages_host", "serve_pages_disk"):
+        v = row.get(key)
+        if isinstance(v, (int, float)):
+            total += int(v)
+    return total
+
+
 # Bounded internal maps: the affinity map holds the most recent chain
 # digests (LRU), the done-cache the most recent responses (idempotent
 # replay window). Both are memory bounds, not correctness bounds.
@@ -145,6 +157,7 @@ class Router:
         backoff_s: float | None = None,
         affinity: bool | None = None,
         hedge: bool | None = None,
+        ship_min_tokens: int | None = None,
         min_health: float | None = None,
         trend_decay: float | None = None,
         queue_timeout_s: float | None = None,
@@ -166,6 +179,10 @@ class Router:
             affinity = knobs.get_bool("TPUFLOW_ROUTER_AFFINITY")
         if hedge is None:
             hedge = knobs.get_bool("TPUFLOW_ROUTER_HEDGE")
+        if ship_min_tokens is None:
+            ship_min_tokens = knobs.get_int(
+                "TPUFLOW_KV_SHIP_MIN_TOKENS"
+            )
         if min_health is None:
             min_health = knobs.get_float("TPUFLOW_ROUTER_MIN_HEALTH")
         if trend_decay is None:
@@ -182,6 +199,7 @@ class Router:
         self.backoff_s = float(backoff_s)
         self.affinity = bool(affinity)
         self.hedge = bool(hedge)
+        self.ship_min_tokens = int(ship_min_tokens)
         self.min_health = float(min_health)
         self.trend_decay = float(trend_decay)
         self.queue_timeout_s = float(queue_timeout_s)
@@ -206,6 +224,10 @@ class Router:
         self._counters = {
             "accepted": 0, "requests": 0, "rejected": 0, "retries": 0,
             "reroutes": 0, "affinity_hits": 0, "drains": 0,
+            # Disaggregated serving (ISSUE 19): prefill hops shipped
+            # to a role=="prefill" replica, and ship attempts that
+            # fell back to the decode replica's local prefill.
+            "ships": 0, "ship_fallbacks": 0,
             # Cumulative router-side admission wait (seconds, successful
             # picks only — deterministic for the alert oracle tests).
             # The ttft_router_dominance rule divides its window delta by
@@ -263,6 +285,10 @@ class Router:
         for rid, row in self._rows.items():
             if self._routable(row, now) is None:
                 continue
+            # A prefill-role replica (ISSUE 19) takes ship hops, not
+            # admissions — its pages are not decode budget.
+            if row.get("serve_role") == "prefill":
+                continue
             free = row.get("serve_pages_free")
             if isinstance(free, (int, float)):
                 budget += max(
@@ -297,6 +323,10 @@ class Router:
             h = self._routable(row, now)
             if h is None:
                 continue
+            # Decode placement skips prefill-role rows (ISSUE 19):
+            # those take the ship hop, never the request itself.
+            if row.get("serve_role") == "prefill":
+                continue
             free = row.get("serve_pages_free")
             if not isinstance(free, (int, float)):
                 continue
@@ -321,10 +351,104 @@ class Router:
                     e[2], e[1].get("queue_trend", 0), self.trend_decay
                 ),
                 -self._outstanding.get(e[0], 0),
+                # Warmer spill tiers break the remaining tie (ISSUE
+                # 19): more host/disk pages means more promotable
+                # prefixes, so equal-score picks land where a lower
+                # tier might save a prefill. Tier-less fleets report
+                # 0 everywhere — the ordering is unchanged.
+                int(row_tier_pages(e[1])),
                 e[0],
             ),
         )
         return rid, row, False
+
+    def _pick_prefill_locked(self, now: float) -> dict | None:
+        """Healthiest routable prefill-role row, or None. The ship hop
+        is best-effort: no candidate simply means local prefill."""
+        best: tuple[float, str, dict] | None = None
+        for rid, row in self._rows.items():
+            if row.get("serve_role") != "prefill":
+                continue
+            h = self._routable(row, now)
+            if h is None:
+                continue
+            if best is None or (h, rid) > (best[0], best[1]):
+                best = (h, rid, row)
+        return None if best is None else best[2]
+
+    def _maybe_ship(
+        self, rid: str, prompt: Any, request: dict
+    ) -> dict:
+        """Disaggregated prefill hop (ISSUE 19). Prompts of at least
+        ``ship_min_tokens`` take one best-effort forward to a
+        role=="prefill" replica — ``{"phase": "prefill"}`` runs a
+        chunked prefill there and commits the KV pages as a tiny
+        checkpoint — and the decode forward carries the returned
+        ``kv_key`` so the decode replica imports pages instead of
+        recomputing them. EVERY failure mode (no prefill capacity, a
+        dead replica mid-ship, a gateway without a kv store, a torn
+        commit) degrades to the unmodified request: the decode replica
+        prefills locally and the answer is unaffected — counted in
+        ``router_ship_fallbacks`` so the degradation is observable."""
+        if self.ship_min_tokens <= 0 or len(prompt) < self.ship_min_tokens:
+            return request
+        ctx = request.get("_trace_ctx")
+        now = self._clock()
+        with self._cond:
+            self._refresh_locked()
+            prow = self._pick_prefill_locked(now)
+        t0 = self._clock()
+        wall = time.time()
+        key = None
+        err = "no_prefill_replica"
+        if prow is not None:
+            ship_req = {
+                "id": f"{rid}#prefill",
+                "phase": "prefill",
+                "prompt": [int(t) for t in prompt],
+            }
+            if request.get("quantize") is not None:
+                ship_req["quantize"] = bool(request.get("quantize"))
+            try:
+                resp = self._forward(prow, ship_req, self.timeout_s)
+                key = resp.get("kv_key") or None
+                if key is None:
+                    err = "no kv_key in prefill response"
+            except Exception as e:  # noqa: BLE001 — ship is optional
+                err = str(e)[:200]
+        if ctx is not None:
+            ctx.add_span(
+                "router.ship",
+                ts=wall,
+                dur_s=self._clock() - t0,
+                parent=ctx.root_id,
+                ok=key is not None,
+                **(
+                    {"replica": str(prow.get("id"))}
+                    if prow is not None else {}
+                ),
+                **({} if key is not None else {"error": err}),
+            )
+        if key is None:
+            with self._cond:
+                self._counters["ship_fallbacks"] += 1
+            _rec.event(
+                "router.ship_fallback",
+                request=rid,
+                reason=err,
+            )
+            return request
+        with self._cond:
+            self._counters["ships"] += 1
+        _rec.event(
+            "router.ship",
+            request=rid,
+            replica=str(prow.get("id")),
+            key=str(key),
+        )
+        out = dict(request)
+        out["kv_key"] = str(key)
+        return out
 
     # ----------------------------------------------------------- route
     def route(self, request: dict) -> dict:
@@ -399,6 +523,7 @@ class Router:
         )
         with self._cond:
             self._counters["accepted"] += 1
+        request = self._maybe_ship(rid, prompt, request)
         attempt = 0
         tried: set[str] = set()
         last_replica: str | None = None
@@ -641,6 +766,8 @@ class Router:
                 "router_reroutes": c["reroutes"],
                 "router_affinity_hits": c["affinity_hits"],
                 "router_drains": c["drains"],
+                "router_ships": c["ships"],
+                "router_ship_fallbacks": c["ship_fallbacks"],
                 "router_inflight": inflight,
                 "router_queue_depth": self._waiting,
                 "router_budget_pages": self._last_budget,

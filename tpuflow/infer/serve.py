@@ -168,6 +168,7 @@ from tpuflow.infer.generate import (
     normalize_prefill_chunk,
     prompt_lens_to_pad_lens,
 )
+from tpuflow.infer import kv_store as _kvstore
 from tpuflow.infer.speculative import ngram_draft
 from tpuflow.utils import knobs
 
@@ -300,12 +301,49 @@ def resolve_spec_draft(speculative=None) -> int:
     return k
 
 
+def resolve_serve_role(role=None) -> str:
+    """Serving phase this engine advertises (``TPUFLOW_SERVE_ROLE``):
+    ``prefill`` takes the router's ship hops, ``decode`` takes
+    admissions, ``both`` (the default) is classic colocated serving.
+    The role never hard-gates engine behavior — a decode replica must
+    still prefill locally when a shipped set is torn — it is placement
+    advice the fleet rows export and the router reads. An explicit bad
+    arg raises; a malformed ENV value degrades to ``both`` with a
+    warning."""
+    if role is None:
+        raw = (knobs.raw("TPUFLOW_SERVE_ROLE") or "").strip().lower()
+        if raw in ("", "both"):
+            return "both"
+        if raw in ("prefill", "decode"):
+            return raw
+        print(
+            f"[tpuflow] malformed TPUFLOW_SERVE_ROLE={raw!r} (want "
+            "prefill|decode|both); using both"
+        )
+        return "both"
+    r = str(role).strip().lower()
+    if r not in ("prefill", "decode", "both"):
+        raise ValueError(
+            f"role must be prefill|decode|both, got {role!r}"
+        )
+    return r
+
+
 class PagePool:
     """Host-side accounting for the paged KV cache: free-list
     allocation, shared-prefix refcounts, and LRU eviction of idle cached
     prefix pages. Pure python/numpy — the DEVICE side only ever sees the
     resulting page tables as data, so this logic is unit-testable with
     zero compiles (tests/test_serve.py).
+
+    Tiered spill (ISSUE 19): with ``tier_cache`` (a
+    ``kv_store.TierCache``) and a ``page_reader`` wired, an evicted
+    prefix page's CONTENT drops to host DRAM / node-local disk instead
+    of being forgotten, and ``acquire`` extends the digest-chain walk
+    into the lower tiers — matched lower-tier pages are freshly
+    allocated here and reported via :meth:`take_promotions` so the
+    engine restores their bytes instead of recomputing prefill. Without
+    a tier cache every code path below is byte-identical to PR 11.
 
     Page 0 is the reserved TRASH page: never allocated, never read.
     Dead slots' zeroed tables and out-of-range writes route there inside
@@ -324,7 +362,8 @@ class PagePool:
     (``serve.page_evict``)."""
 
     def __init__(self, n_pages: int, page_size: int,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, tier_cache=None,
+                 page_reader=None):
         if n_pages < 2:
             raise ValueError(
                 f"n_pages must be >= 2 (page 0 is the reserved trash "
@@ -345,6 +384,10 @@ class PagePool:
         self.prefix_hits = 0
         self.prefix_lookups = 0
         self.evictions = 0
+        self.tier = tier_cache
+        self._page_reader = page_reader
+        self._pending_promote: list[tuple[int, bytes, str]] = []
+        self.tier_hits = 0
 
     @property
     def usable_pages(self) -> int:
@@ -394,7 +437,21 @@ class PagePool:
         cache so the NEXT request with this prefix reuses them."""
         digests = self.prefix_digests(prompt)
         matched = min(self.match_len(digests), need)
+        self._pending_promote = []
+        if self.tier is not None:
+            # Tier walk (ISSUE 19): extend the chain into the lower
+            # tiers, contiguously from where HBM broke — each hit gets
+            # a FRESH page here (registered below like any full-prompt
+            # page) whose bytes the engine restores from the tier.
+            j = matched
+            while j < min(len(digests), need):
+                tier = self.tier.locate(digests[j])
+                if tier is None:
+                    break
+                self._pending_promote.append((j, digests[j], tier))
+                j += 1
         if not self.can_fit(need, matched):
+            self._pending_promote = []
             return None
         self.prefix_lookups += len(digests[:need])
         self.prefix_hits += matched
@@ -418,6 +475,13 @@ class PagePool:
                 self._page_hash[pid] = digests[j]
         return ids, matched
 
+    def take_promotions(self) -> list[tuple[int, bytes, str]]:
+        """The last ``acquire``'s lower-tier matches as ``(page_index,
+        digest, tier)`` — consumed by the engine, which fetches each
+        bundle and writes it back into the pool (serve.tier_promote)."""
+        out, self._pending_promote = self._pending_promote, []
+        return out
+
     def _alloc_one(self) -> int:
         if self._free:
             return self._free.pop()
@@ -425,6 +489,14 @@ class PagePool:
         d = self._page_hash.pop(pid)
         del self._hash_to_page[d]
         self.evictions += 1
+        if self.tier is not None and self._page_reader is not None:
+            # Spill instead of forget: the page's bytes drop a tier and
+            # stay findable through the bounded digest→tier index (the
+            # ISSUE 19 bugfix — an evicted prefix used to be
+            # indistinguishable from never-cached).
+            tier = self.tier.spill(d, self._page_reader(pid))
+            if tier is not None:
+                obs.event("serve.tier_spill", page=pid, tier=tier)
         obs.event("serve.page_evict", page=pid)
         return pid
 
@@ -517,6 +589,10 @@ class ServeRequest:
     # through the front door, else None — the untraced path stays one
     # `is not None` check.
     trace_ctx: Any = None
+    # Disaggregated serving (ISSUE 19): a validated KVPageSet loaded at
+    # submit (kv_key=...) — its pages restore at admission instead of
+    # being recomputed; None rides the classic local-prefill path.
+    kv_import: Any = None
 
     @property
     def done(self) -> bool:
@@ -589,6 +665,10 @@ class ServeEngine:
         prefix_cache: bool | None = None,
         speculative: int | bool | None = None,
         spec_ngram: int = 3,
+        role: str | None = None,
+        kv_store_dir: str | None = None,
+        kv_host_mb: float | None = None,
+        kv_disk_dir: str | None = None,
     ):
         self.model = model
         self.params = params
@@ -688,6 +768,19 @@ class ServeEngine:
                 "n_ctx edge; paging routes overshoot to the trash page) "
                 "— drop paged=False or TPUFLOW_SERVE_PAGED=0"
             )
+        # Disaggregated serving (ISSUE 19): the engine role, the
+        # shared KV-page store (ship/import), and the tiered prefix
+        # cache. Everything defaults off/"both" — an engine built with
+        # no kv knobs is byte-identical to the classic one.
+        self.role = resolve_serve_role(role)
+        kv_dir = (
+            kv_store_dir if kv_store_dir is not None
+            else knobs.raw("TPUFLOW_KV_STORE_DIR")
+        )
+        self.kv_store = _kvstore.KVStore(kv_dir) if kv_dir else None
+        self._tier: _kvstore.TierCache | None = None
+        self._prefill_calls = 0
+        self._row_tmpl = None
         self._pmodel = self._qpmodel = None
         self.pool = None
         if self.paged:
@@ -708,8 +801,33 @@ class ServeEngine:
                 _env_flag("TPUFLOW_SERVE_PREFIX_CACHE", True)
                 if prefix_cache is None else bool(prefix_cache)
             )
+            # Tiered prefix cache (ISSUE 19): both tiers default OFF —
+            # the untiered pool is byte-identical to PR 11.
+            host_mb = (
+                float(kv_host_mb) if kv_host_mb is not None
+                else float(knobs.get_float("TPUFLOW_KV_HOST_MB"))
+            )
+            tier_disk = (
+                kv_disk_dir if kv_disk_dir is not None
+                else knobs.raw("TPUFLOW_KV_DISK_DIR")
+            )
+            if use_prefix and (host_mb > 0 or tier_disk):
+                self._tier = _kvstore.TierCache(
+                    host_bytes=int(host_mb * 2**20),
+                    disk_dir=tier_disk or None,
+                    index_max=int(knobs.get_int("TPUFLOW_KV_INDEX_MAX")),
+                    disk_max_bytes=int(
+                        float(knobs.get_float("TPUFLOW_KV_DISK_MB"))
+                        * 2**20
+                    ),
+                )
             self.pool = PagePool(
-                self.n_pages, self.page_size, prefix_cache=use_prefix
+                self.n_pages, self.page_size, prefix_cache=use_prefix,
+                tier_cache=self._tier,
+                page_reader=(
+                    self._read_page_host
+                    if self._tier is not None else None
+                ),
             )
             self._page_table = np.zeros(
                 (S, self.pages_per_slot), np.int32
@@ -1043,6 +1161,7 @@ class ServeEngine:
         quantize: bool = False,
         speculative: bool | None = None,
         trace: Any = None,
+        kv_key: str | None = None,
     ) -> ServeRequest:
         """Enqueue one request; returns its live handle. Validation is
         eager (a request that can never fit must fail at submit, not
@@ -1052,7 +1171,11 @@ class ServeEngine:
         ``speculative`` routes it through the verify block on a
         spec-armed engine (None = the engine default: on when armed);
         ``speculative=True`` on an unarmed engine raises — the verify
-        programs compile at warmup, never mid-flight."""
+        programs compile at warmup, never mid-flight. ``kv_key`` names a
+        shipped page set in the engine's KV store (ISSUE 19): a loadable
+        matching set admits the request already-prefilled; a missing /
+        torn / mismatched one degrades to local prefill (``kv_fallback``
+        trace), never an error."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must have at least one token")
@@ -1077,6 +1200,18 @@ class ServeEngine:
         spec = bool(self.spec_draft) if speculative is None else bool(
             speculative
         )
+        kv_import = None
+        if kv_key is not None and self.kv_store is not None and self.paged:
+            with obs.span("serve.kv_import", key=kv_key) as sp:
+                pset = self.kv_store.load(kv_key)
+                if pset is not None and self._import_ok(
+                    pset, prompt, quantize
+                ):
+                    kv_import = pset
+                sp.set(
+                    ok=kv_import is not None,
+                    pages=0 if pset is None else pset.n_pages,
+                )
         bucket = self.bucket_for(prompt.size, max_new_tokens)
         req = ServeRequest(
             id=self._next_id,
@@ -1096,12 +1231,17 @@ class ServeEngine:
                 f"(n_pages={self.n_pages}, page_size={self.page_size}) — "
                 "it could never admit; raise TPUFLOW_SERVE_PAGES"
             )
+        req.kv_import = kv_import
         self._next_id += 1
         self._queue.append(req)
         self._trace(
             req, "submitted", prompt_len=int(prompt.size),
             max_new=req.max_new_tokens, bucket=bucket, group=req.group,
         )
+        if kv_key is not None and kv_import is None:
+            # Local-prefill fallback: the shipped set was missing, torn,
+            # or mismatched — the request proceeds as if never shipped.
+            self._trace(req, "kv_fallback", key=kv_key)
         return req
 
     @property
@@ -1150,6 +1290,210 @@ class ServeEngine:
         if allocated <= 0:
             return None
         return resident / allocated
+
+    # ------------------------------------- disaggregated serving (ISSUE 19)
+    def _cache_leaf_items(self, tree):
+        """``(path-key, leaf)`` for every pool-shaped KV leaf (>= 4
+        dims: ``(..., pages_or_slot, tokens, H, D)``) in canonical
+        flatten order — the shared leaf naming that page bundles,
+        shipped sets, and the tier store all key on."""
+        out = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if getattr(leaf, "ndim", 0) >= 4:
+                out.append((jax.tree_util.keystr(path), leaf))
+        return out
+
+    def _read_page_host(self, pid: int) -> dict[str, np.ndarray]:
+        """Pool page ``pid`` as a host-side per-leaf bundle ``(...,
+        page_size, H, D)`` — the spill/promotion unit. Eager gathers:
+        no named program, so ``compile_stats()`` never sees this."""
+        out = {}
+        for key, leaf in self._cache_leaf_items(self._cache):
+            out[key] = np.asarray(
+                jnp.take(leaf, pid, axis=leaf.ndim - 4)
+            )
+        return out
+
+    def _row_template(self):
+        """Shape/dtype pytree of a prefill cache row via
+        ``jax.eval_shape`` (no compile, no device work), cached. Row
+        leaves are bucket-independent — ``(..., 1, n_ctx, H, D)`` KV
+        plus the row model's index scalars — so one template serves
+        every restore."""
+        if self._row_tmpl is None:
+            W = self.buckets[0]
+            pads = prompt_lens_to_pad_lens([1], 1, W)
+            chunk = normalize_prefill_chunk(self.prefill_chunk, W)
+            self._row_tmpl = jax.eval_shape(
+                functools.partial(
+                    self._prefill_fn, self.model, chunk=chunk
+                ),
+                self.params, jnp.zeros((1, W), jnp.int32), pads,
+            )[1]
+        return self._row_tmpl
+
+    def _synth_row(self, pages: dict[int, dict[str, np.ndarray]]):
+        """A zeroed prefill-row pytree with ``pages`` (logical page
+        index -> bundle) written at their columns. Moulded on the
+        :meth:`_row_template` shapes/dtypes — the EXACT signature of a
+        real prefill row — so the warmed ``_insert`` scatters it with
+        ``pad=0`` and zero fresh compiles (pinned by
+        tests/test_serve_disagg.py). Index scalars are zeroed host
+        arrays: the insert passes them through unread, and a fresh
+        buffer never aliases the donated cache operand."""
+        ps = self.page_size
+
+        def mk(path, leaf):
+            row = np.zeros(leaf.shape, leaf.dtype)
+            if row.ndim < 4:
+                return row
+            key = jax.tree_util.keystr(path)
+            for j, bundle in pages.items():
+                page = bundle.get(key)
+                if page is not None:
+                    row[..., 0, j * ps:(j + 1) * ps, :, :] = page
+            return row
+
+        return jax.tree_util.tree_map_with_path(mk, self._row_template())
+
+    def _restore_pages(
+        self, table_row: np.ndarray, pages: dict[int, dict]
+    ) -> None:
+        """Scatter restored page bundles (tier promotions / shipped
+        pages) into the pool slots ``table_row`` names — one masked
+        ``_insert`` over a synthesized row, the admission insert's exact
+        program signature."""
+        if not pages:
+            return
+        write_mask = np.zeros((self.pages_per_slot,), bool)
+        for j in pages:
+            write_mask[j] = True
+        # Device-resident leaves on purpose: the jit cache distinguishes
+        # committed arrays (what the warmed insert saw — prefill output)
+        # from host numpy operands, and a distinct entry would break the
+        # never-recompile contract.
+        row = jax.tree_util.tree_map(jnp.asarray, self._synth_row(pages))
+        with self.ledger.bucket("insert"):
+            self._cache = self._insert(
+                self._cache, row, jnp.asarray(table_row),
+                jnp.int32(0), jnp.asarray(write_mask),
+            )
+
+    def prefill_export(
+        self, prompt, *, quantize: bool = False
+    ) -> _kvstore.KVPageSet:
+        """Run admission prefill for ``prompt`` and extract its KV pages
+        as a :class:`~tpuflow.infer.kv_store.KVPageSet` — the
+        prefill-role half of a disaggregated pair. The row comes from
+        the SAME bucketed prefill program an admission uses, then is
+        pad-stripped host-side (np.roll by ``-(W - L)``), so page
+        content is bit-equal to what a local admission would have
+        inserted (PR 11's pad-invariance). Includes the partial tail
+        page (private to the request — decode writes land there) and
+        the first greedy token, so an exact import admits with zero
+        prefill."""
+        if not self.paged:
+            raise ValueError(
+                "KV export needs the paged engine (TPUFLOW_SERVE_PAGED)"
+            )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must have at least one token")
+        L = int(prompt.size)
+        W = self.bucket_for(L, 1)
+        padded = np.full((1, W), self.pad_id, np.int32)
+        padded[0, W - L:] = prompt
+        pads = prompt_lens_to_pad_lens([L], 1, W)
+        chunk = normalize_prefill_chunk(self.prefill_chunk, W)
+        prefill = self._prefill_q if quantize else self._prefill
+        prm = self._qparams if quantize else self.params
+        self._prefill_calls += 1
+        with self.ledger.bucket("prefill"):
+            tok0, row_cache = prefill(
+                prm, jnp.asarray(padded), pads, chunk=chunk
+            )
+            first = int(np.asarray(tok0)[0])
+        ps = self.page_size
+        k_ship = -(-L // ps)
+        pages: dict[str, np.ndarray] = {}
+        for key, leaf in self._cache_leaf_items(row_cache):
+            row = np.asarray(leaf)  # (..., 1, n_ctx, H, D)
+            shifted = np.roll(row, -(W - L), axis=row.ndim - 3)
+            sq = np.take(shifted, 0, axis=row.ndim - 4)
+            lead = sq.shape[: sq.ndim - 3]
+            paged = sq.reshape(
+                lead + (self.pages_per_slot, ps) + sq.shape[-2:]
+            )
+            paged = np.moveaxis(paged, paged.ndim - 4, 0)
+            pages[key] = np.ascontiguousarray(paged[:k_ship])
+        return _kvstore.KVPageSet(
+            page_size=ps,
+            n_tokens=L,
+            prompt=prompt,
+            digests=_kvstore.chain_digests(prompt, ps),
+            pages=pages,
+            tok0=first,
+            meta={"quant": bool(quantize)},
+        )
+
+    def ship(self, prompt, *, quantize: bool = False, store=None) -> str:
+        """Prefill + commit: the prefill-role request path. Returns the
+        committed ``kv_key`` the router forwards to a decode replica
+        (``submit(..., kv_key=...)``)."""
+        st = store if store is not None else self.kv_store
+        if st is None:
+            raise ValueError(
+                "ship() needs a KV store: pass store= or set "
+                "TPUFLOW_KV_STORE_DIR"
+            )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with obs.span(
+            "serve.kv_ship", prompt_len=int(prompt.size),
+            quant=bool(quantize),
+        ) as sp:
+            pset = self.prefill_export(prompt, quantize=quantize)
+            key = st.commit(pset)
+            sp.set(key=key, pages=pset.n_pages)
+        return key
+
+    def _import_ok(self, pset, prompt, quantize: bool) -> bool:
+        """A shipped set is usable when its geometry and numeric path
+        match and it covers this prompt — exactly (full ship: zero
+        prefill) or as a digest-chain prefix (suffix resume: import the
+        covered pages, prefill only the suffix). Anything else rides
+        local prefill; the serving path never raises on a bad set."""
+        if pset.page_size != self.page_size or not pset.pages:
+            return False
+        if bool(pset.meta.get("quant")) != bool(quantize):
+            return False
+        if pset.n_tokens == prompt.size and np.array_equal(
+            np.asarray(pset.prompt, np.int32), prompt
+        ):
+            return True
+        mine = _kvstore.chain_digests(prompt, self.page_size)
+        return _kvstore.chain_match(pset.digests, mine) > 0
+
+    def _note_first_token(self, req: ServeRequest, now: float) -> None:
+        """TTFT bookkeeping — shared by the classic admission path and
+        the prefill-free ones (full ship / decode-feed, where the first
+        token lands in a decode harvest): same gauge, lifecycle trace,
+        SLO gate, and goodput note either way."""
+        req.t_first = now
+        obs.gauge("serve.ttft_s", round(req.ttft_s, 6))
+        self._trace(req, "first_token", ttft_s=round(req.ttft_s, 6))
+        self.ledger.note_ttft(req.group, req.ttft_s)
+        if self.ledger.check_ttft(req.ttft_s, group=req.group):
+            self._slo_violation(
+                req, "ttft", req.ttft_s, self.ledger.slo_ttft_s
+            )
+        ctx = req.trace_ctx
+        obs.goodput_live().note_serve_ttft(
+            req.ttft_s,
+            trace_id=(
+                ctx.trace_id
+                if ctx is not None and ctx.recorded else None
+            ),
+        )
 
     # ------------------------------------------- lifecycle traces (ISSUE 13)
     def _trace(self, req: ServeRequest, phase: str, **attrs) -> None:
@@ -1272,37 +1616,140 @@ class ServeEngine:
         """Admit ``req`` into ``slot``. Returns False (request untouched,
         caller leaves it queued) when the page pool cannot fit it —
         token-budget admission backpressure. Page acquisition precedes
-        the prefill so a blocked request costs zero device work."""
+        the prefill so a blocked request costs zero device work.
+
+        Disaggregated admission (ISSUE 19): pages covered by an imported
+        :class:`~tpuflow.infer.kv_store.KVPageSet` or by lower-tier
+        promotions are RESTORED (a masked insert of their committed
+        bytes — the admission insert's exact program signature) instead
+        of recomputed. When restored + shared pages cover the prompt the
+        prefill program never runs: an exact shipped set admits on its
+        committed first token (full ship); otherwise the decode program
+        is fed ``prompt[L-1]`` at ``lengths = L-1`` — it writes that
+        column's kv and emits the first token, bit-equal to prefill by
+        the cache-mediated-attention exactness PR 11 pinned (when column
+        ``L-1`` lands in a covered page the decode write re-writes
+        identical bytes, so shared pages stay sound). A request with
+        neither rides the classic path byte-identically."""
         page_ids: list[int] | None = None
         matched = 0
+        promoted: list[tuple[int, bytes, str]] = []
         if self.paged:
             got = self.pool.acquire(req.prompt, self._pages_needed(req))
             if got is None:
                 self._note_queued(req, "pages")
                 return False
             page_ids, matched = got
+            promoted = self.pool.take_promotions()
         now = time.monotonic()
         req.t_admit = now
         W = req.bucket
         L = req.prompt.size
-        padded = np.full((1, W), self.pad_id, np.int32)
-        padded[0, W - L:] = req.prompt
-        pads = prompt_lens_to_pad_lens([L], 1, W)
-        chunk = normalize_prefill_chunk(self.prefill_chunk, W)
-        prefill = self._prefill_q if req.quantize else self._prefill
-        prm = self._qparams if req.quantize else self.params
-        with self.ledger.bucket("prefill"), obs.span(
-            "serve.prefill", request=req.id, bucket=W, prompt_len=int(L),
-            chunk=chunk, quant=bool(req.quantize),
-        ):
-            tok0, row_cache = prefill(
-                prm, jnp.asarray(padded), pads, chunk=chunk
+        ps = self.page_size if self.paged else 0
+        pset = req.kv_import if self.paged else None
+        # Restored pages: logical page index -> bundle, contiguous from
+        # where HBM matching broke — tier promotions first, then shipped
+        # pages extend the run. A failed tier fetch truncates the run;
+        # everything past it rides the prefill write instead (never a
+        # drop, never a gap).
+        restored: dict[int, dict[str, np.ndarray]] = {}
+        restore_src: dict[int, str] = {}
+        for j, digest, _tier in promoted:
+            if j != matched + len(restored):
+                break
+            got_b = self.pool.tier.fetch(digest)
+            if got_b is None:
+                break
+            restored[j], restore_src[j] = got_b
+        exact = (
+            pset is not None
+            and pset.n_tokens == L
+            and np.array_equal(np.asarray(pset.prompt, np.int32),
+                               req.prompt)
+        )
+        if pset is not None:
+            k_full = _kvstore.chain_match(
+                pset.digests, self.pool.prefix_digests(req.prompt)
             )
-            first = int(np.asarray(tok0)[0])
-        req.t_first = time.monotonic()
-        req.t_last_tick = req.t_first
-        req.tokens.append(first)
+            top = pset.n_pages if exact else min(k_full, pset.n_pages)
+            j = matched + len(restored)
+            while j < min(top, len(page_ids)):
+                restored[j] = pset.page_bundle(j)
+                restore_src[j] = "ship"
+                j += 1
+        covered = matched + len(restored)
+        full_ship = exact and pset.tok0 is not None and covered * ps >= L
+        feed_decode = (
+            not full_ship
+            and self.paged
+            and (pset is not None or self.pool.tier is not None)
+            and covered >= 1
+            and covered * ps >= L - 1
+        )
+        mode = (
+            "ship" if full_ship else "feed" if feed_decode else "prefill"
+        )
+        table_row = write_mask = None
+        if self.paged:
+            table_row = np.zeros((self.pages_per_slot,), np.int32)
+            table_row[: len(page_ids)] = page_ids
+            write_mask = np.zeros((self.pages_per_slot,), bool)
+            write_mask[matched: len(page_ids)] = True
+            for j in restored:
+                write_mask[j] = False  # restored bytes, not prefill's
+        n_host = sum(1 for s in restore_src.values() if s == "host")
+        n_disk = sum(1 for s in restore_src.values() if s == "disk")
+        if n_host or n_disk:
+            self.pool.tier_hits += n_host + n_disk
+            obs.event(
+                "serve.tier_hit", request=req.id, host=n_host,
+                disk=n_disk, **self._tid(req),
+            )
+        if restored:
+            self._restore_pages(table_row, restored)
+            if n_host or n_disk:
+                obs.event(
+                    "serve.tier_promote", request=req.id,
+                    pages=n_host + n_disk, **self._tid(req),
+                )
+        first: int | None = None
+        row_cache = None
+        if mode == "ship":
+            first = int(pset.tok0)
+            req.t_first = time.monotonic()
+            req.t_last_tick = req.t_first
+            req.tokens.append(first)
+        elif mode == "feed":
+            pass  # the first token comes out of the decode block
+        else:
+            padded = np.full((1, W), self.pad_id, np.int32)
+            padded[0, W - L:] = req.prompt
+            pads = prompt_lens_to_pad_lens([L], 1, W)
+            chunk = normalize_prefill_chunk(self.prefill_chunk, W)
+            prefill = self._prefill_q if req.quantize else self._prefill
+            prm = self._qparams if req.quantize else self.params
+            self._prefill_calls += 1
+            with self.ledger.bucket("prefill"), obs.span(
+                "serve.prefill", request=req.id, bucket=W,
+                prompt_len=int(L), chunk=chunk, quant=bool(req.quantize),
+            ):
+                tok0, row_cache = prefill(
+                    prm, jnp.asarray(padded), pads, chunk=chunk
+                )
+                first = int(np.asarray(tok0)[0])
+            req.t_first = time.monotonic()
+            req.t_last_tick = req.t_first
+            req.tokens.append(first)
         req.state = "running"
+        extra_trace = {}
+        if mode != "prefill" or restored:
+            extra_trace = {
+                "prefilled": mode,
+                "shipped_pages": sum(
+                    1 for s in restore_src.values() if s == "ship"
+                ),
+                "promoted_pages": n_host + n_disk,
+            }
         obs.event(
             "serve.admit", request=req.id, slot=slot, bucket=W,
             prompt_len=int(L),
@@ -1315,52 +1762,36 @@ class ServeEngine:
             req, "admitted", slot=slot, bucket=W,
             queue_wait_s=round(now - req.t_submit, 6),
             pages=0 if page_ids is None else len(page_ids),
-            shared_pages=matched,
+            shared_pages=matched, **extra_trace,
         )
-        obs.gauge("serve.ttft_s", round(req.ttft_s, 6))
-        self._trace(req, "first_token", ttft_s=round(req.ttft_s, 6))
-        self.ledger.note_ttft(req.group, req.ttft_s)
-        if self.ledger.check_ttft(req.ttft_s, group=req.group):
-            self._slo_violation(
-                req, "ttft", req.ttft_s, self.ledger.slo_ttft_s
+        if first is not None:
+            self._note_first_token(req, req.t_first)
+            done = (req.eos_id is not None and first == req.eos_id) or (
+                req.max_new_tokens == 1
             )
-        led = obs.goodput_live()
-        ctx = req.trace_ctx
-        led.note_serve_ttft(
-            req.ttft_s,
-            trace_id=(
-                ctx.trace_id
-                if ctx is not None and ctx.recorded else None
-            ),
-        )
-        done = (req.eos_id is not None and first == req.eos_id) or (
-            req.max_new_tokens == 1
-        )
-        self._emitted_tokens += 1
-        led.note_serve_tokens(1)
-        obs.counter("serve.tokens", 1)
-        if done:
-            if page_ids is not None:
-                self.pool.release(page_ids)
-            self._finish(
-                req, "eos" if req.max_new_tokens > 1 else "budget"
-            )
-            return True
-        if self.paged:
-            # Pad-stripped page insert: real prompt kv moves to logical
-            # [0, L); shared prefix pages are masked OFF the write.
-            table_row = np.zeros((self.pages_per_slot,), np.int32)
-            table_row[: len(page_ids)] = page_ids
-            write_mask = np.zeros((self.pages_per_slot,), bool)
-            write_mask[matched: len(page_ids)] = True
-            with self.ledger.bucket("insert"):
-                self._cache = self._insert(
-                    self._cache, row_cache, jnp.asarray(table_row),
-                    jnp.int32(W - L), jnp.asarray(write_mask),
+            self._emitted_tokens += 1
+            obs.goodput_live().note_serve_tokens(1)
+            obs.counter("serve.tokens", 1)
+            if done:
+                if page_ids is not None:
+                    self.pool.release(page_ids)
+                self._finish(
+                    req, "eos" if req.max_new_tokens > 1 else "budget"
                 )
+                return True
+        if self.paged:
+            if mode == "prefill":
+                # Pad-stripped page insert: real prompt kv moves to
+                # logical [0, L); shared prefix pages and restored pages
+                # are masked OFF the write.
+                with self.ledger.bucket("insert"):
+                    self._cache = self._insert(
+                        self._cache, row_cache, jnp.asarray(table_row),
+                        jnp.int32(W - L), jnp.asarray(write_mask),
+                    )
             self._page_table[slot] = table_row
             self._slot_pages[slot] = list(page_ids)
-            self._lengths[slot] = L
+            self._lengths[slot] = L if mode != "feed" else L - 1
             self._pads[slot] = 0
         else:
             with self.ledger.bucket("insert"):
@@ -1370,8 +1801,13 @@ class ServeEngine:
             self._lengths[slot] = W
             self._pads[slot] = W - L
         self._slots[slot] = req
-        self._tok[slot] = first
-        self._remaining[slot] = req.max_new_tokens - 1
+        self._tok[slot] = (
+            first if first is not None else int(req.prompt[L - 1])
+        )
+        self._remaining[slot] = (
+            req.max_new_tokens - 1 if first is not None
+            else req.max_new_tokens
+        )
         self._live[slot] = True
         self._quant[slot] = req.quantize
         self._spec[slot] = req.speculative and self.spec_draft > 0
@@ -1414,11 +1850,14 @@ class ServeEngine:
         periodic refresh) — a long idle server must not flood the event
         stream."""
         pool = self.pool
+        tier = None if pool is None else pool.tier
         state = (
             len(self._queue),
             self.live_slots,
             None if pool is None else pool.free_pages,
             None if pool is None else pool.prefix_hits,
+            None if tier is None else tier.pages_host,
+            None if tier is None else tier.pages_disk,
         )
         fr = self.ledger.fractions()
         if self._iters % 64 == 0:
@@ -1439,6 +1878,9 @@ class ServeEngine:
             if pool is not None:
                 obs.gauge("serve.pages_free", state[2])
                 obs.gauge("serve.prefix_hits", state[3])
+            if tier is not None:
+                obs.gauge("serve.pages_host", state[4])
+                obs.gauge("serve.pages_disk", state[5])
             # Engine-time ledger fractions (ISSUE 13): the idle /
             # decode / prefill split one babysitter line reads, plus
             # the token-efficiency gauges, sampled on the same
@@ -1474,6 +1916,11 @@ class ServeEngine:
         if pool is not None:
             led.note_serve_pages(pool.free_pages, pool.usable_pages)
             led.note_serve_prefix(pool.prefix_hits, pool.prefix_lookups)
+        led.note_serve_role(self.role)
+        if tier is not None:
+            led.note_serve_tiers(
+                tier.pages_host, tier.pages_disk, pool.tier_hits
+            )
 
     def _run_decode_block(self, quant: bool, spec: bool = False) -> int:
         """One decode (or speculative verify) block over ONE group's
@@ -1611,6 +2058,12 @@ class ServeEngine:
                         # Median+MAD ITL spike detector (ISSUE 15); the
                         # same call advances a live capture's bound.
                         self._profcap.observe_itl(itl)
+                if req.t_first is None:
+                    # Prefill-free admission (ISSUE 19): the request's
+                    # first token came out of the decode program, so
+                    # TTFT lands on this harvest — after the ITL anchor
+                    # above, which must not see a zero-width tick.
+                    self._note_first_token(req, now)
                 req.t_last_tick = now
                 if spec:
                     self._trace(

@@ -115,6 +115,18 @@ REQUIRED_EMITTERS: tuple[tuple[str, str], ...] = (
     ("gauge", "serve.prefill_fraction"),
     ("gauge", "serve.decode_utilization"),
     ("gauge", "serve.masked_row_waste"),
+    # Disaggregated prefill/decode + tiered KV (ISSUE 19): the ship /
+    # import spans, the tier spill/hit/promote trail, and the per-tier
+    # page gauges.
+    ("span", "serve.kv_ship"),
+    ("span", "serve.kv_import"),
+    ("event", "serve.tier_hit"),
+    ("event", "serve.tier_promote"),
+    ("event", "serve.tier_spill"),
+    ("gauge", "serve.pages_host"),
+    ("gauge", "serve.pages_disk"),
+    ("event", "router.ship"),
+    ("event", "router.ship_fallback"),
     # Fleet observatory (ISSUE 14): registration, the poll sweep, and
     # the staleness evidence trail.
     ("event", "fleet.register"),

@@ -246,6 +246,50 @@ CATALOG: dict[str, tuple[str, str]] = {
         "pool pressure reclaimed an idle (refcount-0) prefix-cache page "
         "LRU-first; its cached prefix must be recomputed on next use",
     ),
+    # Disaggregated prefill/decode + tiered KV (ISSUE 19): committed
+    # page sets ship prefill→decode through kv_store, and evicted
+    # prefix pages spill HBM → host DRAM → node-local disk instead of
+    # being forgotten. All host-side; compile_stats() is unchanged.
+    "serve.kv_ship": (
+        "span",
+        "prefill-role export: chunked prefill + page extraction + the "
+        "atomic kv_store commit of one KVPageSet (prompt_len, pages, "
+        "key) — the prefill half of a disaggregated admission",
+    ),
+    "serve.kv_import": (
+        "span",
+        "decode-role import: load + validate a committed KVPageSet at "
+        "submit (ok=False = torn/missing/mismatched set → the request "
+        "rides local prefill; the fallback evidence the chaos tests "
+        "assert)",
+    ),
+    "serve.tier_hit": (
+        "event",
+        "a prompt's prefix-digest chain matched pages in a lower tier "
+        "(host/disk counts); the pages promote back into the HBM pool "
+        "instead of being recomputed by prefill",
+    ),
+    "serve.tier_promote": (
+        "event",
+        "tier-hit pages were written back into the HBM pool for an "
+        "admission (pages, and whether prefill was skipped entirely)",
+    ),
+    "serve.tier_spill": (
+        "event",
+        "an evicted prefix page's content dropped to a lower tier "
+        "(tier=host|disk) instead of being forgotten — still findable "
+        "through the bounded digest→tier index",
+    ),
+    "serve.pages_host": (
+        "gauge",
+        "prefix pages currently held by the host-DRAM spill tier "
+        "(TPUFLOW_KV_HOST_MB budget, LRU)",
+    ),
+    "serve.pages_disk": (
+        "gauge",
+        "prefix pages findable in the node-local disk spill tier "
+        "(TPUFLOW_KV_DISK_DIR; survives engine restarts)",
+    ),
     # Serving observatory (ISSUE 13): per-request lifecycle traces, the
     # engine-time ledger fractions, and declared-SLO accounting — the
     # serving analog of the goodput ledger (tpuflow.obs.serve_ledger),
@@ -389,6 +433,20 @@ CATALOG: dict[str, tuple[str, str]] = {
         "the autoscale loop launched a prewarm_cache-seeded "
         "replacement or requested scale-up (action, replica, reason: "
         "stale | occupancy | slo_rate)",
+    ),
+    "router.ship": (
+        "event",
+        "disaggregated serving (ISSUE 19): a long prompt's KV pages "
+        "were prefilled on a prefill-role replica and committed — the "
+        "decode forward carries the returned kv_key (request id, "
+        "prefill replica, key)",
+    ),
+    "router.ship_fallback": (
+        "event",
+        "the KV ship hop failed (no prefill capacity, dead replica "
+        "mid-ship, torn commit) and the request degraded to local "
+        "prefill on the decode replica — never an error, never a "
+        "drop, but counted so the degradation is observable",
     ),
     "router.queue_depth": (
         "gauge",

@@ -75,6 +75,11 @@ _PROM_SPEC = (
     ("tpuflow_serve_pages_free", "serve_pages_free", "gauge"),
     ("tpuflow_serve_prefix_hit_rate", "serve_prefix_hit_rate", "gauge"),
     ("tpuflow_serve_spec_accept_rate", "serve_spec_accept_rate", "gauge"),
+    # Tiered prefix cache (ISSUE 19): pages parked in the host-DRAM /
+    # node-local-disk tiers; keys only present when a tier is armed
+    # (TPUFLOW_KV_HOST_MB / TPUFLOW_KV_DISK_DIR).
+    ("tpuflow_serve_pages_host", "serve_pages_host", "gauge"),
+    ("tpuflow_serve_pages_disk", "serve_pages_disk", "gauge"),
     # Serving observatory (ISSUE 13): engine-time ledger fractions, ITL
     # percentiles, and declared-SLO accounting; keys only present while
     # an engine feeds this process's ledger.

@@ -520,6 +520,7 @@ def aggregate(statuses: list[dict]) -> dict[str, Any]:
     for key in (
         "serve_queue_depth", "serve_pages_free", "serve_tokens_per_s",
         "serve_requests", "serve_tokens", "serve_slo_violations",
+        "serve_pages_host", "serve_pages_disk", "serve_tier_hits",
     ):
         v = _sum_key(statuses, key)
         if v is not None:
@@ -853,6 +854,8 @@ class FleetObservatory:
                     "serve_decode_utilization", "serve_idle_fraction",
                     "serve_decode_fraction", "serve_ttft_p99_s",
                     "serve_itl_p99_s", "serve_draining",
+                    "serve_role", "serve_pages_host", "serve_pages_disk",
+                    "serve_tier_hits",
                     "generate_url", "uptime_s",
                     "step", "mfu", "hbm_used_frac", "hbm_peak_frac",
                 ):

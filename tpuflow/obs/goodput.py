@@ -337,6 +337,17 @@ class ProcessLedger:
         self.serve_prefix_lookups = 0
         self.serve_spec_committed = 0
         self.serve_spec_forwards = 0
+        # Disaggregated serving (ISSUE 19): the engine's phase role
+        # ("prefill" / "decode" / "both" — placement advice the router
+        # reads off the fleet row) and the tiered prefix cache's
+        # lower-tier page counts. Role "both" with no tier pages is the
+        # classic engine; the serve_role key is exported whenever an
+        # engine runs, the tier keys only when a tier is armed.
+        self.serve_role: str | None = None
+        self.serve_pages_host = 0
+        self.serve_pages_disk = 0
+        self.serve_tier_hits = 0
+        self.serve_tiers_armed = False
         # Serving observatory (ISSUE 13): engine-time ledger fractions,
         # efficiency gauges, and declared-SLO violation count, fed by
         # the engine each scheduler iteration; ITL observations ride a
@@ -480,6 +491,19 @@ class ProcessLedger:
         """Cumulative shared-prefix page cache hits / lookups."""
         self.serve_prefix_hits = int(hits)
         self.serve_prefix_lookups = int(lookups)
+
+    def note_serve_role(self, role: str) -> None:
+        """The engine's disaggregation role (ISSUE 19), exported on
+        /status so the router can place prefill vs decode traffic."""
+        self.serve_role = str(role)
+
+    def note_serve_tiers(self, host: int, disk: int, hits: int) -> None:
+        """Tiered prefix-cache state (ISSUE 19): pages currently parked
+        per lower tier plus cumulative lower-tier admission hits."""
+        self.serve_tiers_armed = True
+        self.serve_pages_host = int(host)
+        self.serve_pages_disk = int(disk)
+        self.serve_tier_hits = int(hits)
 
     def note_serve_spec(self, committed: int, forwards: int) -> None:
         """Cumulative speculative tokens committed / per-row verifies."""
@@ -633,6 +657,8 @@ class ProcessLedger:
                 out["serve_requests_by_group"] = dict(
                     sorted(self.serve_requests_by_group.items())
                 )
+            if self.serve_role is not None:
+                out["serve_role"] = self.serve_role
             if self.serve_pages_total:
                 out["serve_pages_free"] = self.serve_pages_free
                 if self.serve_prefix_lookups:
@@ -640,6 +666,10 @@ class ProcessLedger:
                         self.serve_prefix_hits / self.serve_prefix_lookups,
                         4,
                     )
+            if self.serve_tiers_armed:
+                out["serve_pages_host"] = self.serve_pages_host
+                out["serve_pages_disk"] = self.serve_pages_disk
+                out["serve_tier_hits"] = self.serve_tier_hits
             if self.serve_spec_forwards:
                 out["serve_spec_accept_rate"] = round(
                     self.serve_spec_committed / self.serve_spec_forwards, 4

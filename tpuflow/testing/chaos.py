@@ -6,9 +6,10 @@ ReplicaGateway (/generate), its own ProcessLedger, and its own
 MetricsServer (/status) — run inside one process, discovered through a
 real registration dir and polled by a real FleetObservatory over real
 HTTP. Chaos is injected through the PR 6 fault vocabulary
-(``replica_kill:<id>@<t>`` / ``replica_stall:<id>@<t>`` in
-``TPUFLOW_FAULT``, read via ``faults.replica_plan()``) or directly via
-``LocalReplica.kill()`` / ``.stall()`` / ``.drain()``.
+(``replica_kill:<id>@<t>`` / ``replica_stall:<id>@<t>`` /
+``prefill_kill:<id>@<t>`` in ``TPUFLOW_FAULT``, read via
+``faults.replica_plan()``) or directly via ``LocalReplica.kill()`` /
+``.stall()`` / ``.drain()``.
 
 Per-replica state stays private on purpose: the engines would
 otherwise all feed the process-singleton goodput ledger and the fleet
@@ -73,6 +74,11 @@ class LocalReplica:
         self._idle_sleep_s = float(idle_sleep_s)
         self._ledger = ProcessLedger()
         self._ledger.note_serve_state(0, 0, engine.max_slots)
+        # Disaggregated serving (ISSUE 19): the fleet row carries this
+        # replica's role so the router can split ship hops from decode
+        # placement, and its spill-tier page counts for the warmth
+        # tie-break.
+        self._ledger.note_serve_role(getattr(engine, "role", "both"))
         if getattr(engine, "pool", None) is not None:
             self._ledger.note_serve_pages(
                 engine.pool.free_pages, engine.pool.usable_pages
@@ -151,6 +157,13 @@ class LocalReplica:
                         self._ledger.note_serve_pages(
                             eng.pool.free_pages, eng.pool.usable_pages
                         )
+                        tier = getattr(eng.pool, "tier", None)
+                        if tier is not None and tier.armed:
+                            self._ledger.note_serve_tiers(
+                                tier.pages_host,
+                                tier.pages_disk,
+                                eng.pool.tier_hits,
+                            )
             if not did:
                 time.sleep(self._idle_sleep_s)
 
@@ -211,7 +224,12 @@ def apply_replica_plan(
             rep = replicas.get(target)
             if rep is None:
                 continue
-            if kind == "replica_kill":
+            if kind in ("replica_kill", "prefill_kill"):
+                # prefill_kill (ISSUE 19) is a replica_kill aimed at a
+                # prefill-role replica: same dead-pod semantics, its
+                # own spec name so one TPUFLOW_FAULT string can kill
+                # the prefill worker mid-ship while decode replicas
+                # ride out the loss via local-prefill fallback.
                 rep.kill()
             elif kind == "replica_stall":
                 rep.stall()

@@ -66,6 +66,12 @@ process. Grammar (one spec per entry)::
                                  stay open and hang — the livelock case
                                  only the router's per-replica forward
                                  timeout can detect
+    prefill_kill:<id>@<t>        disaggregated serving chaos (ISSUE 19):
+                                 kill the prefill-role replica <id> <t>
+                                 seconds in — mid-ship death; the router
+                                 must fall back to local prefill on the
+                                 decode replicas without dropping or
+                                 corrupting any request
 
 Hooks are threaded through gang exec (``maybe_rendezvous_delay``), the
 train loops (``step_boundary`` — called by ``TrainContext.report`` and
@@ -113,6 +119,7 @@ KINDS = (
     "upload_stall",
     "replica_kill",
     "replica_stall",
+    "prefill_kill",
 )
 
 # Parse cache keyed on the raw env string (tests flip the env between
@@ -178,7 +185,7 @@ def parse(raw: str) -> list[Fault]:
             value = float(int(payload[1:]))
         elif kind == "upload_stall":
             value = float(payload) if payload else 5.0
-        elif kind in ("replica_kill", "replica_stall"):
+        elif kind in ("replica_kill", "replica_stall", "prefill_kill"):
             target_s, _, t_s = payload.partition("@")
             if not target_s or not t_s:
                 raise ValueError(
@@ -388,7 +395,9 @@ def replica_plan() -> list[tuple[str, str, float]]:
     plan = [
         (f.kind, f.target or "", float(f.value or 0.0))
         for f in _specs()
-        if f.kind in ("replica_kill", "replica_stall")
+        if f.kind in (
+            "replica_kill", "replica_stall", "prefill_kill"
+        )
     ]
     plan.sort(key=lambda x: x[2])
     return plan

@@ -54,6 +54,7 @@ _A_ROUTER = "router--failover-runbook"
 _A_TRACE = "distributed-tracing-runbook"
 _A_DEVICE = "device-observatory-runbook"
 _A_QUANT = "quantization-runbook"
+_A_KV = "disaggregated-serving-runbook"
 _A_ALERTS = "regression--alerting-runbook"
 _A_OBS = "goodput--live-monitoring-runbook"
 _A_OBS_BASE = "observability"
@@ -413,6 +414,38 @@ REGISTRY: dict[str, Knob] = dict(
            "declared inter-token-latency SLO in ms, checked per decode "
            "tick (tick wall / tokens committed)", "serve", _A_SERVE,
            default_doc="off"),
+        # ------------------------------------------- kv (disaggregation)
+        _k("TPUFLOW_SERVE_ROLE", "enum", "both",
+           "serving phase this replica advertises: a prefill replica "
+           "takes the router's ship hops, a decode replica takes "
+           "admissions (both = classic colocated serving; every "
+           "existing path stays byte-identical)", "kv", _A_KV,
+           choices=("prefill", "decode", "both")),
+        _k("TPUFLOW_KV_STORE_DIR", "path", None,
+           "committed KVPageSet store (the prefill→decode shipping "
+           "layer: one crc-manifested blob per page set, tmp+rename "
+           "commit, torn sets never load)", "kv", _A_KV),
+        _k("TPUFLOW_KV_HOST_MB", "float", 0.0,
+           "host-DRAM spill-tier budget in MiB for evicted-but-"
+           "matchable prefix pages (0 = tier off; eviction forgets "
+           "pages exactly as before)", "kv", _A_KV),
+        _k("TPUFLOW_KV_DISK_DIR", "path", None,
+           "node-local disk spill tier (same kv_store commit protocol; "
+           "rescanned at engine start, so hot prefixes survive a "
+           "replica restart)", "kv", _A_KV),
+        _k("TPUFLOW_KV_DISK_MB", "float", 0.0,
+           "disk spill-tier LRU budget in MiB (0 = unbounded; trimmed "
+           "by manifest mtime after each spill)", "kv", _A_KV),
+        _k("TPUFLOW_KV_INDEX_MAX", "int", 4096,
+           "bound on the digest→tier index (the eviction-forgets-"
+           "digests fix: spilled prefixes stay findable for promotion "
+           "and router affinity without unbounded host state)", "kv",
+           _A_KV),
+        _k("TPUFLOW_KV_SHIP_MIN_TOKENS", "int", 0,
+           "router: prompts at least this long take a prefill-replica "
+           "hop before decode placement (0 = ship hop off; any "
+           "ship-hop failure falls back to local prefill)", "kv",
+           _A_KV),
         # ---------------------------------------------------------- fleet
         _k("TPUFLOW_FLEET_REPLICAS", "list", None,
            "comma list of replica /status base URLs the fleet "
@@ -626,6 +659,12 @@ REGISTRY: dict[str, Knob] = dict(
            "replicas + one kill behind the front door; records "
            "dropped_requests — must be 0 — and routed p99)", "bench",
            _A_BENCH),
+        _k("TPUFLOW_BENCH_DISAGG", "bool", True,
+           "0 = skip the serving.disagg bench leg (TTFT cold vs "
+           "tier-hit vs cross-engine ship on one hot prompt set; "
+           "records ttft_tier_hit_vs_cold — fresh on-chip gate < 1.0 "
+           "— per-tier hit rates, and ship exactness)", "bench",
+           _A_BENCH),
         _k("TPUFLOW_BENCH_INT8", "bool", True,
            "0 = skip the int8 bench legs", "bench", _A_BENCH),
         _k("TPUFLOW_BENCH_OVERLAP", "bool", True,
@@ -674,6 +713,7 @@ _SUBSYSTEM_TITLES = (
     ("ops", "Kernels & dispatch"),
     ("quant", "Quantization"),
     ("serve", "Serving"),
+    ("kv", "Disaggregated serving & KV tiers"),
     ("fleet", "Fleet observatory"),
     ("router", "Front-door router"),
     ("trace", "Distributed tracing"),
